@@ -1,0 +1,38 @@
+"""TPU-native Kubernetes authorizing proxy.
+
+A brand-new framework with the capabilities of
+``josephschorr/spicedb-kubeapi-proxy`` (reference at /root/reference — see
+SURVEY.md): a reverse proxy in front of a kube-apiserver that
+
+- authorizes every request against a Zanzibar-style relationship graph,
+- filters responses (single objects, lists, tables, watch streams) down to
+  what the caller may see, and
+- durably dual-writes relationship updates + Kubernetes objects in one
+  logical transaction,
+
+with the authorization hot path (CheckPermission / LookupResources / list
+filtering) executed on TPU: the relationship graph is compiled into a flat
+slot-space of (type, relation, object) booleans plus one global
+(dst, src) edge tensor, and permission evaluation is a jitted fixpoint of
+gather/segment-max propagation + an elementwise userset-rewrite program
+(see ops/reachability.py).
+
+Subpackages
+-----------
+- ``models``   — schema DSL (definitions/relations/permissions) parser + IR
+- ``engine``   — relationship store, snapshots, the query engine (the
+                 embedded-SpiceDB replacement; reference pkg/spicedb)
+- ``ops``      — JAX/XLA kernels for batched reachability
+- ``parallel`` — device-mesh sharding of the edge tensors (shard_map + psum)
+- ``rules``    — ProxyRule config + template/expression compiler
+                 (reference pkg/rules, pkg/config/proxyrule)
+- ``authz``    — per-request authorization middleware + response filtering
+                 (reference pkg/authz)
+- ``proxy``    — HTTP server, authn, reverse proxy, in-memory transport
+                 (reference pkg/proxy, pkg/inmemory)
+- ``dtx``      — durable dual-write workflow engine
+                 (reference pkg/authz/distributedtx)
+- ``utils``    — failpoints, metrics, logging
+"""
+
+__version__ = "0.1.0"

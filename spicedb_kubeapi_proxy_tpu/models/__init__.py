@@ -1,0 +1,27 @@
+"""Schema models: the Zanzibar-style schema DSL parser and typed IR.
+
+Covers the schema language surface the reference uses (see
+/root/reference/pkg/spicedb/bootstrap.yaml:1-38 and e2e bootstrap schemas):
+``use expiration``, ``definition``, ``relation`` with union subject types
+(including userset subjects ``type#relation``, wildcard ``type:*`` and
+``with expiration``), and ``permission`` expressions with union ``+``,
+intersection ``&``, exclusion ``-``, arrows ``rel->perm`` and ``nil``.
+"""
+
+from .schema import (  # noqa: F401
+    AllowedSubject,
+    Arrow,
+    Definition,
+    Exclude,
+    Expr,
+    Intersect,
+    Nil,
+    Permission,
+    Relation,
+    RelationRef,
+    Schema,
+    SchemaError,
+    Union,
+    parse_schema,
+)
+from .bootstrap import parse_bootstrap, Bootstrap  # noqa: F401

@@ -1,0 +1,135 @@
+"""Relationship tuples and their string form.
+
+A relationship is ``resource_type:resource_id#relation@subject_type:subject_id``
+optionally followed by ``#subject_relation`` (userset subject) and/or an
+``[expiration:RFC3339]`` trait. Mirrors the reference's template grammar
+(/root/reference/pkg/rules/rules.go:1050-1073) and SpiceDB's tuple string
+format used in bootstrap ``relationships`` blocks
+(/root/reference/pkg/spicedb/bootstrap.yaml:39-40).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, replace
+from datetime import datetime, timezone
+from typing import Optional
+
+
+class TupleError(ValueError):
+    pass
+
+
+# Template splitting (lenient): segments may contain '/', '.', '-', '{{ }}'
+# templates, '$' wildcards etc.; only ':', '#', '@' are structural. Same
+# shape as the reference's relRegex (rules.go:1050-1052).
+_TPL_RE = re.compile(
+    r"^(?P<resource_type>.*?):(?P<resource_id>.*?)#(?P<relation>.*?)"
+    r"@(?P<subject_type>.*?):(?P<subject_id>.*?)(?:#(?P<subject_relation>.*?))?$"
+)
+
+# Concrete relationship strings (strict): types/relations are identifiers,
+# ids allow the kube-ish charset (slashes for namespacedName, dots, dashes)
+# plus '*' for wildcard subjects; an optional [expiration:...] trait must be
+# a well-formed suffix — trailing garbage is rejected, not absorbed.
+_IDENT = r"[A-Za-z_][A-Za-z0-9_/]*"
+_ID = r"[A-Za-z0-9_.=+/-]+|\*"
+_REL_RE = re.compile(
+    rf"^(?P<resource_type>{_IDENT}):(?P<resource_id>{_ID})#(?P<relation>{_IDENT})"
+    rf"@(?P<subject_type>{_IDENT}):(?P<subject_id>{_ID})"
+    rf"(?:#(?P<subject_relation>{_IDENT}|\.\.\.))?"
+    rf"(?:\[expiration:(?P<expiration>[^\]]+)\])?$"
+)
+
+ELLIPSIS = "..."
+
+
+@dataclass(frozen=True)
+class Relationship:
+    resource_type: str
+    resource_id: str
+    relation: str
+    subject_type: str
+    subject_id: str
+    subject_relation: Optional[str] = None  # userset subject, e.g. group#member
+    expiration: Optional[float] = None  # unix seconds; None = never expires
+
+    def key(self) -> tuple:
+        """Identity key — expiration is an attribute, not identity (TOUCH
+        overwrites the expiration of an existing tuple)."""
+        return (
+            self.resource_type,
+            self.resource_id,
+            self.relation,
+            self.subject_type,
+            self.subject_id,
+            self.subject_relation or "",
+        )
+
+    def without_expiration(self) -> "Relationship":
+        return replace(self, expiration=None)
+
+    def __str__(self) -> str:
+        s = (
+            f"{self.resource_type}:{self.resource_id}#{self.relation}"
+            f"@{self.subject_type}:{self.subject_id}"
+        )
+        if self.subject_relation:
+            s += f"#{self.subject_relation}"
+        if self.expiration is not None:
+            ts = datetime.fromtimestamp(self.expiration, tz=timezone.utc)
+            s += f"[expiration:{ts.strftime('%Y-%m-%dT%H:%M:%SZ')}]"
+        return s
+
+
+def parse_expiration(text: str) -> float:
+    """RFC3339 → unix seconds."""
+    t = text.strip()
+    if t.endswith("Z"):
+        t = t[:-1] + "+00:00"
+    try:
+        dt = datetime.fromisoformat(t)
+    except ValueError as e:
+        raise TupleError(f"invalid expiration {text!r}: {e}") from None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
+
+
+def parse_relationship(text: str) -> Relationship:
+    """Parse a concrete relationship string (no templates)."""
+    m = _REL_RE.match(text.strip())
+    if not m:
+        raise TupleError(f"invalid relationship: {text!r}")
+    g = m.groupdict()
+    sub_rel = g["subject_relation"] or None
+    if sub_rel == ELLIPSIS:
+        sub_rel = None
+    exp = parse_expiration(g["expiration"]) if g["expiration"] else None
+    return Relationship(
+        g["resource_type"],
+        g["resource_id"],
+        g["relation"],
+        g["subject_type"],
+        g["subject_id"],
+        sub_rel,
+        exp,
+    )
+
+
+def parse_rel_fields(text: str) -> dict:
+    """Split a (possibly templated) relationship string into its six fields
+    without validating contents — the rules engine compiles each field as an
+    expression (reference ParseRelSring, rules.go:1056-1073)."""
+    m = _TPL_RE.match(text.strip())
+    if not m:
+        raise TupleError(f"invalid relationship template: {text!r}")
+    g = m.groupdict()
+    return {
+        "resource_type": g["resource_type"],
+        "resource_id": g["resource_id"],
+        "relation": g["relation"],
+        "subject_type": g["subject_type"],
+        "subject_id": g["subject_id"],
+        "subject_relation": g["subject_relation"] or None,
+    }

@@ -1,0 +1,19 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Real-TPU runs happen via bench.py / __graft_entry__.py; unit tests exercise
+the same jitted code paths on CPU, including multi-device sharding over a
+virtual 8-device mesh (SURVEY.md env notes).
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

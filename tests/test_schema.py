@@ -1,0 +1,236 @@
+"""Schema DSL parser + tuple parsing tests (models/)."""
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.models import (
+    Arrow,
+    Exclude,
+    Intersect,
+    Nil,
+    RelationRef,
+    SchemaError,
+    Union,
+    parse_bootstrap,
+    parse_schema,
+)
+from spicedb_kubeapi_proxy_tpu.models.bootstrap import DEFAULT_BOOTSTRAP
+from spicedb_kubeapi_proxy_tpu.models.tuples import (
+    Relationship,
+    TupleError,
+    parse_rel_fields,
+    parse_relationship,
+)
+
+REFERENCE_SCHEMA = """
+use expiration
+
+definition cluster {}
+definition user {}
+definition namespace {
+  relation cluster: cluster
+  relation creator: user
+  relation viewer: user
+
+  permission admin = creator
+  permission edit = creator
+  permission view = viewer + creator
+  permission no_one_at_all = nil
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user
+  permission edit = creator
+  permission view = viewer + creator
+}
+definition lock {
+  relation workflow: workflow
+}
+
+definition workflow {
+  relation idempotency_key: activity with expiration
+}
+
+definition activity{}
+"""
+
+
+def test_parse_reference_bootstrap_schema():
+    s = parse_schema(REFERENCE_SCHEMA)
+    assert s.use_expiration
+    assert set(s.definitions) == {
+        "cluster", "user", "namespace", "pod", "lock", "workflow", "activity",
+    }
+    ns = s.definitions["namespace"]
+    assert set(ns.relations) == {"cluster", "creator", "viewer"}
+    assert set(ns.permissions) == {"admin", "edit", "view", "no_one_at_all"}
+    view = ns.permissions["view"].expr
+    assert view == Union((RelationRef("viewer"), RelationRef("creator")))
+    assert ns.permissions["no_one_at_all"].expr == Nil()
+    wf = s.definitions["workflow"]
+    assert wf.relations["idempotency_key"].allowed[0].expiration
+
+
+def test_userset_wildcard_and_arrow():
+    s = parse_schema("""
+    definition user {}
+    definition group {
+      relation member: user | group#member
+    }
+    definition folder {
+      relation parent: folder
+      relation viewer: user | user:* | group#member
+      permission view = viewer + parent->view
+    }
+    """)
+    g = s.definitions["group"].relations["member"]
+    assert g.allowed[1].relation == "member"
+    f = s.definitions["folder"]
+    viewer = f.relations["viewer"]
+    assert viewer.allowed[1].wildcard
+    expr = f.permissions["view"].expr
+    assert expr == Union((RelationRef("viewer"), Arrow("parent", "view")))
+
+
+def test_intersection_exclusion_parens():
+    s = parse_schema("""
+    definition user {}
+    definition doc {
+      relation a: user
+      relation b: user
+      relation c: user
+      permission p = (a & b) - c
+      permission q = a - (b + c)
+    }
+    """)
+    d = s.definitions["doc"]
+    p = d.permissions["p"].expr
+    assert p == Exclude(Intersect((RelationRef("a"), RelationRef("b"))), RelationRef("c"))
+    q = d.permissions["q"].expr
+    assert q == Exclude(RelationRef("a"), Union((RelationRef("b"), RelationRef("c"))))
+
+
+def test_comments_and_caveats_tolerated():
+    s = parse_schema("""
+    // line comment
+    /* block
+       comment */
+    caveat only_on_tuesday(day string) {
+      day == "tuesday"
+    }
+    definition user {}
+    """)
+    assert "user" in s.definitions
+
+
+@pytest.mark.parametrize(
+    "bad,msg",
+    [
+        ("definition a { relation r: nosuch }", "unknown subject type"),
+        ("definition a { permission p = nope }", "unknown relation"),
+        ("definition user {} definition a { relation r: user } definition a {}", "duplicate definition"),
+        ("definition a { relation r: a relation r: a }", "duplicate"),
+        ("definition user {} definition g { relation m: user } definition a { relation r: g#nosuch }", "unknown subject relation"),
+        ("definition user {} definition a { relation t: user permission p = t->nothing }", "arrow target"),
+        ("definition user {} definition a { permission p = p2->x }", "tupleset"),
+    ],
+)
+def test_validation_errors(bad, msg):
+    with pytest.raises(SchemaError, match=msg):
+        parse_schema(bad)
+
+
+def test_parse_relationship_roundtrip():
+    r = parse_relationship("namespace:spicedb-kubeapi-proxy#viewer@user:rakis")
+    assert r == Relationship("namespace", "spicedb-kubeapi-proxy", "viewer", "user", "rakis")
+    assert str(r) == "namespace:spicedb-kubeapi-proxy#viewer@user:rakis"
+
+    r2 = parse_relationship("pod:default/nginx#viewer@group:eng#member")
+    assert r2.resource_id == "default/nginx"
+    assert r2.subject_relation == "member"
+
+    r3 = parse_relationship(
+        "workflow:abc#idempotency_key@activity:xyz[expiration:2030-01-01T00:00:00Z]"
+    )
+    assert r3.expiration is not None and r3.expiration > 1.8e9
+    assert "expiration:2030-01-01T00:00:00Z" in str(r3)
+
+    # '...' subject relation normalizes to None
+    r4 = parse_relationship("a:b#c@d:e#...")
+    assert r4.subject_relation is None
+
+
+def test_parse_relationship_errors():
+    for bad in ["nope", "a:b@c:d", "a:b#c@d", ":x#y@z:w"]:
+        with pytest.raises(TupleError):
+            parse_relationship(bad)
+
+
+def test_parse_rel_fields_templates():
+    f = parse_rel_fields("pod:{{namespacedName}}#creator@user:{{user.name}}")
+    assert f["resource_type"] == "pod"
+    assert f["resource_id"] == "{{namespacedName}}"
+    assert f["subject_id"] == "{{user.name}}"
+    assert f["subject_relation"] is None
+    f2 = parse_rel_fields("namespace:$#view@user:{{user.name}}")
+    assert f2["resource_id"] == "$"
+
+
+def test_parse_bootstrap_default():
+    b = parse_bootstrap(DEFAULT_BOOTSTRAP)
+    assert "namespace" in b.schema.definitions
+    assert b.relationships == []
+
+
+def test_parse_bootstrap_multi_doc():
+    b = parse_bootstrap("""
+schema: |-
+  definition user {}
+  definition ns {
+    relation viewer: user
+  }
+relationships: |
+  ns:a#viewer@user:alice
+  ns:b#viewer@user:bob
+""")
+    assert len(b.relationships) == 2
+    assert b.relationships[0].resource_id == "a"
+
+
+def test_review_findings_regressions():
+    # Trailing garbage / malformed expiration traits are rejected, not absorbed.
+    for bad in [
+        "a:b#c@d:e[expiration:2030-01-01T00:00:00Z]x",
+        "a:b#c@d:e[expiration:notclosed",
+        "a:b#c@d:e]junk",
+    ]:
+        with pytest.raises(TupleError):
+            parse_relationship(bad)
+
+    # Keywords are reserved as relation/permission names.
+    with pytest.raises(SchemaError, match="reserved keyword"):
+        parse_schema("definition user {} definition a { relation nil: user }")
+
+    # Arrows over wildcard-able tuplesets are rejected.
+    with pytest.raises(SchemaError, match="wildcard"):
+        parse_schema("""
+        definition user {}
+        definition folder {
+          relation parent: folder:*
+          relation viewer: user
+          permission view = viewer + parent->view
+        }
+        """)
+
+    # Caller bootstraps missing lock/workflow/activity get them appended.
+    b = parse_bootstrap("schema: |\n  definition user {}\n")
+    assert {"lock", "workflow", "activity"} <= set(b.schema.definitions)
+    # ...without clobbering caller-provided ones.
+    b2 = parse_bootstrap(
+        "schema: |\n  definition user {}\n  definition activity {}\n  definition lock { relation workflow: workflow }\n"
+    )
+    assert "workflow" in b2.schema.definitions
+
+    # Wildcard subject ids still parse as concrete tuples.
+    r = parse_relationship("pod:x#viewer@user:*")
+    assert r.subject_id == "*"

@@ -1,0 +1,21 @@
+"""The relationship-graph engine — the embedded-SpiceDB replacement.
+
+Host side: string interning, a mutable columnar relationship store with
+revisions/preconditions/watch (reference pkg/spicedb embedded server
+semantics), and a pure-Python oracle evaluator used as the correctness
+oracle for the TPU path. Device side: snapshots compiled by
+ops/reachability.py and queried through :class:`Engine`.
+"""
+
+from .interning import Interner  # noqa: F401
+from .store import (  # noqa: F401
+    Columns,
+    Precondition,
+    PreconditionFailed,
+    RelationshipFilter,
+    Store,
+    StoreError,
+    WriteOp,
+)
+from .evaluator import OracleEvaluator  # noqa: F401
+from .engine import CheckItem, Engine, WatchEvent  # noqa: F401

@@ -1,0 +1,246 @@
+"""The query engine: SpiceDB-equivalent API over the TPU reachability path.
+
+Public surface mirrors what the reference proxy consumes from authzed-go
+(SURVEY.md §2.5): WriteRelationships (create/touch/delete + preconditions),
+ReadRelationships, DeleteRelationships(filter), CheckPermission /
+CheckBulkPermissions, LookupResources, and Watch. All queries are fully
+consistent — the reference always requests full consistency
+(/root/reference/pkg/authz/check.go:42-44, lookups.go:50-52) — implemented
+as compile-on-demand: a query against a stale snapshot recompiles first.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..models.bootstrap import Bootstrap, DEFAULT_BOOTSTRAP, parse_bootstrap
+from ..models.schema import Schema
+from ..models.tuples import Relationship
+from ..ops.reachability import CompiledGraph, compile_graph
+from .evaluator import OracleEvaluator
+from .store import (
+    Precondition,
+    RelationshipFilter,
+    Store,
+    StoreError,
+    WatchRecord,
+    WriteOp,
+)
+
+
+class SchemaViolation(StoreError):
+    pass
+
+
+@dataclass(frozen=True)
+class CheckItem:
+    resource_type: str
+    resource_id: str
+    permission: str
+    subject_type: str
+    subject_id: str
+    subject_relation: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    revision: int
+    operation: str  # "touch" | "delete"
+    relationship: Relationship
+
+
+class Engine:
+    """In-process relationship-graph engine (the ``embedded://`` / ``tpu://``
+    backend). Thread-safe."""
+
+    def __init__(self, bootstrap: Optional[str] = None,
+                 schema: Optional[Schema] = None,
+                 validate_writes: bool = True):
+        if schema is None:
+            b: Bootstrap = parse_bootstrap(bootstrap or DEFAULT_BOOTSTRAP)
+            schema = b.schema
+            seed = b.relationships
+        else:
+            seed = []
+        self.schema = schema
+        self.store = Store()
+        self.validate_writes = validate_writes
+        self._lock = threading.RLock()
+        self._compiled: Optional[CompiledGraph] = None
+        if seed:
+            self.write_relationships([WriteOp("touch", r) for r in seed])
+
+    # -- write path ---------------------------------------------------------
+
+    def _validate(self, rel: Relationship) -> None:
+        d = self.schema.definitions.get(rel.resource_type)
+        if d is None:
+            raise SchemaViolation(f"unknown resource type {rel.resource_type!r}")
+        r = d.relations.get(rel.relation)
+        if r is None:
+            raise SchemaViolation(
+                f"{rel.resource_type} has no relation {rel.relation!r}"
+                + (" (permissions are not writable)"
+                   if rel.relation in d.permissions else "")
+            )
+        sub_def = self.schema.definitions.get(rel.subject_type)
+        if sub_def is None:
+            raise SchemaViolation(f"unknown subject type {rel.subject_type!r}")
+        ok = False
+        for a in r.allowed:
+            if a.type != rel.subject_type:
+                continue
+            if rel.subject_id == "*":
+                if not a.wildcard:
+                    continue
+            elif a.wildcard or (a.relation or None) != rel.subject_relation:
+                continue
+            ok = True
+            if rel.expiration is not None and not a.expiration:
+                raise SchemaViolation(
+                    f"{rel.resource_type}#{rel.relation} does not allow "
+                    "expiring relationships"
+                )
+            break
+        if not ok:
+            raise SchemaViolation(
+                f"subject {rel.subject_type}"
+                + (f"#{rel.subject_relation}" if rel.subject_relation else "")
+                + f" not allowed on {rel.resource_type}#{rel.relation}"
+            )
+        if rel.subject_relation:
+            if not self.schema.definitions[rel.subject_type].relation_or_permission(
+                rel.subject_relation
+            ):
+                raise SchemaViolation(
+                    f"{rel.subject_type} has no relation "
+                    f"{rel.subject_relation!r}"
+                )
+
+    def write_relationships(self, ops: list[WriteOp],
+                            preconditions: list[Precondition] = ()) -> int:
+        if self.validate_writes:
+            for op in ops:
+                self._validate(op.rel)
+        return self.store.write(list(ops), list(preconditions))
+
+    def delete_relationships(self, f: RelationshipFilter,
+                             preconditions: list[Precondition] = ()) -> int:
+        return self.store.delete_by_filter(f, list(preconditions))
+
+    def read_relationships(self, f: RelationshipFilter) -> Iterator[Relationship]:
+        return self.store.read(f)
+
+    def bulk_load(self, rels_cols: dict) -> int:
+        return self.store.bulk_load(rels_cols)
+
+    # -- query path ---------------------------------------------------------
+
+    def _objects_by_name(self) -> dict:
+        return {
+            self.store.types.string(tid): it
+            for tid, it in self.store.objects.items()
+        }
+
+    def compiled(self) -> CompiledGraph:
+        """Fully-consistent snapshot: recompile if the store moved."""
+        with self._lock:
+            if self._compiled is None or \
+               self._compiled.revision != self.store.revision:
+                self._compiled = compile_graph(self.schema, self.store.snapshot())
+            return self._compiled
+
+    def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
+        return self.check_bulk([item], now=now)[0]
+
+    def check_bulk(self, items: list[CheckItem],
+                   now: Optional[float] = None) -> list[bool]:
+        """CheckBulkPermissions: evaluate all items in one device pass,
+        batching distinct subjects along B (reference check.go:22-48 issues
+        one bulk RPC per request; here the whole bulk is one fixpoint)."""
+        if not items:
+            return []
+        cg = self.compiled()
+        objs = self._objects_by_name()
+        subjects: dict[tuple, int] = {}
+        seed_rows: list[tuple[int, int]] = []
+        q_slots = np.empty(len(items), dtype=np.int32)
+        q_batch = np.empty(len(items), dtype=np.int32)
+        for i, it in enumerate(items):
+            skey = (it.subject_type, it.subject_id, it.subject_relation)
+            row = subjects.get(skey)
+            if row is None:
+                row = len(seed_rows)
+                subjects[skey] = row
+                seed_rows.append(
+                    cg.encode_subject(it.subject_type, it.subject_id,
+                                      it.subject_relation, objs)
+                )
+            q_slots[i] = cg.encode_target(it.resource_type, it.permission,
+                                          it.resource_id, objs)
+            q_batch[i] = row
+        seeds = np.asarray(seed_rows, dtype=np.int32)
+        out = cg.query(seeds, q_slots, q_batch, now=now)
+        return [bool(x) for x in out]
+
+    def lookup_resources(self, resource_type: str, permission: str,
+                         subject_type: str, subject_id: str,
+                         subject_relation: Optional[str] = None,
+                         now: Optional[float] = None) -> list[str]:
+        """LookupResources: ids of ``resource_type`` on which the subject has
+        ``permission`` (reference lookups.go:49-65 streams these; we return
+        the whole set from one device pass)."""
+        mask, interner = self.lookup_resources_mask(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now)
+        if mask is None:
+            return []
+        return [interner.string(i) for i in np.flatnonzero(mask).tolist()]
+
+    def lookup_resources_mask(self, resource_type: str, permission: str,
+                              subject_type: str, subject_id: str,
+                              subject_relation: Optional[str] = None,
+                              now: Optional[float] = None):
+        """Vectorized variant for the list-filter hot path: returns
+        (bool mask over the type's object index space, per-type interner).
+        Callers with a list of candidate names map name->index and test the
+        mask directly — no per-object RPC or string materialization."""
+        cg = self.compiled()
+        objs = self._objects_by_name()
+        off = cg.offset_of(resource_type, permission)
+        n = cg.type_sizes.get(resource_type)
+        interner = objs.get(resource_type)
+        if off is None or interner is None:
+            return None, None
+        seeds = np.asarray(
+            [cg.encode_subject(subject_type, subject_id, subject_relation, objs)],
+            dtype=np.int32,
+        )
+        q_slots = off + np.arange(n, dtype=np.int32)
+        q_batch = np.zeros(n, dtype=np.int32)
+        out = np.array(cg.query(seeds, q_slots, q_batch, now=now))
+        out[0] = False  # void
+        out[1] = False  # wildcard pseudo-object
+        return out, interner
+
+    # -- watch --------------------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        return self.store.revision
+
+    def watch_since(self, revision: int) -> list[WatchEvent]:
+        return [
+            WatchEvent(r.revision, "touch" if r.op == 2 else "delete", r.rel)
+            for r in self.store.watch_since(revision)
+        ]
+
+    # -- debugging ----------------------------------------------------------
+
+    def oracle(self, now: Optional[float] = None) -> OracleEvaluator:
+        return OracleEvaluator(self.schema, self.store.snapshot(), now=now)

@@ -1,0 +1,58 @@
+"""String interning: stable string -> int32 ids, vectorized for bulk loads."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+class Interner:
+    """Monotone string→int table. Index 0 is reserved for ``reserved[0]``, etc.
+
+    Used for type names, relation names, and per-type object ids. Bulk
+    interning goes through :meth:`intern_many` (one dict pass, no per-call
+    Python overhead beyond the loop).
+    """
+
+    __slots__ = ("_to_id", "_to_str")
+
+    def __init__(self, reserved: Iterable[str] = ()):
+        self._to_id: dict[str, int] = {}
+        self._to_str: list[str] = []
+        for s in reserved:
+            self.intern(s)
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def lookup(self, s: str) -> Optional[int]:
+        return self._to_id.get(s)
+
+    def string(self, i: int) -> str:
+        return self._to_str[i]
+
+    def intern_many(self, strings) -> np.ndarray:
+        """Intern a sequence of strings, returning int32 ids."""
+        to_id = self._to_id
+        to_str = self._to_str
+        out = np.empty(len(strings), dtype=np.int32)
+        for k, s in enumerate(strings):
+            i = to_id.get(s)
+            if i is None:
+                i = len(to_str)
+                to_id[s] = i
+                to_str.append(s)
+            out[k] = i
+        return out
+
+    def strings(self) -> list[str]:
+        return list(self._to_str)

@@ -1,0 +1,454 @@
+"""Mutable relationship store: columnar, revisioned, watchable.
+
+Plays the role of the reference's embedded SpiceDB datastore
+(/root/reference/pkg/spicedb/spicedb.go:18-57): WriteRelationships with
+CREATE/TOUCH/DELETE semantics and preconditions, ReadRelationships /
+DeleteRelationships by filter, relationship expiration, and a watch log.
+
+Layout is columnar int32 (see :class:`Columns`) so that 10M-relationship
+graphs bulk-load and snapshot without per-row Python objects; a dict index
+over row keys is built lazily only when single-row mutations need it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..models.tuples import Relationship
+from .interning import Interner
+
+# Operation codes (watch log + write ops)
+OP_CREATE = 1
+OP_TOUCH = 2
+OP_DELETE = 3
+
+_OPS = {"create": OP_CREATE, "touch": OP_TOUCH, "delete": OP_DELETE}
+
+NO_EXPIRATION = np.float64(np.inf)
+
+
+class StoreError(Exception):
+    pass
+
+
+class PreconditionFailed(StoreError):
+    """A write's precondition did not hold (maps to gRPC FailedPrecondition,
+    which the pessimistic workflow turns into kube 409 Conflict —
+    reference workflow.go:189-202)."""
+
+
+class AlreadyExists(StoreError):
+    """CREATE of an existing relationship."""
+
+
+@dataclass
+class Columns:
+    """Columnar relationship block: parallel int32 arrays + expiration."""
+
+    rt: np.ndarray  # resource type id      (types interner)
+    rid: np.ndarray  # resource object id   (per-type objects interner)
+    rl: np.ndarray  # relation id           (relations interner)
+    st: np.ndarray  # subject type id
+    sid: np.ndarray  # subject object id
+    srl: np.ndarray  # subject relation id; 0 == none (ELLIPSIS)
+    exp: np.ndarray  # float64 unix seconds; +inf == never expires
+
+    def __len__(self) -> int:
+        return len(self.rt)
+
+    @staticmethod
+    def empty() -> "Columns":
+        z = np.empty(0, dtype=np.int32)
+        return Columns(z, z.copy(), z.copy(), z.copy(), z.copy(), z.copy(),
+                       np.empty(0, dtype=np.float64))
+
+    @staticmethod
+    def concat(blocks: list["Columns"]) -> "Columns":
+        if not blocks:
+            return Columns.empty()
+        return Columns(*[
+            np.concatenate([getattr(b, f) for b in blocks])
+            for f in ("rt", "rid", "rl", "st", "sid", "srl", "exp")
+        ])
+
+    def take(self, idx) -> "Columns":
+        return Columns(self.rt[idx], self.rid[idx], self.rl[idx], self.st[idx],
+                       self.sid[idx], self.srl[idx], self.exp[idx])
+
+
+@dataclass(frozen=True)
+class RelationshipFilter:
+    """SpiceDB-style relationship filter. ``None`` fields match anything —
+    the rules engine maps the ``$`` wildcard convention
+    (reference pkg/authz/update.go:207-271) to ``None`` here."""
+
+    resource_type: Optional[str] = None
+    resource_id: Optional[str] = None
+    relation: Optional[str] = None
+    subject_type: Optional[str] = None
+    subject_id: Optional[str] = None
+    subject_relation: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Precondition:
+    filter: RelationshipFilter
+    must_exist: bool  # False => must NOT exist
+
+
+@dataclass(frozen=True)
+class WriteOp:
+    op: str  # create | touch | delete
+    rel: Relationship
+
+
+@dataclass
+class WatchRecord:
+    revision: int
+    op: int  # OP_TOUCH (covers create) | OP_DELETE
+    rel: Relationship
+
+
+@dataclass
+class Snapshot:
+    """Immutable view handed to the device compiler."""
+
+    revision: int
+    cols: Columns
+    types: Interner
+    relations: Interner
+    objects: dict[int, Interner]  # type id -> per-type object interner
+
+
+class Store:
+    """Thread-safe mutable relationship store."""
+
+    # Per-type object interners reserve index 0 for "void" (unknown ids at
+    # query time) and 1 for the wildcard object '*'.
+    RESERVED_OBJECTS = ("\x00void", "*")
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.types = Interner()
+        # relation id 0 reserved for "no subject relation"
+        self.relations = Interner(reserved=("",))
+        self.objects: dict[int, Interner] = {}
+        self._chunks: list[Columns] = []
+        self._alive: list[np.ndarray] = []  # bool per chunk
+        self._index: Optional[dict[tuple, tuple[int, int]]] = None
+        self.revision = 0
+        self._watch_log: list[WatchRecord] = []
+
+    # -- interning helpers -------------------------------------------------
+
+    def _obj_interner(self, type_id: int) -> Interner:
+        it = self.objects.get(type_id)
+        if it is None:
+            it = Interner(reserved=self.RESERVED_OBJECTS)
+            self.objects[type_id] = it
+        return it
+
+    def _intern_rel(self, rel: Relationship) -> tuple:
+        rt = self.types.intern(rel.resource_type)
+        st = self.types.intern(rel.subject_type)
+        return (
+            rt,
+            self._obj_interner(rt).intern(rel.resource_id),
+            self.relations.intern(rel.relation),
+            st,
+            self._obj_interner(st).intern(rel.subject_id),
+            self.relations.intern(rel.subject_relation or ""),
+        )
+
+    def _extern_rel(self, key: tuple, exp: float) -> Relationship:
+        rt, rid, rl, st, sid, srl = key
+        return Relationship(
+            self.types.string(rt),
+            self.objects[rt].string(rid),
+            self.relations.string(rl),
+            self.types.string(st),
+            self.objects[st].string(sid),
+            self.relations.string(srl) or None,
+            None if not np.isfinite(exp) else float(exp),
+        )
+
+    # -- index -------------------------------------------------------------
+
+    def _ensure_index(self) -> dict:
+        if self._index is None:
+            idx: dict[tuple, tuple[int, int]] = {}
+            for ci, (cols, alive) in enumerate(zip(self._chunks, self._alive)):
+                live_rows = np.flatnonzero(alive)
+                keys = np.stack(
+                    [cols.rt, cols.rid, cols.rl, cols.st, cols.sid, cols.srl],
+                    axis=1,
+                )
+                for ri in live_rows.tolist():
+                    idx[tuple(keys[ri].tolist())] = (ci, ri)
+            self._index = idx
+        return self._index
+
+    def _append_rows(self, cols: Columns, update_index: bool) -> None:
+        ci = len(self._chunks)
+        self._chunks.append(cols)
+        self._alive.append(np.ones(len(cols), dtype=bool))
+        if update_index and self._index is not None:
+            keys = np.stack(
+                [cols.rt, cols.rid, cols.rl, cols.st, cols.sid, cols.srl], axis=1
+            )
+            for ri in range(len(cols)):
+                self._index[tuple(keys[ri].tolist())] = (ci, ri)
+        elif not update_index:
+            self._index = None
+
+    # -- filter matching ---------------------------------------------------
+
+    def _filter_mask(self, cols: Columns, f: RelationshipFilter,
+                     now: Optional[float] = None) -> np.ndarray:
+        mask = np.ones(len(cols), dtype=bool)
+
+        def match_str(interner: Interner, col: np.ndarray, value: Optional[str]):
+            nonlocal mask
+            if value is None:
+                return
+            i = interner.lookup(value)
+            if i is None:
+                mask &= False
+            else:
+                mask &= col == i
+
+        match_str(self.types, cols.rt, f.resource_type)
+        match_str(self.relations, cols.rl, f.relation)
+        match_str(self.types, cols.st, f.subject_type)
+        if f.resource_id is not None or f.subject_id is not None or \
+           f.subject_relation is not None:
+            # object ids live in per-type interners; resolve per present type
+            if f.resource_id is not None:
+                ok = np.zeros(len(cols), dtype=bool)
+                for tid in np.unique(cols.rt[mask]).tolist():
+                    oi = self.objects.get(tid)
+                    v = oi.lookup(f.resource_id) if oi else None
+                    if v is not None:
+                        ok |= (cols.rt == tid) & (cols.rid == v)
+                mask &= ok
+            if f.subject_id is not None:
+                ok = np.zeros(len(cols), dtype=bool)
+                for tid in np.unique(cols.st[mask]).tolist():
+                    oi = self.objects.get(tid)
+                    v = oi.lookup(f.subject_id) if oi else None
+                    if v is not None:
+                        ok |= (cols.st == tid) & (cols.sid == v)
+                mask &= ok
+            if f.subject_relation is not None:
+                i = self.relations.lookup(f.subject_relation)
+                mask &= (cols.srl == i) if i is not None else False
+        if now is not None:
+            mask &= cols.exp > now
+        return mask
+
+    # -- public API --------------------------------------------------------
+
+    def write(self, ops: list[WriteOp],
+              preconditions: list[Precondition] = ()) -> int:
+        """Apply a write transaction; returns the new revision.
+
+        CREATE errors on an existing live tuple (SpiceDB AlreadyExists);
+        TOUCH upserts (refreshing expiration); DELETE is idempotent — the
+        reference's rollback inverts CREATE/TOUCH into DELETE and retries
+        until success (workflow.go:86-129), which requires idempotency.
+        """
+        with self._lock:
+            now = time.time()
+            for pc in preconditions:
+                if self.exists(pc.filter, _now=now) != pc.must_exist:
+                    raise PreconditionFailed(
+                        f"precondition {'exists' if pc.must_exist else 'does not exist'} "
+                        f"failed for {pc.filter}"
+                    )
+            idx = self._ensure_index()
+
+            # Pass 1 — plan + validate before any mutation so the whole
+            # batch is atomic: an AlreadyExists mid-batch must not leave
+            # earlier ops half-applied. Like SpiceDB, duplicate updates for
+            # the same tuple within one write are rejected, so the plan is
+            # order-free.
+            seen: set[tuple] = set()
+            plan: list[tuple[int, tuple, float]] = []
+            for wop in ops:
+                code = _OPS[wop.op]
+                key = self._intern_rel(wop.rel)
+                exp = wop.rel.expiration if wop.rel.expiration is not None \
+                    else NO_EXPIRATION
+                if key in seen:
+                    raise StoreError(
+                        f"duplicate update for relationship in one write: {wop.rel}"
+                    )
+                seen.add(key)
+                pos = idx.get(key)
+                live = pos is not None and bool(
+                    self._chunks[pos[0]].exp[pos[1]] > now
+                )
+                if code == OP_CREATE and live:
+                    raise AlreadyExists(f"relationship already exists: {wop.rel}")
+                if code == OP_DELETE:
+                    if pos is not None:  # tombstone even expired rows
+                        plan.append((OP_DELETE, key, NO_EXPIRATION))
+                    continue
+                plan.append((OP_TOUCH, key, float(exp)))
+
+            if not plan:
+                return self.revision
+
+            # Pass 2 — apply.
+            rev = self.revision + 1
+            new_rows: list[tuple[tuple, float]] = []
+            for code, key, exp in plan:
+                pos = idx.get(key)
+                if pos is not None and self._alive[pos[0]][pos[1]]:
+                    self._alive[pos[0]][pos[1]] = False
+                    del idx[key]
+                if code == OP_DELETE:
+                    self._watch_log.append(
+                        WatchRecord(rev, OP_DELETE,
+                                    self._extern_rel(key, NO_EXPIRATION)))
+                    continue
+                new_rows.append((key, exp))
+                self._watch_log.append(
+                    WatchRecord(rev, OP_TOUCH, self._extern_rel(key, exp)))
+            if new_rows:
+                keys = np.array([k for k, _ in new_rows], dtype=np.int32)
+                cols = Columns(
+                    keys[:, 0].copy(), keys[:, 1].copy(), keys[:, 2].copy(),
+                    keys[:, 3].copy(), keys[:, 4].copy(), keys[:, 5].copy(),
+                    np.array([e for _, e in new_rows], dtype=np.float64),
+                )
+                self._append_rows(cols, update_index=True)
+            self.revision = rev
+            return rev
+
+    def bulk_load(self, rels_cols: dict) -> int:
+        """Fast path for large graph loads (bench setup): columnar string
+        arrays {resource_type, resource_id, relation, subject_type,
+        subject_id, subject_relation?, expiration?}. Rows are assumed
+        deduplicated. Not logged to watch."""
+        with self._lock:
+            n = len(rels_cols["resource_id"])
+
+            def intern_typed(type_col, id_col):
+                tids = self.types.intern_many(type_col)
+                out = np.empty(n, dtype=np.int32)
+                for tid in np.unique(tids).tolist():
+                    sel = tids == tid
+                    out[sel] = self._obj_interner(int(tid)).intern_many(
+                        [id_col[i] for i in np.flatnonzero(sel).tolist()]
+                    )
+                return tids, out
+
+            rt, rid = intern_typed(rels_cols["resource_type"],
+                                   rels_cols["resource_id"])
+            st, sid = intern_typed(rels_cols["subject_type"],
+                                   rels_cols["subject_id"])
+            rl = self.relations.intern_many(rels_cols["relation"])
+            srl_col = rels_cols.get("subject_relation")
+            srl = (self.relations.intern_many(srl_col) if srl_col is not None
+                   else np.zeros(n, dtype=np.int32))
+            exp_col = rels_cols.get("expiration")
+            exp = (np.asarray(exp_col, dtype=np.float64) if exp_col is not None
+                   else np.full(n, NO_EXPIRATION))
+            exp = np.where(np.isnan(exp), NO_EXPIRATION, exp)
+            self._append_rows(
+                Columns(rt, rid, rl, st, sid, srl, exp), update_index=False
+            )
+            self.revision += 1
+            return self.revision
+
+    def read(self, f: RelationshipFilter, now: Optional[float] = None
+             ) -> Iterator[Relationship]:
+        """ReadRelationships: stream live, unexpired tuples matching filter."""
+        with self._lock:
+            if now is None:
+                now = time.time()
+            for cols, alive in zip(self._chunks, self._alive):
+                mask = self._filter_mask(cols, f, now=now) & alive
+                for ri in np.flatnonzero(mask).tolist():
+                    key = (int(cols.rt[ri]), int(cols.rid[ri]), int(cols.rl[ri]),
+                           int(cols.st[ri]), int(cols.sid[ri]), int(cols.srl[ri]))
+                    yield self._extern_rel(key, cols.exp[ri])
+
+    def exists(self, f: RelationshipFilter, _now: Optional[float] = None) -> bool:
+        with self._lock:
+            now = _now if _now is not None else time.time()
+            for cols, alive in zip(self._chunks, self._alive):
+                if np.any(self._filter_mask(cols, f, now=now) & alive):
+                    return True
+            return False
+
+    def delete_by_filter(self, f: RelationshipFilter,
+                         preconditions: list[Precondition] = ()) -> int:
+        """DeleteRelationships: delete all matching tuples; returns count.
+        Preconditions are checked under the same lock acquisition as the
+        delete so they cannot be invalidated in between."""
+        with self._lock:
+            now = time.time()
+            for pc in preconditions:
+                if self.exists(pc.filter, _now=now) != pc.must_exist:
+                    raise PreconditionFailed(
+                        f"precondition "
+                        f"{'exists' if pc.must_exist else 'does not exist'} "
+                        f"failed for {pc.filter}"
+                    )
+            count = 0
+            rev = self.revision + 1
+            for cols, alive in zip(self._chunks, self._alive):
+                mask = self._filter_mask(cols, f, now=now) & alive
+                rows = np.flatnonzero(mask)
+                if len(rows) == 0:
+                    continue
+                alive[rows] = False
+                count += len(rows)
+                for ri in rows.tolist():
+                    key = (int(cols.rt[ri]), int(cols.rid[ri]), int(cols.rl[ri]),
+                           int(cols.st[ri]), int(cols.sid[ri]), int(cols.srl[ri]))
+                    if self._index is not None:
+                        self._index.pop(key, None)
+                    self._watch_log.append(
+                        WatchRecord(rev, OP_DELETE,
+                                    self._extern_rel(key, NO_EXPIRATION)))
+            if count:
+                self.revision = rev
+            return count
+
+    def watch_since(self, revision: int) -> list[WatchRecord]:
+        """Watch events with revision > the given revision."""
+        with self._lock:
+            return [r for r in self._watch_log if r.revision > revision]
+
+    def snapshot(self) -> Snapshot:
+        """Immutable columnar view of all live tuples for the compiler.
+
+        Expired tuples are retained (with their timestamps) — the device
+        kernel masks them against the query-time clock, mirroring SpiceDB's
+        read-time expiration filtering."""
+        with self._lock:
+            blocks = [
+                cols.take(np.flatnonzero(alive))
+                for cols, alive in zip(self._chunks, self._alive)
+                if np.any(alive)
+            ]
+            # NOTE: interners are monotone (never shrink / renumber), so
+            # sharing them with an immutable snapshot is safe.
+            return Snapshot(
+                revision=self.revision,
+                cols=Columns.concat(blocks),
+                types=self.types,
+                relations=self.relations,
+                objects=self.objects,
+            )
+
+    def __len__(self) -> int:
+        return int(sum(int(a.sum()) for a in self._alive))

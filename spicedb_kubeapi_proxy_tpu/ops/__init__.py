@@ -1,0 +1,3 @@
+"""JAX/XLA kernels for batched relationship-graph reachability."""
+
+from .reachability import CompiledGraph, compile_graph  # noqa: F401

@@ -1,0 +1,521 @@
+"""Slot-space reachability: the TPU execution backend for permission checks.
+
+This is the "native tier" the north star mandates (BASELINE.json): what the
+reference delegates to SpiceDB's recursive graph dispatcher (CheckPermission
+/ CheckBulkPermissions / LookupResources — reference pkg/authz/check.go:41-48,
+pkg/authz/lookups.go:49-65) is compiled here into a fixed-shape, jit-friendly
+fixpoint over a flat boolean state vector.
+
+Design
+------
+Every ``(definition, relation-or-permission, object)`` triple is interned
+into one flat "slot" index. The whole evaluation state is a single uint8
+tensor ``V[M, B]`` (M = total slots, B = batch of subjects). Three kinds of
+graph structure all become the SAME uniform edge form ``dst <- src``:
+
+- direct relation tuples   ``pod:x#viewer@user:alice``
+      src = slot(user, __self, alice),   dst = slot(pod, viewer, x)
+- userset tuples           ``pod:x#viewer@group:eng#member``
+      src = slot(group, member, eng),    dst = slot(pod, viewer, x)
+- arrow terms              ``permission view = namespace->view`` over tuple
+  ``pod:x#namespace@namespace:ns``
+      src = slot(namespace, view, ns),   dst = slot(pod, __arrow_k, x)
+
+Wildcard subjects (``user:*``) fall out for free: the wildcard object is
+interned at index 1 of every type, and every query seeds both its concrete
+subject slot and its type's wildcard slot.
+
+One propagation step is then a gather + segment-max (boolean OR) over the
+edge array, followed by a static elementwise program that recomputes every
+permission slot range from its userset-rewrite expression (union ``|``,
+intersection ``&``, exclusion ``& ^1``, nil ``0``). The full evaluation is
+``V_{t+1} = elementwise(base | propagate(V_t))`` iterated to fixpoint in a
+``lax.while_loop`` — monotone in the graph, so it converges in at most
+graph-diameter steps; exclusion/intersection are re-evaluated every step so
+userset rewrites keep exact semantics under vectorization (SURVEY.md §7
+"hard parts" (a)). Relationship expiration is a per-edge timestamp mask
+applied at query time.
+
+Checks read single slots; LookupResources reads a slot range. Both are
+encoded host-side as int32 slot indices, so the device computation has
+fixed shapes (§7 hard part (b)): E, M, B, Q are bucket-padded and jit
+re-specializes only when a bucket grows.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.schema import (
+    Arrow,
+    Exclude,
+    Expr,
+    Intersect,
+    Nil,
+    RelationRef,
+    Schema,
+    Union,
+)
+from ..engine.store import Snapshot
+
+SELF_REL = "__self"
+VOID_IDX = 0  # reserved per-type object index for unknown ids
+WILDCARD_IDX = 1  # reserved per-type object index for '*'
+
+DEFAULT_MAX_ITERS = 128
+
+
+class ConvergenceError(RuntimeError):
+    """The fixpoint hit its iteration budget before converging — the analog
+    of SpiceDB's dispatch-depth error (embedded depth 50, reference
+    pkg/spicedb/spicedb.go:33). Raised instead of silently denying."""
+
+
+def _next_bucket(n: int, minimum: int = 8) -> int:
+    """Pad sizes to power-of-two buckets to bound jit re-specialization."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _PermProgram:
+    """One permission's elementwise recompute: (dst_offset, size, expr),
+    with expression leaves resolved to slot offsets."""
+
+    dst_off: int
+    size: int
+    expr: Expr
+    # leaf name -> slot offset (RelationRef name or Arrow term id)
+    leaf_off: dict
+
+
+@dataclass
+class CompiledGraph:
+    """An immutable device-ready compilation of (schema, snapshot)."""
+
+    schema: Schema
+    revision: int
+    base_time: float
+    M: int  # real slots (M is also the trash slot index; arrays sized M+1)
+    slot_offset: dict  # (type_name, rel_name) -> offset
+    type_sizes: dict  # type_name -> object count (incl. void/wildcard)
+    # host edge arrays, sorted by dst, padded to bucket; pad rows point at
+    # the trash slot with -inf expiration (never valid)
+    src: np.ndarray
+    dst: np.ndarray
+    exp_rel: np.ndarray  # float32 seconds relative to base_time; +inf = never
+    n_edges: int
+    programs: list  # topo-ordered _PermProgram list
+    # lazily-populated device state
+    _device: dict = field(default_factory=dict)
+
+    # -- host-side encoding ------------------------------------------------
+
+    def offset_of(self, type_name: str, rel_name: str) -> Optional[int]:
+        return self.slot_offset.get((type_name, rel_name))
+
+    def encode_subject(self, type_name: str, obj_id: str,
+                       subject_relation: Optional[str] = None,
+                       objects=None) -> tuple[int, int]:
+        """-> (subject_seed_slot, wildcard_seed_slot); trash slot when
+        unknown so unknown subjects simply seed nothing."""
+        trash = self.M
+        if subject_relation:
+            off = self.offset_of(type_name, subject_relation)
+            # wildcards match only concrete subjects (oracle: a userset
+            # subject query never matches a `type:*` tuple), so userset
+            # subjects must not seed the wildcard slot
+            wc_off = None
+        else:
+            off = self.offset_of(type_name, SELF_REL)
+            wc_off = off
+        if off is None:
+            return trash, trash
+        idx = self._obj_index(type_name, obj_id, objects)
+        seed = off + idx if idx is not None else trash
+        wc = wc_off + WILDCARD_IDX if wc_off is not None else trash
+        return seed, wc
+
+    def encode_target(self, type_name: str, permission: str, obj_id: str,
+                      objects=None) -> int:
+        """Slot to read a check result from; trash slot (always 0) when the
+        type/permission/object is unknown."""
+        off = self.offset_of(type_name, permission)
+        if off is None:
+            return self.M
+        idx = self._obj_index(type_name, obj_id, objects)
+        return off + idx if idx is not None else off + VOID_IDX
+
+    def _obj_index(self, type_name: str, obj_id: str, objects) -> Optional[int]:
+        if objects is None:
+            return None
+        it = objects.get(type_name)
+        if it is None:
+            return None
+        i = it.lookup(obj_id)
+        # ids interned after this snapshot was compiled have no edges; void
+        # behaves identically (no edges) and keeps indices in range.
+        if i is None or i >= self.type_sizes.get(type_name, 0):
+            return VOID_IDX
+        return i
+
+    # -- device execution --------------------------------------------------
+
+    def _dev(self):
+        d = self._device
+        if not d:
+            d["src"] = jnp.asarray(self.src)
+            d["dst"] = jnp.asarray(self.dst)
+            d["exp"] = jnp.asarray(self.exp_rel)
+            d["run"] = jax.jit(
+                partial(_run, self), static_argnames=("max_iters",)
+            )
+        return d
+
+    def query(
+        self,
+        seed_slots: np.ndarray,  # int32 [B, 2] (subject slot, wildcard slot)
+        q_slots: np.ndarray,  # int32 [Q]
+        q_batch: np.ndarray,  # int32 [Q] batch row per query
+        now: Optional[float] = None,
+        max_iters: int = DEFAULT_MAX_ITERS,
+    ) -> np.ndarray:
+        """Run the fixpoint; returns bool [Q]."""
+        d = self._dev()
+        B = seed_slots.shape[0]
+        Q = len(q_slots)
+        B_pad = _next_bucket(B, 1)
+        Q_pad = _next_bucket(Q, 8)
+        seeds = np.full((B_pad, 2), self.M, dtype=np.int32)
+        seeds[:B] = seed_slots
+        qs = np.full(Q_pad, self.M, dtype=np.int32)
+        qs[:Q] = q_slots
+        qb = np.zeros(Q_pad, dtype=np.int32)
+        qb[:Q] = q_batch
+        now_rel = np.float32((time.time() if now is None else now) - self.base_time)
+        out, converged = d["run"](
+            d["src"], d["dst"], d["exp"],
+            jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
+            now_rel, max_iters=max_iters,
+        )
+        if not bool(converged):
+            raise ConvergenceError(
+                f"reachability did not converge within {max_iters} iterations "
+                "(graph deeper than the dispatch budget)"
+            )
+        return np.asarray(out)[:Q]
+
+
+def _apply_program(cg: CompiledGraph, V):
+    """Recompute every permission slot range from its expression (static
+    slices; offsets are compile-time constants)."""
+
+    def ev(expr: Expr, p: _PermProgram):
+        if isinstance(expr, Nil):
+            return jnp.zeros((p.size,) + V.shape[1:], dtype=V.dtype)
+        if isinstance(expr, (RelationRef, Arrow)):
+            off = p.leaf_off[expr]
+            return jax.lax.dynamic_slice_in_dim(V, off, p.size, axis=0)
+        if isinstance(expr, Union):
+            out = ev(expr.operands[0], p)
+            for e in expr.operands[1:]:
+                out = out | ev(e, p)
+            return out
+        if isinstance(expr, Intersect):
+            out = ev(expr.operands[0], p)
+            for e in expr.operands[1:]:
+                out = out & ev(e, p)
+            return out
+        if isinstance(expr, Exclude):
+            return ev(expr.base, p) & (ev(expr.subtract, p) ^ 1)
+        raise TypeError(f"unknown expr {expr!r}")
+
+    for p in cg.programs:
+        V = jax.lax.dynamic_update_slice_in_dim(V, ev(p.expr, p), p.dst_off, axis=0)
+    return V
+
+
+def _run(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots, q_batch,
+         now_rel, *, max_iters: int):
+    """The jitted fixpoint. V layout: [M+1, B] uint8 (slot-major so the
+    segment reduction runs over the leading axis)."""
+    B = seeds.shape[0]
+    Mp1 = cg.M + 1
+    valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E]
+
+    brange = jnp.arange(B, dtype=jnp.int32)
+    base = jnp.zeros((Mp1, B), dtype=jnp.uint8)
+    base = base.at[seeds[:, 0], brange].max(1)
+    base = base.at[seeds[:, 1], brange].max(1)
+    # the trash slot must stay 0: unknown subjects seed nothing
+    base = base.at[cg.M].set(0)
+    base = _apply_program(cg, base)
+
+    def step(V):
+        gathered = V[src] & valid[:, None]  # [E, B]
+        prop = jax.ops.segment_max(
+            gathered, dst, num_segments=Mp1, indices_are_sorted=True
+        )
+        return _apply_program(cg, prop | base)
+
+    def cond(state):
+        V, prev_changed, it = state
+        return prev_changed & (it < max_iters)
+
+    def body(state):
+        V, _, it = state
+        V2 = step(V)
+        return V2, jnp.any(V2 != V), it + 1
+
+    V0 = base
+    V, still_changing, _ = jax.lax.while_loop(cond, body, (V0, jnp.bool_(True), 0))
+    # still_changing at loop exit means we hit max_iters before convergence;
+    # surface it so the host can raise instead of silently denying
+    return V[q_slots, q_batch].astype(jnp.bool_), jnp.logical_not(still_changing)
+
+
+# ---------------------------------------------------------------------------
+# Compilation: (schema, snapshot) -> CompiledGraph
+# ---------------------------------------------------------------------------
+
+
+def _topo_permissions(defn) -> list[str]:
+    """Topologically order a definition's permissions by their intra-type
+    RelationRef dependencies (cross-type and cyclic deps are resolved by the
+    outer fixpoint; within a pass we just avoid reading an obviously stale
+    sibling where possible)."""
+    deps: dict[str, set] = {}
+    for name, perm in defn.permissions.items():
+        refs = set()
+
+        def walk(e):
+            if isinstance(e, RelationRef) and e.name in defn.permissions:
+                refs.add(e.name)
+            elif isinstance(e, (Union, Intersect)):
+                for o in e.operands:
+                    walk(o)
+            elif isinstance(e, Exclude):
+                walk(e.base)
+                walk(e.subtract)
+
+        walk(perm.expr)
+        deps[name] = refs
+    out: list[str] = []
+    seen: set = set()
+
+    def visit(n, path):
+        if n in seen or n in path:
+            return
+        for d in sorted(deps[n]):
+            visit(d, path | {n})
+        seen.add(n)
+        out.append(n)
+
+    for n in sorted(deps):
+        visit(n, set())
+    return out
+
+
+def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
+    """Compile a store snapshot into device-ready slot-space form.
+
+    Everything here is vectorized numpy over the snapshot's columnar arrays
+    — no per-relationship Python loops — so 10M-edge graphs compile in
+    seconds on the host.
+    """
+    types_in = snapshot.types
+    rels_in = snapshot.relations
+    cols = snapshot.cols
+
+    # ---- slot layout ----
+    slot_offset: dict[tuple, int] = {}
+    type_sizes: dict[str, int] = {}
+    arrow_terms: dict[tuple, list[Arrow]] = {}  # (type, perm) -> arrows in order
+    off = 0
+    for tname in sorted(schema.definitions):
+        d = schema.definitions[tname]
+        tid = types_in.lookup(tname)
+        n = len(snapshot.objects[tid]) if tid is not None and tid in snapshot.objects \
+            else 2
+        n = max(n, 2)
+        type_sizes[tname] = n
+        slot_offset[(tname, SELF_REL)] = off
+        off += n
+        for rname in sorted(d.relations):
+            slot_offset[(tname, rname)] = off
+            off += n
+        for pname in sorted(d.permissions):
+            arrows: list[Arrow] = []
+
+            def collect(e):
+                if isinstance(e, Arrow):
+                    arrows.append(e)
+                elif isinstance(e, (Union, Intersect)):
+                    for o in e.operands:
+                        collect(o)
+                elif isinstance(e, Exclude):
+                    collect(e.base)
+                    collect(e.subtract)
+
+            collect(d.permissions[pname].expr)
+            arrow_terms[(tname, pname)] = arrows
+            for k in range(len(arrows)):
+                slot_offset[(tname, f"__arrow_{pname}_{k}")] = off
+                off += n
+        for pname in sorted(d.permissions):
+            slot_offset[(tname, pname)] = off
+            off += n
+    M = off
+
+    # ---- store-id -> offset lookup tables ----
+    n_st = len(types_in)
+    n_sr = len(rels_in)
+    self_off = np.full(n_st + 1, -1, dtype=np.int64)
+    rel_off = np.full((n_st + 1, n_sr + 1), -1, dtype=np.int64)  # writable rels
+    relperm_off = np.full((n_st + 1, n_sr + 1), -1, dtype=np.int64)
+    for tname, d in schema.definitions.items():
+        tid = types_in.lookup(tname)
+        if tid is None:
+            continue
+        self_off[tid] = slot_offset[(tname, SELF_REL)]
+        for rname in d.relations:
+            rid = rels_in.lookup(rname)
+            if rid is not None:
+                rel_off[tid, rid] = slot_offset[(tname, rname)]
+                relperm_off[tid, rid] = slot_offset[(tname, rname)]
+        for pname in d.permissions:
+            rid = rels_in.lookup(pname)
+            if rid is not None:
+                relperm_off[tid, rid] = slot_offset[(tname, pname)]
+
+    # ---- edges ----
+    srcs: list[np.ndarray] = []
+    dsts: list[np.ndarray] = []
+    exps: list[np.ndarray] = []
+    base_time = time.time()
+    exp_rel_all = (cols.exp - base_time).astype(np.float32)
+
+    rt = cols.rt.astype(np.int64)
+    st = cols.st.astype(np.int64)
+    rl = cols.rl.astype(np.int64)
+    srl = cols.srl.astype(np.int64)
+
+    dst_all = rel_off[rt, rl] + cols.rid  # -1-based stays negative
+    dst_valid = rel_off[rt, rl] >= 0
+
+    # direct tuples (includes wildcard subjects: wildcard object index is 1)
+    m = (srl == 0) & dst_valid & (self_off[st] >= 0)
+    srcs.append(self_off[st[m]] + cols.sid[m])
+    dsts.append(dst_all[m])
+    exps.append(exp_rel_all[m])
+
+    # userset tuples: src is the subject's (type, relation|permission) slot
+    us_off = relperm_off[st, srl]
+    m = (srl != 0) & dst_valid & (us_off >= 0) & (cols.sid != WILDCARD_IDX)
+    srcs.append(us_off[m] + cols.sid[m])
+    dsts.append(dst_all[m])
+    exps.append(exp_rel_all[m])
+
+    # arrow term edges
+    for (tname, pname), arrows in arrow_terms.items():
+        if not arrows:
+            continue
+        tid = types_in.lookup(tname)
+        if tid is None:
+            continue
+        for k, a in enumerate(arrows):
+            ts_id = rels_in.lookup(a.tupleset)
+            if ts_id is None:
+                continue
+            term_off = slot_offset[(tname, f"__arrow_{pname}_{k}")]
+            # per-subject-type offset of the arrow target
+            tgt_off = np.full(n_st + 1, -1, dtype=np.int64)
+            d = schema.definitions[tname]
+            for asub in d.relations[a.tupleset].allowed:
+                if asub.relation:
+                    continue  # arrows walk concrete subjects only
+                sub_tid = types_in.lookup(asub.type)
+                if sub_tid is None:
+                    continue
+                if schema.definitions[asub.type].relation_or_permission(a.target):
+                    tgt_off[sub_tid] = slot_offset[(asub.type, a.target)]
+            m = (
+                (rt == tid) & (rl == ts_id) & (srl == 0)
+                & (tgt_off[st] >= 0) & (cols.sid != WILDCARD_IDX)
+            )
+            srcs.append(tgt_off[st[m]] + cols.sid[m])
+            dsts.append(term_off + cols.rid[m])
+            exps.append(exp_rel_all[m])
+
+    src = np.concatenate(srcs) if srcs else np.empty(0, dtype=np.int64)
+    dst = np.concatenate(dsts) if dsts else np.empty(0, dtype=np.int64)
+    exp = np.concatenate(exps) if exps else np.empty(0, dtype=np.float32)
+
+    order = np.argsort(dst, kind="stable")
+    src, dst, exp = src[order], dst[order], exp[order]
+
+    n_edges = len(src)
+    E_pad = _next_bucket(max(n_edges, 1))
+    src_p = np.full(E_pad, M, dtype=np.int32)
+    dst_p = np.full(E_pad, M, dtype=np.int32)
+    exp_p = np.full(E_pad, -np.inf, dtype=np.float32)
+    src_p[:n_edges] = src
+    dst_p[:n_edges] = dst
+    exp_p[:n_edges] = exp
+
+    # ---- elementwise programs ----
+    programs: list[_PermProgram] = []
+    for tname in sorted(schema.definitions):
+        d = schema.definitions[tname]
+        n = type_sizes[tname]
+        for pname in _topo_permissions(d):
+            arrows = arrow_terms[(tname, pname)]
+            leaf_off: dict = {}
+            arrow_seen = 0
+
+            def resolve(e):
+                nonlocal arrow_seen
+                if isinstance(e, RelationRef):
+                    leaf_off[e] = slot_offset[(tname, e.name)]
+                elif isinstance(e, Arrow):
+                    # nth arrow occurrence maps to its own term range
+                    leaf_off[e] = slot_offset[
+                        (tname, f"__arrow_{pname}_{arrow_seen}")
+                    ]
+                    arrow_seen += 1
+                elif isinstance(e, (Union, Intersect)):
+                    for o in e.operands:
+                        resolve(o)
+                elif isinstance(e, Exclude):
+                    resolve(e.base)
+                    resolve(e.subtract)
+
+            expr = d.permissions[pname].expr
+            resolve(expr)
+            programs.append(
+                _PermProgram(slot_offset[(tname, pname)], n, expr, leaf_off)
+            )
+
+    return CompiledGraph(
+        schema=schema,
+        revision=snapshot.revision,
+        base_time=base_time,
+        M=M,
+        slot_offset=slot_offset,
+        type_sizes=type_sizes,
+        src=src_p,
+        dst=dst_p,
+        exp_rel=exp_p,
+        n_edges=n_edges,
+        programs=programs,
+    )

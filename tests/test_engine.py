@@ -1,0 +1,547 @@
+"""Engine core tests: store semantics, oracle, and TPU-path equivalence.
+
+The key gate: the jitted slot-space fixpoint (ops/reachability.py) must
+agree with the recursive oracle evaluator on every (object, permission,
+subject) combination, across schema features and randomized graphs.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.engine import (
+    CheckItem,
+    Engine,
+    Precondition,
+    PreconditionFailed,
+    RelationshipFilter,
+    Store,
+    WriteOp,
+)
+from spicedb_kubeapi_proxy_tpu.engine.store import AlreadyExists
+from spicedb_kubeapi_proxy_tpu.engine.engine import SchemaViolation
+from spicedb_kubeapi_proxy_tpu.models import parse_schema
+from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship, parse_relationship
+
+
+def rel(s: str) -> Relationship:
+    return parse_relationship(s)
+
+
+def touch(*rels: str) -> list[WriteOp]:
+    return [WriteOp("touch", rel(r)) for r in rels]
+
+
+# ---------------------------------------------------------------------------
+# Store semantics
+# ---------------------------------------------------------------------------
+
+
+def test_store_create_touch_delete():
+    s = Store()
+    s.write([WriteOp("create", rel("ns:a#viewer@user:alice"))])
+    with pytest.raises(AlreadyExists):
+        s.write([WriteOp("create", rel("ns:a#viewer@user:alice"))])
+    # touch is an upsert
+    s.write([WriteOp("touch", rel("ns:a#viewer@user:alice"))])
+    assert len(s) == 1
+    # delete is idempotent
+    s.write([WriteOp("delete", rel("ns:a#viewer@user:alice"))])
+    s.write([WriteOp("delete", rel("ns:a#viewer@user:alice"))])
+    assert len(s) == 0
+
+
+def test_store_preconditions():
+    s = Store()
+    s.write(touch("ns:a#viewer@user:alice"))
+    # must-not-exist fails when it exists
+    with pytest.raises(PreconditionFailed):
+        s.write(
+            touch("ns:b#viewer@user:bob"),
+            [Precondition(RelationshipFilter("ns", "a", "viewer"), must_exist=False)],
+        )
+    # must-exist passes
+    s.write(
+        touch("ns:b#viewer@user:bob"),
+        [Precondition(RelationshipFilter("ns", "a", "viewer"), must_exist=True)],
+    )
+    assert len(s) == 2
+    # filter with subject fields
+    assert s.exists(RelationshipFilter(subject_type="user", subject_id="bob"))
+    assert not s.exists(RelationshipFilter(subject_type="user", subject_id="carol"))
+
+
+def test_store_read_and_delete_by_filter():
+    s = Store()
+    s.write(touch(
+        "pod:ns1/a#viewer@user:alice",
+        "pod:ns1/b#viewer@user:alice",
+        "pod:ns2/c#viewer@user:bob",
+        "ns:ns1#viewer@user:alice",
+    ))
+    got = {str(r) for r in s.read(RelationshipFilter(resource_type="pod"))}
+    assert got == {
+        "pod:ns1/a#viewer@user:alice",
+        "pod:ns1/b#viewer@user:alice",
+        "pod:ns2/c#viewer@user:bob",
+    }
+    n = s.delete_by_filter(
+        RelationshipFilter(resource_type="pod", subject_type="user",
+                           subject_id="alice"))
+    assert n == 2
+    assert len(s) == 2
+
+
+def test_store_expiration():
+    s = Store()
+    now = time.time()
+    s.write([
+        WriteOp("touch", Relationship("ns", "a", "viewer", "user", "x",
+                                      expiration=now - 10)),
+        WriteOp("touch", Relationship("ns", "b", "viewer", "user", "x",
+                                      expiration=now + 1000)),
+    ])
+    live = {r.resource_id for r in s.read(RelationshipFilter(resource_type="ns"))}
+    assert live == {"b"}
+    # an expired tuple does not block CREATE
+    s.write([WriteOp("create", Relationship("ns", "a", "viewer", "user", "x"))])
+
+
+def test_store_watch_log():
+    s = Store()
+    r0 = s.revision
+    s.write(touch("ns:a#viewer@user:alice"))
+    s.write([WriteOp("delete", rel("ns:a#viewer@user:alice"))])
+    recs = s.watch_since(r0)
+    assert [(r.op, str(r.rel)) for r in recs] == [
+        (2, "ns:a#viewer@user:alice"),
+        (3, "ns:a#viewer@user:alice"),
+    ]
+
+
+def test_store_bulk_load_and_snapshot():
+    s = Store()
+    n = 1000
+    s.bulk_load({
+        "resource_type": ["pod"] * n,
+        "resource_id": [f"p{i}" for i in range(n)],
+        "relation": ["viewer"] * n,
+        "subject_type": ["user"] * n,
+        "subject_id": [f"u{i % 7}" for i in range(n)],
+    })
+    assert len(s) == n
+    snap = s.snapshot()
+    assert len(snap.cols) == n
+    # single-row ops still work after bulk load (lazy index build)
+    s.write([WriteOp("delete", rel("pod:p0#viewer@user:u0"))])
+    assert len(s) == n - 1
+
+
+# ---------------------------------------------------------------------------
+# Engine write validation
+# ---------------------------------------------------------------------------
+
+SCHEMA = """
+use expiration
+
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition namespace {
+  relation creator: user
+  relation viewer: user | group#member | user:*
+  permission edit = creator
+  permission view = viewer + creator
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user with expiration
+  permission edit = creator
+  permission view = viewer + creator + namespace->view
+}
+"""
+
+
+def make_engine(*rels_: str) -> Engine:
+    e = Engine(schema=parse_schema(SCHEMA))
+    if rels_:
+        e.write_relationships(touch(*rels_))
+    return e
+
+
+def test_engine_write_validation():
+    e = make_engine()
+    with pytest.raises(SchemaViolation, match="no relation"):
+        e.write_relationships(touch("namespace:a#nope@user:x"))
+    with pytest.raises(SchemaViolation, match="not writable"):
+        e.write_relationships(touch("namespace:a#view@user:x"))
+    with pytest.raises(SchemaViolation, match="not allowed"):
+        e.write_relationships(touch("namespace:a#creator@group:g#member"))
+    with pytest.raises(SchemaViolation, match="unknown resource type"):
+        e.write_relationships(touch("zebra:a#creator@user:x"))
+    with pytest.raises(SchemaViolation, match="not allowed"):
+        e.write_relationships(touch("namespace:a#creator@user:*"))
+    with pytest.raises(SchemaViolation, match="expiring"):
+        e.write_relationships([WriteOp("touch", Relationship(
+            "namespace", "a", "creator", "user", "x",
+            expiration=time.time() + 60))])
+    # allowed cases
+    e.write_relationships(touch(
+        "namespace:a#viewer@user:*",
+        "namespace:a#viewer@group:g#member",
+        "pod:a/p#namespace@namespace:a",
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Oracle sanity (hand-computed expectations)
+# ---------------------------------------------------------------------------
+
+
+def test_oracle_basics():
+    e = make_engine(
+        "namespace:ns1#creator@user:alice",
+        "namespace:ns1#viewer@user:bob",
+        "pod:ns1/p1#namespace@namespace:ns1",
+        "pod:ns1/p1#creator@user:carol",
+    )
+    o = e.oracle()
+    assert o.check("namespace", "ns1", "view", "user", "alice")  # creator
+    assert o.check("namespace", "ns1", "view", "user", "bob")  # viewer
+    assert not o.check("namespace", "ns1", "edit", "user", "bob")
+    # arrow: pod view via namespace->view
+    assert o.check("pod", "ns1/p1", "view", "user", "alice")
+    assert o.check("pod", "ns1/p1", "view", "user", "bob")
+    assert o.check("pod", "ns1/p1", "view", "user", "carol")
+    assert not o.check("pod", "ns1/p1", "edit", "user", "bob")
+    assert o.lookup_resources("pod", "view", "user", "bob") == {"ns1/p1"}
+
+
+def test_oracle_nested_groups_and_wildcard():
+    e = make_engine(
+        "group:eng#member@user:dev1",
+        "group:all#member@group:eng#member",
+        "namespace:ns#viewer@group:all#member",
+        "namespace:open#viewer@user:*",
+    )
+    o = e.oracle()
+    assert o.check("namespace", "ns", "view", "user", "dev1")
+    assert not o.check("namespace", "ns", "view", "user", "outsider")
+    assert o.check("namespace", "open", "view", "user", "anyone")
+
+
+def test_oracle_cycle_terminates():
+    e = make_engine(
+        "group:a#member@group:b#member",
+        "group:b#member@group:a#member",
+        "group:b#member@user:u",
+        "namespace:ns#viewer@group:a#member",
+    )
+    o = e.oracle()
+    assert o.check("namespace", "ns", "view", "user", "u")
+    assert not o.check("namespace", "ns", "view", "user", "v")
+
+
+# ---------------------------------------------------------------------------
+# TPU path vs oracle equivalence
+# ---------------------------------------------------------------------------
+
+
+def assert_engine_matches_oracle(e: Engine, subjects=None):
+    """Exhaustively compare engine.check_bulk and lookup_resources against
+    the oracle for every (type, object, permission) x subject."""
+    o = e.oracle()
+    snap = e.store.snapshot()
+    if subjects is None:
+        uid = snap.types.lookup("user")
+        subjects = [
+            ("user", snap.objects[uid].string(i))
+            for i in range(2, len(snap.objects[uid]))
+        ] if uid is not None and uid in snap.objects else []
+        subjects.append(("user", "zz-unknown"))
+    items, expect = [], []
+    for tname, d in e.schema.definitions.items():
+        tid = snap.types.lookup(tname)
+        if tid is None or tid not in snap.objects:
+            continue
+        ids = [snap.objects[tid].string(i)
+               for i in range(2, len(snap.objects[tid]))]
+        for perm in list(d.permissions) + list(d.relations):
+            for oid in ids:
+                for st, sid in subjects:
+                    items.append(CheckItem(tname, oid, perm, st, sid))
+                    expect.append(o.check(tname, oid, perm, st, sid))
+    got = e.check_bulk(items)
+    bad = [
+        (items[i], expect[i], got[i])
+        for i in range(len(items)) if expect[i] != got[i]
+    ]
+    assert not bad, f"{len(bad)}/{len(items)} mismatches; first 5: {bad[:5]}"
+
+    # lookup_resources equivalence on permissions
+    for tname, d in e.schema.definitions.items():
+        for perm in d.permissions:
+            for st, sid in subjects:
+                got_ids = set(e.lookup_resources(tname, perm, st, sid))
+                want = o.lookup_resources(tname, perm, st, sid)
+                assert got_ids == want, (tname, perm, st, sid, got_ids, want)
+
+
+def test_tpu_matches_oracle_reference_style():
+    e = make_engine(
+        "namespace:ns1#creator@user:alice",
+        "namespace:ns1#viewer@user:bob",
+        "namespace:ns2#creator@user:bob",
+        "pod:ns1/p1#namespace@namespace:ns1",
+        "pod:ns1/p2#namespace@namespace:ns1",
+        "pod:ns2/q#namespace@namespace:ns2",
+        "pod:ns2/q#viewer@user:alice",
+        "pod:ns1/p1#creator@user:carol",
+        "group:eng#member@user:dev1",
+        "group:all#member@group:eng#member",
+        "namespace:ns2#viewer@group:all#member",
+        "namespace:open#viewer@user:*",
+    )
+    assert_engine_matches_oracle(e)
+
+
+INTERSECT_SCHEMA = """
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition doc {
+  relation owner: user
+  relation reader: user | group#member
+  relation banned: user
+  relation org: org
+  permission read = (reader + owner) - banned
+  permission audit = reader & owner
+  permission admin = org->admin
+  permission super = org->admin & owner
+}
+definition org {
+  relation admin: user
+  relation parent: org
+  permission all_admin = admin + parent->all_admin
+}
+"""
+
+
+def test_tpu_matches_oracle_intersect_exclude_arrows():
+    e = Engine(schema=parse_schema(INTERSECT_SCHEMA))
+    e.write_relationships(touch(
+        "doc:d1#owner@user:o",
+        "doc:d1#reader@user:r",
+        "doc:d1#reader@user:o",
+        "doc:d1#banned@user:o",
+        "doc:d2#reader@group:g#member",
+        "doc:d2#banned@user:m1",
+        "group:g#member@user:m1",
+        "group:g#member@user:m2",
+        "doc:d3#org@org:acme",
+        "org:acme#admin@user:boss",
+        "org:acme#parent@org:parent",
+        "org:parent#admin@user:grandboss",
+        "doc:d3#owner@user:boss",
+    ))
+    o = e.oracle()
+    # sanity: exclusion beats union
+    assert not o.check("doc", "d1", "read", "user", "o")
+    assert o.check("doc", "d1", "read", "user", "r")
+    assert o.check("doc", "d2", "read", "user", "m2")
+    assert not o.check("doc", "d2", "read", "user", "m1")
+    # multi-hop arrow recursion
+    assert o.check("org", "acme", "all_admin", "user", "grandboss")
+    assert o.check("doc", "d3", "admin", "user", "boss")
+    assert o.check("doc", "d3", "super", "user", "boss")
+    assert not o.check("doc", "d3", "super", "user", "grandboss")
+    assert_engine_matches_oracle(e)
+
+
+def test_tpu_deep_chain_10_hops():
+    # BASELINE config 4 shape: 10-hop org->team->user chains
+    chain = ["group:g%d#member@group:g%d#member" % (i, i + 1) for i in range(10)]
+    e = make_engine(
+        *chain,
+        "group:g10#member@user:deep",
+        "namespace:ns#viewer@group:g0#member",
+    )
+    o = e.oracle()
+    assert o.check("namespace", "ns", "view", "user", "deep")
+    assert_engine_matches_oracle(e)
+
+
+def test_tpu_expiration_mask():
+    now = time.time()
+    e = make_engine()
+    e.write_relationships([
+        WriteOp("touch", Relationship("pod", "a/p", "viewer", "user", "u1",
+                                      expiration=now + 3600)),
+        WriteOp("touch", Relationship("pod", "a/q", "viewer", "user", "u1",
+                                      expiration=now - 5)),
+    ])
+    assert e.check(CheckItem("pod", "a/p", "view", "user", "u1"))
+    assert not e.check(CheckItem("pod", "a/q", "view", "user", "u1"))
+    # lookup sees only the unexpired one
+    assert e.lookup_resources("pod", "view", "user", "u1") == ["a/p"]
+
+
+def test_tpu_matches_oracle_fuzz():
+    rng = np.random.default_rng(42)
+    for trial in range(6):
+        e = Engine(schema=parse_schema(INTERSECT_SCHEMA))
+        users = [f"u{i}" for i in range(6)]
+        groups = [f"g{i}" for i in range(4)]
+        docs = [f"d{i}" for i in range(8)]
+        orgs = [f"o{i}" for i in range(4)]
+        ops = []
+        for g in groups:
+            for u in rng.choice(users, size=2, replace=False):
+                ops.append(f"group:{g}#member@user:{u}")
+            if rng.random() < 0.5:
+                g2 = rng.choice(groups)
+                if g2 != g:
+                    ops.append(f"group:{g}#member@group:{g2}#member")
+        for d in docs:
+            for u in rng.choice(users, size=2, replace=False):
+                ops.append(f"doc:{d}#reader@user:{u}")
+            if rng.random() < 0.6:
+                ops.append(f"doc:{d}#owner@user:{rng.choice(users)}")
+            if rng.random() < 0.4:
+                ops.append(f"doc:{d}#banned@user:{rng.choice(users)}")
+            if rng.random() < 0.6:
+                ops.append(f"doc:{d}#reader@group:{rng.choice(groups)}#member")
+            if rng.random() < 0.6:
+                ops.append(f"doc:{d}#org@org:{rng.choice(orgs)}")
+        for o_ in orgs:
+            ops.append(f"org:{o_}#admin@user:{rng.choice(users)}")
+            o2 = rng.choice(orgs)
+            if o2 != o_:
+                ops.append(f"org:{o_}#parent@org:{o2}")
+        e.write_relationships(touch(*set(ops)))
+        assert_engine_matches_oracle(
+            e, subjects=[("user", u) for u in users] + [("user", "nobody")]
+        )
+
+
+def test_check_bulk_mixed_subjects_and_unknowns():
+    e = make_engine(
+        "namespace:ns1#creator@user:alice",
+        "namespace:ns1#viewer@user:bob",
+    )
+    got = e.check_bulk([
+        CheckItem("namespace", "ns1", "view", "user", "alice"),
+        CheckItem("namespace", "ns1", "view", "user", "bob"),
+        CheckItem("namespace", "ns1", "edit", "user", "bob"),
+        CheckItem("namespace", "nsX", "view", "user", "alice"),  # unknown obj
+        CheckItem("wat", "x", "view", "user", "alice"),  # unknown type
+        CheckItem("namespace", "ns1", "view", "robot", "r2"),  # unknown subj type
+    ])
+    assert got == [True, True, False, False, False, False]
+
+
+# ---------------------------------------------------------------------------
+# Review-finding regressions (engine core)
+# ---------------------------------------------------------------------------
+
+
+def test_write_atomicity_on_midbatch_conflict():
+    s = Store()
+    s.write(touch("ns:a#viewer@user:x", "ns:b#viewer@user:x"))
+    rev = s.revision
+    with pytest.raises(AlreadyExists):
+        s.write([
+            WriteOp("touch", rel("ns:a#viewer@user:x")),
+            WriteOp("create", rel("ns:b#viewer@user:x")),
+        ])
+    # nothing applied, revision unchanged, no bogus watch events
+    assert len(s) == 2
+    assert s.exists(RelationshipFilter("ns", "a", "viewer"))
+    assert s.revision == rev
+    assert s.watch_since(rev) == []
+
+
+def test_duplicate_update_in_one_write_rejected():
+    from spicedb_kubeapi_proxy_tpu.engine import StoreError
+    s = Store()
+    with pytest.raises(StoreError, match="duplicate"):
+        s.write(touch("ns:a#viewer@user:x", "ns:a#viewer@user:x"))
+
+
+def test_userset_subject_does_not_match_wildcard():
+    schema = parse_schema("""
+    definition user {}
+    definition group {
+      relation member: user
+    }
+    definition ns {
+      relation viewer: group#member | group:*
+      permission view = viewer
+    }
+    """)
+    e = Engine(schema=schema)
+    e.write_relationships(touch("ns:x#viewer@group:*", "group:g#member@user:u"))
+    o = e.oracle()
+    assert not o.check("ns", "x", "view", "group", "g", "member")
+    assert not e.check(CheckItem("ns", "x", "view", "group", "g", "member"))
+    # but a concrete group subject does match the wildcard
+    assert o.check("ns", "x", "view", "group", "anything")
+    assert e.check(CheckItem("ns", "x", "view", "group", "anything"))
+
+
+def test_nonconvergence_raises_not_denies():
+    from spicedb_kubeapi_proxy_tpu.ops.reachability import ConvergenceError
+    chain = ["group:g%d#member@group:g%d#member" % (i, i + 1) for i in range(40)]
+    e = make_engine(*chain, "group:g40#member@user:deep",
+                    "namespace:ns#viewer@group:g0#member")
+    cg = e.compiled()
+    objs = e._objects_by_name()
+    seeds = np.asarray([cg.encode_subject("user", "deep", None, objs)],
+                       dtype=np.int32)
+    q = np.asarray([cg.encode_target("namespace", "view", "ns", objs)],
+                   dtype=np.int32)
+    with pytest.raises(ConvergenceError):
+        cg.query(seeds, q, np.zeros(1, dtype=np.int32), max_iters=8)
+    # with budget it converges and grants
+    assert cg.query(seeds, q, np.zeros(1, dtype=np.int32), max_iters=128)[0]
+
+
+def test_wildcard_expiration_validation():
+    schema = parse_schema("""
+    definition user {}
+    definition ns {
+      relation viewer: user:*
+      permission view = viewer
+    }
+    """)
+    e = Engine(schema=schema)
+    with pytest.raises(SchemaViolation, match="expiring"):
+        e.write_relationships([WriteOp("touch", Relationship(
+            "ns", "a", "viewer", "user", "*", expiration=time.time() + 60))])
+
+
+def test_noop_deletes_do_not_bump_revision():
+    s = Store()
+    s.write(touch("ns:a#viewer@user:x"))
+    rev = s.revision
+    s.delete_by_filter(RelationshipFilter(resource_type="pod"))
+    assert s.revision == rev
+    s.write([WriteOp("delete", rel("ns:zz#viewer@user:x"))])
+    assert s.revision == rev
+
+
+def test_delete_by_filter_preconditions_atomic():
+    s = Store()
+    s.write(touch("lock:l1#workflow@workflow:w1"))
+    with pytest.raises(PreconditionFailed):
+        s.delete_by_filter(
+            RelationshipFilter(resource_type="lock"),
+            [Precondition(RelationshipFilter("lock", "l1", "workflow",
+                                             subject_id="other"),
+                          must_exist=True)],
+        )
+    assert len(s) == 1

@@ -81,6 +81,9 @@ class Engine:
         d = self.schema.definitions.get(rel.resource_type)
         if d is None:
             raise SchemaViolation(f"unknown resource type {rel.resource_type!r}")
+        if rel.resource_id == "*":
+            # SpiceDB forbids wildcard resource ids; only subjects may be '*'
+            raise SchemaViolation("resource id may not be the wildcard '*'")
         r = d.relations.get(rel.relation)
         if r is None:
             raise SchemaViolation(
@@ -142,10 +145,13 @@ class Engine:
     # -- query path ---------------------------------------------------------
 
     def _objects_by_name(self) -> dict:
-        return {
-            self.store.types.string(tid): it
-            for tid, it in self.store.objects.items()
-        }
+        # snapshot under the store lock: writers intern new types into
+        # store.objects and a concurrent iteration would race
+        with self.store._lock:
+            return {
+                self.store.types.string(tid): it
+                for tid, it in self.store.objects.items()
+            }
 
     def compiled(self) -> CompiledGraph:
         """Fully-consistent snapshot: recompile if the store moved."""
@@ -200,7 +206,10 @@ class Engine:
             subject_relation, now=now)
         if mask is None:
             return []
-        return [interner.string(i) for i in np.flatnonzero(mask).tolist()]
+        # the mask covers the bucket-padded object space; padding indices
+        # can never be true (no edges) but guard the interner bound anyway
+        return [interner.string(i) for i in np.flatnonzero(mask).tolist()
+                if i < len(interner)]
 
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,

@@ -93,6 +93,11 @@ class OracleEvaluator:
     def _eval(self, rtype, rid, relname, subject, memo, path, depth) -> bool:
         if depth > MAX_DEPTH:
             raise DepthExceeded(f"{rtype}:{rid}#{relname}")
+        # Zanzibar identity: a userset is a member of itself —
+        # check(g:eng#member @ g:eng#member) is true (matches the device
+        # path, which seeds the subject's own userset slot).
+        if subject[2] is not None and (rtype, rid, relname) == subject:
+            return True
         key = (rtype, rid, relname)
         if key in memo:
             return memo[key]

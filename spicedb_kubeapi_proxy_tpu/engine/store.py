@@ -143,6 +143,11 @@ class Store:
         self._index: Optional[dict[tuple, tuple[int, int]]] = None
         self.revision = 0
         self._watch_log: list[WatchRecord] = []
+        # history retention: beyond the cap the oldest half is dropped and
+        # watchers that far behind get a StoreError (re-list + re-watch,
+        # kube "resourceVersion too old" semantics)
+        self.watch_retention = 1_000_000
+        self._watch_oldest_rev = 0
 
     # -- interning helpers -------------------------------------------------
 
@@ -328,6 +333,7 @@ class Store:
                     np.array([e for _, e in new_rows], dtype=np.float64),
                 )
                 self._append_rows(cols, update_index=True)
+            self._trim_watch_log()
             self.revision = rev
             return rev
 
@@ -368,17 +374,21 @@ class Store:
             return self.revision
 
     def read(self, f: RelationshipFilter, now: Optional[float] = None
-             ) -> Iterator[Relationship]:
-        """ReadRelationships: stream live, unexpired tuples matching filter."""
+             ) -> list[Relationship]:
+        """ReadRelationships: live, unexpired tuples matching the filter.
+        Materialized under the lock (a lazily-consumed generator would hold
+        the store lock across yields and deadlock writers)."""
         with self._lock:
             if now is None:
                 now = time.time()
+            out: list[Relationship] = []
             for cols, alive in zip(self._chunks, self._alive):
                 mask = self._filter_mask(cols, f, now=now) & alive
                 for ri in np.flatnonzero(mask).tolist():
                     key = (int(cols.rt[ri]), int(cols.rid[ri]), int(cols.rl[ri]),
                            int(cols.st[ri]), int(cols.sid[ri]), int(cols.srl[ri]))
-                    yield self._extern_rel(key, cols.exp[ri])
+                    out.append(self._extern_rel(key, cols.exp[ri]))
+            return out
 
     def exists(self, f: RelationshipFilter, _now: Optional[float] = None) -> bool:
         with self._lock:
@@ -420,13 +430,33 @@ class Store:
                         WatchRecord(rev, OP_DELETE,
                                     self._extern_rel(key, NO_EXPIRATION)))
             if count:
+                self._trim_watch_log()
                 self.revision = rev
             return count
 
+    def _trim_watch_log(self) -> None:
+        # caller holds the lock
+        if len(self._watch_log) > self.watch_retention:
+            drop = len(self._watch_log) // 2
+            self._watch_oldest_rev = self._watch_log[drop - 1].revision
+            del self._watch_log[:drop]
+
     def watch_since(self, revision: int) -> list[WatchRecord]:
-        """Watch events with revision > the given revision."""
+        """Watch events with revision > the given revision. Binary-searched
+        (records are appended in revision order); raises if the requested
+        revision predates the retained history."""
         with self._lock:
-            return [r for r in self._watch_log if r.revision > revision]
+            if revision < self._watch_oldest_rev:
+                raise StoreError(
+                    f"watch history before revision {self._watch_oldest_rev} "
+                    "has been trimmed; re-list and re-watch"
+                )
+            import bisect
+
+            i = bisect.bisect_right(
+                self._watch_log, revision, key=lambda r: r.revision
+            )
+            return self._watch_log[i:]
 
     def snapshot(self) -> Snapshot:
         """Immutable columnar view of all live tuples for the compiler.

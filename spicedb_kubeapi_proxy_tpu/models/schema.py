@@ -343,8 +343,18 @@ class _Parser:
 
     def parse_expr(self) -> Expr:
         left = self.parse_term()
+        first_op = None
         while self.cur.value in ("+", "&", "-"):
             op = self.advance().value
+            # SpiceDB rejects unparenthesized mixing of different operators;
+            # silently picking an associativity would change grants
+            if first_op is None:
+                first_op = op
+            elif op != first_op:
+                raise SchemaError(
+                    f"schema line {self.cur.line}: mixing {first_op!r} and "
+                    f"{op!r} requires parentheses"
+                )
             right = self.parse_term()
             if op == "+":
                 if isinstance(left, Union):

@@ -72,6 +72,11 @@ WILDCARD_IDX = 1  # reserved per-type object index for '*'
 
 DEFAULT_MAX_ITERS = 128
 
+# jitted fixpoint functions shared across CompiledGraph revisions with equal
+# signatures (bounded: distinct schemas/bucket layouts, not revisions)
+_JIT_CACHE: dict = {}
+_JIT_CACHE_MAX = 32
+
 
 class ConvergenceError(RuntimeError):
     """The fixpoint hit its iteration budget before converging — the analog
@@ -171,15 +176,48 @@ class CompiledGraph:
 
     # -- device execution --------------------------------------------------
 
+    def signature(self) -> tuple:
+        """Everything baked statically into the traced computation. Two
+        CompiledGraphs with equal signatures can share one jitted function —
+        type sizes are bucket-padded, so steady-state writes (new tuples,
+        even new objects within a bucket) keep the signature stable and hit
+        the XLA compile cache."""
+
+        def expr_sig(e: Expr, leaf_off: dict) -> tuple:
+            if isinstance(e, Nil):
+                return ("nil",)
+            if isinstance(e, (RelationRef, Arrow)):
+                return ("leaf", leaf_off[e])
+            if isinstance(e, Union):
+                return ("or",) + tuple(expr_sig(o, leaf_off) for o in e.operands)
+            if isinstance(e, Intersect):
+                return ("and",) + tuple(expr_sig(o, leaf_off) for o in e.operands)
+            if isinstance(e, Exclude):
+                return ("sub", expr_sig(e.base, leaf_off),
+                        expr_sig(e.subtract, leaf_off))
+            raise TypeError(e)
+
+        return (
+            self.M,
+            tuple((p.dst_off, p.size, expr_sig(p.expr, p.leaf_off))
+                  for p in self.programs),
+        )
+
     def _dev(self):
         d = self._device
         if not d:
             d["src"] = jnp.asarray(self.src)
             d["dst"] = jnp.asarray(self.dst)
             d["exp"] = jnp.asarray(self.exp_rel)
-            d["run"] = jax.jit(
-                partial(_run, self), static_argnames=("max_iters",)
-            )
+            sig = self.signature()
+            run = _JIT_CACHE.get(sig)
+            if run is None:
+                run = jax.jit(partial(_run, self),
+                              static_argnames=("max_iters",))
+                if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+                    _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+                _JIT_CACHE[sig] = run
+            d["run"] = run
         return d
 
     def query(
@@ -347,7 +385,9 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         tid = types_in.lookup(tname)
         n = len(snapshot.objects[tid]) if tid is not None and tid in snapshot.objects \
             else 2
-        n = max(n, 2)
+        # bucket-pad the per-type object space so slot offsets (and thus the
+        # jit signature) stay stable as objects are interned within a bucket
+        n = _next_bucket(max(n, 2), 8)
         type_sizes[tname] = n
         slot_offset[(tname, SELF_REL)] = off
         off += n

@@ -18,3 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin (sitecustomize on this image) overrides platform
+# selection to "axon,cpu" when jax registers, which makes the first backend
+# use initialize the TPU tunnel — slow, single-tenant, and hang-prone from
+# test processes. Backends initialize lazily, so forcing the config back to
+# cpu here (before any jax computation) keeps tests off the chip entirely.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

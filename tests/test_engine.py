@@ -545,3 +545,78 @@ def test_delete_by_filter_preconditions_atomic():
                           must_exist=True)],
         )
     assert len(s) == 1
+
+
+def test_jit_cache_shared_across_revisions():
+    """Steady-state writes (same bucket layout) must not recompile
+    (review finding: jit-per-CompiledGraph)."""
+    e = make_engine("namespace:ns1#creator@user:alice")
+    e.check(CheckItem("namespace", "ns1", "view", "user", "alice"))
+    cg1 = e.compiled()
+    # touch/delete an existing tuple: same interners, same buckets
+    e.write_relationships(touch("namespace:ns1#viewer@user:bob"))
+    e.check(CheckItem("namespace", "ns1", "view", "user", "bob"))
+    cg2 = e.compiled()
+    assert cg1 is not cg2
+    assert cg1.signature() == cg2.signature()
+    assert cg1._device["run"] is cg2._device["run"]
+
+
+def test_reflexive_userset_identity_both_paths():
+    e = make_engine("group:eng#member@user:u",
+                    "namespace:ns#viewer@group:eng#member")
+    o = e.oracle()
+    assert o.check("group", "eng", "member", "group", "eng", "member")
+    assert e.check(CheckItem("group", "eng", "member", "group", "eng", "member"))
+
+
+def test_wildcard_resource_id_rejected():
+    e = make_engine()
+    with pytest.raises(SchemaViolation, match="wildcard"):
+        e.write_relationships(touch("namespace:*#viewer@user:x"))
+
+
+def test_store_read_does_not_hold_lock():
+    s = Store()
+    s.write(touch("ns:a#viewer@user:x", "ns:b#viewer@user:x"))
+    rels = s.read(RelationshipFilter(resource_type="ns"))
+    # read returns a list; a concurrent write must not deadlock
+    s.write(touch("ns:c#viewer@user:x"))
+    assert len(rels) == 2
+
+
+def test_watch_trim_and_bisect():
+    from spicedb_kubeapi_proxy_tpu.engine import StoreError
+    s = Store()
+    s.watch_retention = 10
+    for i in range(20):
+        s.write(touch(f"ns:n{i}#viewer@user:x"))
+    recs = s.watch_since(s.revision - 1)
+    assert len(recs) == 1
+    with pytest.raises(StoreError, match="trimmed"):
+        s.watch_since(0)
+
+
+def test_schema_mixed_operators_require_parens():
+    from spicedb_kubeapi_proxy_tpu.models import SchemaError
+    with pytest.raises(SchemaError, match="parentheses"):
+        parse_schema("""
+        definition user {}
+        definition d {
+          relation a: user
+          relation b: user
+          relation c: user
+          permission p = a + b & c
+        }
+        """)
+    # same-operator chains still fine
+    parse_schema("""
+    definition user {}
+    definition d {
+      relation a: user
+      relation b: user
+      relation c: user
+      permission p = a - b - c
+      permission q = a + b + c
+    }
+    """)

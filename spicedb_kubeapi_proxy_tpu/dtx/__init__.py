@@ -1,0 +1,25 @@
+"""Durable dual-write: deterministic workflow engine + the two lock-mode
+workflows (reference pkg/authz/distributedtx).
+
+The reference uses github.com/cschleiden/go-workflows with a SQLite
+event-sourced backend run in-process ("monoprocess",
+/root/reference/pkg/authz/distributedtx/client.go:18-62). Here the same
+durability contract is provided by runner.py: workflows are Python
+generator functions whose activity calls are event-sourced to SQLite and
+deterministically replayed after a crash.
+"""
+
+from .runner import (  # noqa: F401
+    ActivityError,
+    WorkflowCrash,
+    WorkflowEngine,
+    WorkflowTimeout,
+)
+from .workflow import (  # noqa: F401
+    KubeResp,
+    LOCK_MODE_OPTIMISTIC,
+    LOCK_MODE_PESSIMISTIC,
+    WorkflowInput,
+    register_workflows,
+)
+from .activity import ActivityHandler  # noqa: F401

@@ -1,0 +1,42 @@
+"""Authentication: embedded-mode header authenticator.
+
+Mirrors the reference's embedded-mode authenticator
+(/root/reference/pkg/proxy/authn.go:78-119): the caller's identity arrives
+in ``X-Remote-User`` / ``X-Remote-Group`` / ``X-Remote-Extra-*`` headers.
+(The reference's other mode wires kube's built-in client-cert/OIDC/token
+authenticators; TLS client-cert authn is a proxy-server concern layered on
+top of this interface in a later milestone.)
+"""
+
+from __future__ import annotations
+
+from ..rules.input import UserInfo
+
+USER_HEADER = "X-Remote-User"
+GROUP_HEADER = "X-Remote-Group"
+EXTRA_HEADER_PREFIX = "X-Remote-Extra-"
+
+
+class AuthenticationError(Exception):
+    pass
+
+
+class HeaderAuthenticator:
+    def authenticate(self, headers: dict[str, str]) -> UserInfo:
+        name = None
+        groups: list[str] = []
+        extra: dict[str, list[str]] = {}
+        for k, v in headers.items():
+            lk = k.lower()
+            if lk == USER_HEADER.lower():
+                name = v
+            elif lk == GROUP_HEADER.lower():
+                # repeated headers may arrive comma-joined
+                groups.extend(g.strip() for g in v.split(",") if g.strip())
+            elif lk.startswith(EXTRA_HEADER_PREFIX.lower()):
+                key = k[len(EXTRA_HEADER_PREFIX):].lower()
+                extra.setdefault(key, []).extend(
+                    x.strip() for x in v.split(",") if x.strip())
+        if not name:
+            raise AuthenticationError(f"no {USER_HEADER} header present")
+        return UserInfo(name=name, groups=groups, extra=extra)

@@ -1,0 +1,35 @@
+"""Rules engine: ProxyRule config, template/expression compiler, matcher.
+
+Mirrors the reference's pkg/config/proxyrule (YAML rule schema) and
+pkg/rules (compilation of templates/tupleSets/conditions into runnable
+rules keyed by (verb, group, version, resource)). The reference embeds two
+third-party expression runtimes — Bloblang for templates/tupleSets and CEL
+for `if` conditions; here a single host expression language (expr.py)
+covers both surfaces.
+"""
+
+from .expr import ExprError, compile_expr, compile_template  # noqa: F401
+from .input import RequestInfo, ResolveInput, UserInfo  # noqa: F401
+from .proxyrule import (  # noqa: F401
+    Match,
+    PreFilterSpec,
+    PostFilterSpec,
+    RuleConfig,
+    RuleSpec,
+    RuleValidationError,
+    StringOrTemplate,
+    UpdateSpec,
+    parse_rule_configs,
+)
+from .compile import (  # noqa: F401
+    CompileError,
+    PostFilter,
+    PreFilter,
+    RelExpr,
+    ResolvedRel,
+    RunnableRule,
+    TupleSetExpr,
+    UpdateSet,
+    compile_rule,
+)
+from .matcher import MapMatcher, RequestMeta  # noqa: F401

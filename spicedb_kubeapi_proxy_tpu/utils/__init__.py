@@ -1,0 +1,3 @@
+"""Utilities: failpoints, metrics, logging."""
+
+from .failpoints import FailPointError, failpoints  # noqa: F401

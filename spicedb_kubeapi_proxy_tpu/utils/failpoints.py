@@ -1,0 +1,70 @@
+"""Fault-injection failpoints.
+
+Mirrors /root/reference/pkg/failpoints/failpoints_on.go:19-48: named panic
+sites armed with per-name call budgets. The reference compiles them in via a
+build tag; here they are armed at runtime (API or
+``FAILPOINTS=name:count,name2`` env) and are a no-op when not armed, so they
+stay in production code paths like the reference's activity hooks
+(activity.go:48,61,153,155,176,213).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class FailPointError(RuntimeError):
+    """Raised at an armed failpoint (the reference panics; activities catch
+    this to simulate side-effect-edge crashes)."""
+
+    def __init__(self, name: str):
+        super().__init__(f"failpoint {name!r} triggered")
+        self.name = name
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, int] = {}
+        env = os.environ.get("FAILPOINTS", "")
+        for part in env.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                name, count = part.split(":", 1)
+                self.enable(name, int(count))
+            else:
+                self.enable(part, 1)
+
+    def enable(self, name: str, budget: int = 1) -> None:
+        with self._lock:
+            self._armed[name] = budget
+
+    def disable(self, name: str) -> None:
+        with self._lock:
+            self._armed.pop(name, None)
+
+    def disable_all(self) -> None:
+        with self._lock:
+            self._armed.clear()
+
+    def hit(self, name: str) -> None:
+        """Call at a potential fault site; raises while the budget lasts."""
+        with self._lock:
+            left = self._armed.get(name)
+            if left is None:
+                return
+            if left <= 1:
+                self._armed.pop(name, None)
+            else:
+                self._armed[name] = left - 1
+        raise FailPointError(name)
+
+    def armed(self, name: str) -> bool:
+        with self._lock:
+            return name in self._armed
+
+
+failpoints = _Registry()

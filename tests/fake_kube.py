@@ -1,0 +1,156 @@
+"""In-memory fake kube-apiserver implementing the Upstream interface.
+
+Plays the role envtest's real apiserver plays in the reference e2e suite
+(reference e2e/util_test.go:65-102): CRUD + list + watch over JSON
+resources, with injectable failures for the crash matrix. Content shape
+follows kube conventions (kind lists, Status errors, resourceVersion).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from spicedb_kubeapi_proxy_tpu.proxy.types import (
+    ProxyRequest,
+    ProxyResponse,
+    json_response,
+    kube_status,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
+
+
+def _kind_for(resource: str) -> str:
+    singular = resource[:-1] if resource.endswith("s") else resource
+    return "".join(p.capitalize() for p in singular.split("-"))
+
+
+class FakeKube:
+    def __init__(self):
+        # (resource, namespace, name) -> object dict
+        self.objects: dict[tuple, dict] = {}
+        self.rv = 0
+        self._fail_next: list = []  # (matcher, status | Exception)
+        self.requests: list[ProxyRequest] = []
+        self._watchers: list[tuple[str, str, asyncio.Queue]] = []
+
+    # -- failure injection ---------------------------------------------------
+
+    def fail_next(self, n: int = 1, status: int = 500,
+                  exception: Optional[Exception] = None,
+                  method: Optional[str] = None):
+        for _ in range(n):
+            self._fail_next.append((method, status, exception))
+
+    # -- upstream interface --------------------------------------------------
+
+    async def __call__(self, req: ProxyRequest) -> ProxyResponse:
+        self.requests.append(req)
+        if self._fail_next:
+            method, status, exc = self._fail_next[0]
+            if method is None or method == req.method:
+                self._fail_next.pop(0)
+                if exc is not None:
+                    raise exc
+                return kube_status(status, "injected failure")
+        info = req.request_info or parse_request_info(
+            req.method, req.path, req.query)
+        if not info.is_resource_request:
+            if info.path.startswith(("/api", "/apis", "/openapi", "/version")):
+                return json_response(200, {"kind": "APIVersions",
+                                           "versions": ["v1"]})
+            return kube_status(404, "not found")
+        res, ns, name = info.resource, info.namespace, info.name
+        if info.verb == "get":
+            obj = self.objects.get((res, ns, name))
+            if obj is None:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            return json_response(200, obj)
+        if info.verb == "list" or info.verb == "watch":
+            if info.verb == "watch":
+                return self._start_watch(res, ns)
+            items = [o for (r, n_, _), o in sorted(self.objects.items())
+                     if r == res and (not ns or n_ == ns)]
+            return json_response(200, {
+                "kind": _kind_for(res) + "List",
+                "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(self.rv)},
+                "items": items,
+            })
+        if info.verb == "create":
+            try:
+                obj = json.loads(req.body)
+            except ValueError:
+                return kube_status(400, "invalid body")
+            name = (obj.get("metadata") or {}).get("name", "")
+            if not name:
+                return kube_status(400, "name required")
+            key = (res, ns, name)
+            if key in self.objects:
+                return kube_status(409, f'{res} "{name}" already exists',
+                                   "AlreadyExists")
+            self.rv += 1
+            obj.setdefault("metadata", {})
+            obj["metadata"]["resourceVersion"] = str(self.rv)
+            if ns:
+                obj["metadata"]["namespace"] = ns
+            obj.setdefault("kind", _kind_for(res))
+            self.objects[key] = obj
+            self._notify(res, ns, {"type": "ADDED", "object": obj})
+            return json_response(201, obj)
+        if info.verb == "update":
+            key = (res, ns, name)
+            if key not in self.objects:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            obj = json.loads(req.body)
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            self.objects[key] = obj
+            self._notify(res, ns, {"type": "MODIFIED", "object": obj})
+            return json_response(200, obj)
+        if info.verb == "delete":
+            key = (res, ns, name)
+            obj = self.objects.pop(key, None)
+            if obj is None:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            self.rv += 1
+            self._notify(res, ns, {"type": "DELETED", "object": obj})
+            return json_response(200, {"kind": "Status", "status": "Success",
+                                       "code": 200})
+        return kube_status(405, f"verb {info.verb} not supported")
+
+    # -- watch ---------------------------------------------------------------
+
+    def _notify(self, res: str, ns: str, event: dict) -> None:
+        for r, n_, q in self._watchers:
+            if r == res and (not n_ or n_ == ns):
+                q.put_nowait(event)
+
+    def _start_watch(self, res: str, ns: str) -> ProxyResponse:
+        q: asyncio.Queue = asyncio.Queue()
+        # emit existing objects as initial ADDED events (kube semantics with
+        # resourceVersion=0 watches)
+        for (r, n_, _), o in sorted(self.objects.items()):
+            if r == res and (not ns or n_ == ns):
+                q.put_nowait({"type": "ADDED", "object": o})
+        self._watchers.append((res, ns, q))
+
+        async def frames():
+            while True:
+                ev = await q.get()
+                if ev is None:
+                    return
+                yield (json.dumps(ev) + "\n").encode()
+
+        return ProxyResponse(
+            status=200,
+            headers={"Content-Type": "application/json",
+                     "Transfer-Encoding": "chunked"},
+            stream=frames(),
+        )
+
+    def stop_watches(self):
+        for _, _, q in self._watchers:
+            q.put_nowait(None)
+        self._watchers.clear()

@@ -1,0 +1,327 @@
+"""Durable dual-write tests: happy paths, rollbacks, and the crash matrix.
+
+Ports the shape of the reference's e2e failpoint suite
+(reference e2e/proxy_test.go:650-860): kube write failures, post-success
+crashes, SpiceDB write failures with idempotent retries, per-lock-mode
+reruns, and the zero-leftover-locks invariant (proxy_test.go:106-111).
+"""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.dtx import (
+    ActivityHandler,
+    WorkflowEngine,
+    WorkflowInput,
+    register_workflows,
+)
+from spicedb_kubeapi_proxy_tpu.dtx.runner import ActivityError
+from spicedb_kubeapi_proxy_tpu.dtx.workflow import (
+    LOCK_MODE_OPTIMISTIC,
+    LOCK_MODE_PESSIMISTIC,
+)
+from spicedb_kubeapi_proxy_tpu.engine import (
+    CheckItem,
+    Engine,
+    RelationshipFilter,
+    WriteOp,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+from spicedb_kubeapi_proxy_tpu.utils.failpoints import failpoints
+
+from fake_kube import FakeKube
+
+
+def ns_create_input(name="team-a", user="alice") -> WorkflowInput:
+    body = json.dumps({"metadata": {"name": name}, "kind": "Namespace"})
+    return WorkflowInput(
+        verb="create",
+        path="/api/v1/namespaces",
+        uri="/api/v1/namespaces",
+        headers={"Content-Type": "application/json"},
+        user_name=user,
+        object_name=name,
+        namespace="",
+        api_group="",
+        resource="namespaces",
+        body_b64=base64.b64encode(body.encode()).decode(),
+        preconditions=[{
+            "must_exist": False,
+            "filter": {"resource_type": "namespace", "resource_id": name,
+                       "relation": "cluster"},
+        }],
+        creates=[
+            f"namespace:{name}#creator@user:{user}",
+            f"namespace:{name}#cluster@cluster:cluster",
+        ],
+    )
+
+
+def ns_delete_input(name="team-a", user="alice") -> WorkflowInput:
+    return WorkflowInput(
+        verb="delete",
+        path=f"/api/v1/namespaces/{name}",
+        uri=f"/api/v1/namespaces/{name}",
+        headers={},
+        user_name=user,
+        object_name=name,
+        namespace="",
+        api_group="",
+        resource="namespaces",
+        deletes=[
+            f"namespace:{name}#creator@user:{user}",
+            f"namespace:{name}#cluster@cluster:cluster",
+        ],
+    )
+
+
+class World:
+    """Engine + fake kube + workflow runner wired together."""
+
+    def __init__(self, db_path=":memory:"):
+        self.engine = Engine()
+        self.kube = FakeKube()
+        self.db_path = db_path
+        self.runner = self.new_runner()
+
+    def new_runner(self) -> WorkflowEngine:
+        r = WorkflowEngine(db_path=self.db_path)
+        register_workflows(r)
+        ActivityHandler(self.engine, self.kube).register(r)
+        return r
+
+    def no_leftover_locks(self) -> bool:
+        return not self.engine.store.exists(
+            RelationshipFilter(resource_type="lock"))
+
+    def has_rel(self, rel: str) -> bool:
+        r = parse_relationship(rel)
+        return self.engine.store.exists(RelationshipFilter(
+            r.resource_type, r.resource_id, r.relation,
+            r.subject_type, r.subject_id, r.subject_relation))
+
+
+@pytest.fixture(autouse=True)
+def clear_failpoints():
+    failpoints.disable_all()
+    yield
+    failpoints.disable_all()
+
+
+@pytest.mark.parametrize("mode", [LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+def test_dual_write_happy_path(mode):
+    async def run():
+        w = World()
+        iid = await w.runner.create_instance(mode, ns_create_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 201
+        body = json.loads(base64.b64decode(out["body_b64"]))
+        assert body["metadata"]["name"] == "team-a"
+        assert ("namespaces", "", "team-a") in w.kube.objects
+        assert w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.engine.check(CheckItem("namespace", "team-a", "view",
+                                        "user", "alice"))
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("mode", [LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+def test_spicedb_precondition_failure_conflict(mode):
+    async def run():
+        w = World()
+        # precondition (cluster rel must not exist) already violated
+        w.engine.write_relationships(
+            [WriteOp("touch",
+                     parse_relationship("namespace:team-a#cluster@cluster:cluster"))])
+        iid = await w.runner.create_instance(mode, ns_create_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 409
+        assert ("namespaces", "", "team-a") not in w.kube.objects
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+def test_lock_conflict_returns_409():
+    async def run():
+        w = World()
+        from spicedb_kubeapi_proxy_tpu.dtx.workflow import resource_lock_rel
+        lock = resource_lock_rel(ns_create_input(), "other-workflow")
+        w.engine.write_relationships([WriteOp("touch", parse_relationship(lock))])
+        iid = await w.runner.create_instance(
+            LOCK_MODE_PESSIMISTIC, ns_create_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 409
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+        # the other workflow's lock is untouched
+        assert w.engine.store.exists(RelationshipFilter(resource_type="lock"))
+    asyncio.run(run())
+
+
+def test_kube_rejection_rolls_back():
+    async def run():
+        w = World()
+        # kube rejects with a NON-retryable failure status (422)
+        w.kube.fail_next(n=1, status=422, method="POST")
+        iid = await w.runner.create_instance(
+            LOCK_MODE_PESSIMISTIC, ns_create_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 422
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+def test_kube_transient_exception_retried():
+    async def run():
+        w = World()
+        w.kube.fail_next(n=2, exception=ConnectionError("kaboom"),
+                         method="POST")
+        iid = await w.runner.create_instance(
+            LOCK_MODE_PESSIMISTIC, ns_create_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=15)
+        assert out["status"] == 201
+        assert w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+def test_delete_with_404_is_success():
+    async def run():
+        w = World()
+        w.engine.write_relationships([
+            WriteOp("touch",
+                    parse_relationship("namespace:team-a#creator@user:alice")),
+            WriteOp("touch",
+                    parse_relationship("namespace:team-a#cluster@cluster:cluster")),
+        ])
+        # object already gone from kube: delete still succeeds (404 ok)
+        iid = await w.runner.create_instance(
+            LOCK_MODE_PESSIMISTIC, ns_delete_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 404
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("mode", [LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+@pytest.mark.parametrize("failpoint", [
+    "panicWriteSpiceDB",     # before the spicedb side effect
+    "panicSpiceDBReadResp",  # after the spicedb side effect
+    "panicKubeWrite",        # before the kube side effect
+    "panicKubeReadResp",     # after the kube side effect
+])
+def test_crash_matrix_resume_exactly_once(tmp_path, mode, failpoint):
+    """Crash at every side-effect edge; a restarted worker must complete the
+    dual-write exactly once (reference proxy_test.go:650-830)."""
+    async def run():
+        db = str(tmp_path / f"dtx-{mode}-{failpoint}.sqlite")
+        w = World(db_path=db)
+        failpoints.enable(failpoint, 1)
+        iid = await w.runner.create_instance(mode, ns_create_input().to_dict())
+        with pytest.raises(asyncio.TimeoutError):
+            await w.runner.get_result(iid, timeout=0.5)
+        assert w.runner.pending_count() == 1
+        # "restart": a fresh engine over the same event log
+        w.runner = w.new_runner()
+        resumed = await w.runner.resume_pending()
+        assert resumed == [iid]
+        out = await w.runner.get_result(iid, timeout=15)
+        assert out["status"] in (201, 409)  # 409: kube write landed pre-crash
+        assert ("namespaces", "", "team-a") in w.kube.objects
+        assert w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.has_rel("namespace:team-a#cluster@cluster:cluster")
+        assert w.no_leftover_locks()
+        # exactly-once: no duplicate objects, exactly one creator rel
+        rels = list(w.engine.read_relationships(RelationshipFilter(
+            resource_type="namespace", relation="creator")))
+        assert len(rels) == 1
+    asyncio.run(run())
+
+
+def test_optimistic_ambiguous_kube_failure_object_exists():
+    """Kube activity fails but the write landed: no rollback
+    (reference workflow.go:335-348)."""
+    async def run():
+        w = World()
+        # the object already exists in kube (simulating a prior landed write),
+        # and the kube activity raises
+        w.kube.objects[("namespaces", "", "team-a")] = {
+            "kind": "Namespace", "metadata": {"name": "team-a"}}
+        w.kube.fail_next(n=10, exception=ConnectionError("down"),
+                         method="POST")
+        iid = await w.runner.create_instance(
+            LOCK_MODE_OPTIMISTIC, ns_create_input().to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 200
+        assert w.has_rel("namespace:team-a#creator@user:alice")
+    asyncio.run(run())
+
+
+def test_optimistic_ambiguous_kube_failure_object_absent():
+    async def run():
+        w = World()
+        # POSTs fail; the existence probe (GET) succeeds and reports absent,
+        # so the relationship write must be rolled back (workflow.go:341-346)
+        w.kube.fail_next(n=20, exception=ConnectionError("down"),
+                         method="POST")
+        iid = await w.runner.create_instance(
+            LOCK_MODE_OPTIMISTIC, ns_create_input().to_dict())
+        with pytest.raises(ActivityError):
+            await w.runner.get_result(iid, timeout=10)
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+    asyncio.run(run())
+
+
+def test_delete_by_filter_expansion():
+    async def run():
+        w = World()
+        w.engine.write_relationships([
+            WriteOp("touch", parse_relationship(f"pod:ns/p#viewer@user:u{i}"))
+            for i in range(3)
+        ])
+        w.kube.objects[("pods", "ns", "p")] = {
+            "kind": "Pod", "metadata": {"name": "p", "namespace": "ns"}}
+        inp = WorkflowInput(
+            verb="delete", path="/api/v1/namespaces/ns/pods/p",
+            uri="/api/v1/namespaces/ns/pods/p", headers={},
+            user_name="alice", object_name="p", namespace="ns",
+            api_group="", resource="pods",
+            delete_by_filter=[{"resource_type": "pod", "resource_id": "ns/p"}],
+        )
+        iid = await w.runner.create_instance(LOCK_MODE_PESSIMISTIC,
+                                             inp.to_dict())
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 200
+        assert not w.engine.store.exists(
+            RelationshipFilter(resource_type="pod"))
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+def test_workflow_determinism_replay_guard(tmp_path):
+    """Replaying with different workflow code fails loudly."""
+    async def run():
+        db = str(tmp_path / "det.sqlite")
+        w = World(db_path=db)
+        failpoints.enable("panicKubeWrite", 1)
+        iid = await w.runner.create_instance(
+            LOCK_MODE_PESSIMISTIC, ns_create_input().to_dict())
+        with pytest.raises(asyncio.TimeoutError):
+            await w.runner.get_result(iid, timeout=0.5)
+        # resume with a DIFFERENT (incompatible) workflow registered
+        w.runner = w.new_runner()
+
+        def bogus(ctx, input):
+            yield ctx.call("read_relationships", filter={})
+            return None
+
+        w.runner.register_workflow(LOCK_MODE_PESSIMISTIC, bogus)
+        await w.runner.resume_pending()
+        with pytest.raises(ActivityError, match="non-deterministic"):
+            await w.runner.get_result(iid, timeout=5)
+    asyncio.run(run())

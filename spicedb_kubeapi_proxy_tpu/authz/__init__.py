@@ -1,0 +1,10 @@
+"""Per-request authorization: middleware orchestration + response filtering.
+
+Mirrors the reference's pkg/authz: WithAuthorization per-request flow
+(checks, update dispatch, prefilter/postfilter/watch paths), LookupResources
+prefiltering, list/table/object response filtering, bulk postfilter checks,
+and the dual-write front door.
+"""
+
+from .middleware import AuthzDeps, authorize  # noqa: F401
+from .lookups import AllowedSet, run_prefilter  # noqa: F401

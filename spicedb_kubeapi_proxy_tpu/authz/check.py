@@ -1,0 +1,43 @@
+"""Check execution: rule templates -> one bulk permission query.
+
+Mirrors /root/reference/pkg/authz/check.go:17-114: every matching rule's
+check (or postcheck) templates generate relationships; ALL of them must
+come back permitted. The reference fans out goroutines that each issue a
+CheckBulkPermissions RPC; here the entire set is one engine.check_bulk
+call — a single batched fixpoint on device.
+"""
+
+from __future__ import annotations
+
+from ..engine import CheckItem, Engine
+from ..rules.compile import RelationshipExpr, RunnableRule
+from ..rules.input import ResolveInput
+
+
+def collect_check_items(exprs: list[RelationshipExpr],
+                        input: ResolveInput) -> list[CheckItem]:
+    items: list[CheckItem] = []
+    for e in exprs:
+        for rel in e.generate(input):
+            items.append(CheckItem(
+                rel.resource_type, rel.resource_id, rel.resource_relation,
+                rel.subject_type, rel.subject_id,
+                rel.subject_relation or None,
+            ))
+    return items
+
+
+def run_checks(engine: Engine, rules: list[RunnableRule],
+               input: ResolveInput, post: bool = False) -> bool:
+    """True iff every generated check passes (fully consistent)."""
+    items: list[CheckItem] = []
+    for r in rules:
+        items.extend(collect_check_items(
+            r.post_checks if post else r.checks, input))
+    if not items:
+        return True
+    return all(engine.check_bulk(items))
+
+
+def has_checks(rules: list[RunnableRule]) -> bool:
+    return any(r.checks for r in rules)

@@ -1,0 +1,87 @@
+"""Response filtering: lists, tables, and single objects.
+
+Mirrors /root/reference/pkg/authz/responsefilterer.go:190-415: after the
+upstream responds, list items / table rows / the single object are filtered
+against the allowed set computed by the (concurrent) prefilter. JSON is the
+negotiated content type (the reference additionally handles kube protobuf;
+this proxy requests/serves JSON). Filtering errors surface as 401, an
+excluded single object as 404 (writeResp semantics,
+responsefilterer.go:716-735 — the reference writes 401 for errors and 404
+for a filtered-out single object).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..proxy.types import ProxyResponse, kube_status
+from ..rules.input import ResolveInput
+from .lookups import AllowedSet
+
+
+class FilterError(Exception):
+    pass
+
+
+def _meta_pair(obj: dict) -> tuple[str, str]:
+    meta = obj.get("metadata") or {}
+    return meta.get("namespace") or "", meta.get("name") or ""
+
+
+def filter_body(body: bytes, allowed: AllowedSet,
+                input: ResolveInput) -> tuple[int, bytes]:
+    """Filter a JSON response body; returns (status, new_body)."""
+    try:
+        doc = json.loads(body)
+    except ValueError as e:
+        raise FilterError(f"response is not JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise FilterError("response is not an object")
+    kind = doc.get("kind", "")
+    if kind == "Table":
+        rows = doc.get("rows") or []
+        kept = []
+        for row in rows:
+            obj = row.get("object") or {}
+            ns, name = _meta_pair(obj)
+            if allowed.allows(ns, name):
+                kept.append(row)
+        doc["rows"] = kept
+        return 200, json.dumps(doc).encode()
+    if kind.endswith("List"):
+        items = doc.get("items") or []
+        kept = [o for o in items if allowed.allows(*_meta_pair(o))]
+        doc["items"] = kept
+        return 200, json.dumps(doc).encode()
+    # single object
+    ns, name = _meta_pair(doc)
+    if allowed.allows(ns, name):
+        return 200, body
+    return 404, b""
+
+
+def apply_filter(resp: ProxyResponse, allowed: AllowedSet,
+                 input: ResolveInput) -> ProxyResponse:
+    """Filter an upstream response in place (the reference hooks
+    ReverseProxy.ModifyResponse, pkg/proxy/server.go:103-112)."""
+    if resp.status != 200:
+        return resp  # upstream errors pass through unfiltered
+    ctype = resp.content_type
+    if ctype and "json" not in ctype:
+        # the proxy always requests JSON upstream; anything else is a bug
+        return kube_status(401, f"cannot filter content type {ctype!r}")
+    try:
+        status, body = filter_body(resp.body, allowed, input)
+    except FilterError as e:
+        return kube_status(401, str(e))
+    if status == 404:
+        info = input.request
+        return kube_status(
+            404,
+            f'{info.resource} "{input.name}" not found',
+            "NotFound",
+        )
+    headers = dict(resp.headers)
+    headers["Content-Length"] = str(len(body))
+    return ProxyResponse(status=200, headers=headers, body=body)

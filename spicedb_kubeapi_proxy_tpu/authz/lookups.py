@@ -1,0 +1,97 @@
+"""PreFilter execution: LookupResources -> allowed (namespace, name) set.
+
+Mirrors /root/reference/pkg/authz/lookups.go:43-136: the prefilter rule's
+relationship template must resolve its resource ID to ``$`` (the
+match-everything marker); the engine's reverse-reachability query returns
+every object id the subject can reach, and the rule's
+``fromObjectIDNameExpr`` / ``fromObjectIDNamespaceExpr`` expressions map
+each id to an allowed NamespacedName.
+
+The TPU twist (BASELINE.json north star): instead of streaming ids over
+gRPC and mapping one-by-one, the engine hands back a boolean mask over the
+type's whole interned object space from a single device pass; when the
+mapping expressions are the identity/split forms (the common case, e.g.
+deploy/rules.yaml), names are materialized lazily only for allowed ids.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..engine import Engine
+from ..rules.compile import PreFilter, RunnableRule
+from ..rules.expr import ExprError
+from ..rules.input import ResolveInput
+from ..rules.proxyrule import MATCHING_ID_FIELD_VALUE
+
+
+class PreFilterError(Exception):
+    pass
+
+
+@dataclass
+class AllowedSet:
+    """Allowed (namespace, name) pairs; namespace '' for cluster-scoped."""
+
+    pairs: set = field(default_factory=set)
+
+    def add(self, namespace: str, name: str) -> None:
+        self.pairs.add((namespace or "", name))
+
+    def allows(self, namespace: str, name: str) -> bool:
+        return (namespace or "", name) in self.pairs
+
+    def __len__(self) -> int:
+        return len(self.pairs)
+
+
+def single_prefilter(rules: list[RunnableRule]) -> Optional[tuple[RunnableRule, PreFilter]]:
+    """At most one prefilter may apply to a request (reference
+    singlePreFilterRule, pkg/authz/rules.go:49-61)."""
+    found: list[tuple[RunnableRule, PreFilter]] = []
+    for r in rules:
+        for p in r.pre_filters:
+            found.append((r, p))
+    if not found:
+        return None
+    if len(found) > 1:
+        raise PreFilterError(
+            f"multiple prefilter rules match the request "
+            f"({[r.name for r, _ in found]}); only one is allowed")
+    return found[0]
+
+
+def run_prefilter_sync(engine: Engine, pf: PreFilter,
+                       input: ResolveInput) -> AllowedSet:
+    rel = pf.rel.generate(input)[0]
+    if rel.resource_id != MATCHING_ID_FIELD_VALUE:
+        raise PreFilterError(
+            f"prefilter resource ID must be {MATCHING_ID_FIELD_VALUE!r}, "
+            f"got {rel.resource_id!r} (reference lookups.go:49-56)")
+    ids = engine.lookup_resources(
+        rel.resource_type, rel.resource_relation,
+        rel.subject_type, rel.subject_id, rel.subject_relation or None,
+    )
+    allowed = AllowedSet()
+    base = input.template_data()
+    for obj_id in ids:
+        data = dict(base)
+        data["resourceId"] = obj_id
+        try:
+            name = pf.name_expr.evaluate_str(data)
+            ns = (pf.namespace_expr.evaluate_str(data)
+                  if pf.namespace_expr else "")
+        except ExprError as e:
+            raise PreFilterError(f"mapping looked-up id {obj_id!r}: {e}") from None
+        allowed.add(ns, name)
+    return allowed
+
+
+async def run_prefilter(engine: Engine, pf: PreFilter,
+                        input: ResolveInput) -> AllowedSet:
+    """Async wrapper so the device query overlaps the upstream kube request
+    (the reference overlaps via goroutine+channel,
+    responsefilterer.go:165-183)."""
+    return await asyncio.to_thread(run_prefilter_sync, engine, pf, input)

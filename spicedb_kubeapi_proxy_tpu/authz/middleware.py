@@ -1,0 +1,201 @@
+"""The per-request authorization orchestrator.
+
+Mirrors /root/reference/pkg/authz/authz.go:23-194 (WithAuthorization):
+
+1. build ResolveInput from the authenticated request
+2. always-allow API discovery (GET /api, /apis, /openapi, /version —
+   authz.go:205-207)
+3. match rules on (verb, group, version, resource); none -> 403
+4. filter rules by their `if` conditions; none left -> 403
+5. run every matching rule's checks as ONE bulk engine query; any
+   denial -> 403
+6. dispatch:
+   - write verbs with an update rule -> durable dual-write workflow
+     (≤30s wait), response written from the workflow's KubeResp
+   - watch with a prefilter -> filtered watch join
+   - list/get with a prefilter -> prefilter overlapped with the upstream
+     request, response filtered (lists/tables/single object)
+   - list with postfilters -> upstream response recorded and bulk-checked
+   - get with postchecks -> checks run after a 2xx upstream response
+   - otherwise -> plain reverse proxy
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..dtx.runner import ActivityError, WorkflowEngine, WorkflowTimeout
+from ..dtx.workflow import KubeResp, LOCK_MODE_PESSIMISTIC
+from ..engine import Engine
+from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
+from ..rules.expr import ExprError
+from ..rules.input import ResolveInput, UserInfo
+from ..rules.matcher import MapMatcher, RequestMeta
+from .check import run_checks
+from .filterer import apply_filter
+from .lookups import PreFilterError, run_prefilter, single_prefilter
+from .postfilter import filter_list_response
+from .update import UpdateError, build_workflow_input, single_update_rule
+from .watch import filtered_watch
+
+WRITE_VERBS = ("create", "update", "patch", "delete")
+
+ALWAYS_ALLOWED_PREFIXES = ("/api", "/apis", "/openapi", "/version")
+
+WORKFLOW_RESULT_TIMEOUT = 30.0  # reference DefaultWorkflowTimeout
+
+
+@dataclass
+class AuthzDeps:
+    matcher: MapMatcher
+    engine: Engine
+    upstream: object  # Upstream callable
+    workflow: Optional[WorkflowEngine] = None
+    default_lock_mode: str = LOCK_MODE_PESSIMISTIC
+    watch_poll_interval: float = 0.05
+
+
+def _always_allowed(req: ProxyRequest) -> bool:
+    """API discovery & metadata requests pass through unfiltered
+    (authz.go:205-207 allows get on /api, /apis, /openapi/v2)."""
+    info = req.request_info
+    if info is None:
+        return False
+    return (not info.is_resource_request
+            and info.verb == "get"
+            and info.path.startswith(ALWAYS_ALLOWED_PREFIXES))
+
+
+async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
+    info = req.request_info
+    user = req.user
+    if info is None:
+        return kube_status(500, "no request info")
+    if user is None:
+        return kube_status(401, "no user info")
+
+    if _always_allowed(req):
+        return await deps.upstream(req)
+
+    input = ResolveInput.create(info, user, body=req.body or None,
+                                headers=req.headers)
+
+    rules = deps.matcher.match(RequestMeta.from_request(info))
+    if not rules:
+        return kube_status(
+            403, f"user {user.name!r} cannot {info.verb} {info.resource}",
+            "Forbidden")
+    try:
+        rules = [r for r in rules if r.conditions_pass(input)]
+    except ExprError as e:
+        return kube_status(500, f"evaluating rule conditions: {e}")
+    if not rules:
+        return kube_status(
+            403, f"user {user.name!r} cannot {info.verb} {info.resource}",
+            "Forbidden")
+
+    try:
+        if not run_checks(deps.engine, rules, input):
+            return kube_status(
+                403,
+                f"user {user.name!r} is not permitted to {info.verb} "
+                f"{info.resource} {input.namespaced_name}",
+                "Forbidden")
+    except ExprError as e:
+        return kube_status(500, f"resolving checks: {e}")
+
+    # -- write path: durable dual-write --------------------------------------
+    if info.verb in WRITE_VERBS:
+        try:
+            update_rule = single_update_rule(rules)
+        except UpdateError as e:
+            return kube_status(500, str(e))
+        if update_rule is not None:
+            return await _dual_write(req, deps, update_rule, input)
+        return await deps.upstream(req)
+
+    # -- watch ----------------------------------------------------------------
+    try:
+        pf = single_prefilter(rules)
+    except PreFilterError as e:
+        return kube_status(500, str(e))
+
+    if info.verb == "watch":
+        if pf is None:
+            return await deps.upstream(req)
+        try:
+            upstream_resp = await deps.upstream(req)
+            return await filtered_watch(
+                deps.engine, upstream_resp, pf[1], input,
+                poll_interval=deps.watch_poll_interval)
+        except (PreFilterError, ExprError) as e:
+            return kube_status(500, f"watch prefilter: {e}")
+
+    # -- read path: prefilter overlap + response filtering --------------------
+    post_filters = [p for r in rules for p in r.post_filters]
+    prefilter_task = None
+    if pf is not None:
+        prefilter_task = asyncio.ensure_future(
+            run_prefilter(deps.engine, pf[1], input))
+    try:
+        resp = await deps.upstream(req)
+    except Exception:
+        if prefilter_task:
+            prefilter_task.cancel()
+        raise
+    if prefilter_task is not None:
+        try:
+            # reference waits ≤10s for the concurrent prefilter
+            # (responsefilterer.go:44,196-204)
+            allowed = await asyncio.wait_for(prefilter_task, timeout=10.0)
+        except asyncio.TimeoutError:
+            return kube_status(401, "prefilter timed out")
+        except (PreFilterError, ExprError) as e:
+            return kube_status(401, f"prefilter: {e}")
+        resp = apply_filter(resp, allowed, input)
+    if post_filters and info.verb == "list":
+        try:
+            resp = filter_list_response(deps.engine, post_filters, input, resp)
+        except ExprError as e:
+            return kube_status(401, f"postfilter: {e}")
+
+    # -- postchecks (get only; reference shouldRunPostChecks authz.go:211-220)
+    if info.verb == "get" and resp.status < 300 \
+       and any(r.post_checks for r in rules):
+        try:
+            if not run_checks(deps.engine, rules, input, post=True):
+                return kube_status(
+                    403,
+                    f"user {user.name!r} is not permitted to {info.verb} "
+                    f"{info.resource} {input.namespaced_name}",
+                    "Forbidden")
+        except ExprError as e:
+            return kube_status(500, f"resolving postchecks: {e}")
+    return resp
+
+
+async def _dual_write(req: ProxyRequest, deps: AuthzDeps, rule,
+                      input: ResolveInput) -> ProxyResponse:
+    """Launch the workflow and wait ≤30s (reference performUpdate/dualWrite,
+    update.go:53-195)."""
+    if deps.workflow is None:
+        return kube_status(500, "no workflow engine configured")
+    try:
+        wf_input = build_workflow_input(rule, input, req.uri, req.headers)
+    except (UpdateError, ExprError) as e:
+        return kube_status(500, f"resolving update: {e}")
+    mode = rule.locking or deps.default_lock_mode
+    iid = await deps.workflow.create_instance(mode, wf_input.to_dict())
+    try:
+        out = await deps.workflow.get_result(
+            iid, timeout=WORKFLOW_RESULT_TIMEOUT)
+    except WorkflowTimeout:
+        return kube_status(504, "dual-write timed out")
+    except ActivityError as e:
+        return kube_status(502, f"dual-write failed: {e}")
+    resp = KubeResp.from_activity(out)
+    headers = dict(resp.headers)
+    headers["Content-Length"] = str(len(resp.body))
+    return ProxyResponse(status=resp.status, headers=headers, body=resp.body)

@@ -149,16 +149,7 @@ class ActivityHandler:
 
 
 def _split_uri(uri: str) -> tuple[str, dict]:
-    if "?" not in uri:
-        return uri, {}
-    path, qs = uri.split("?", 1)
-    query: dict[str, list] = {}
-    for part in qs.split("&"):
-        if not part:
-            continue
-        if "=" in part:
-            k, v = part.split("=", 1)
-        else:
-            k, v = part, ""
-        query.setdefault(k, []).append(v)
-    return path, query
+    from urllib.parse import parse_qs, unquote, urlsplit
+
+    u = urlsplit(uri)
+    return unquote(u.path), parse_qs(u.query, keep_blank_values=True)

@@ -144,6 +144,9 @@ class WorkflowEngine:
             raise WorkflowTimeout(
                 f"workflow {instance_id} did not complete in {timeout}s"
             ) from None
+        finally:
+            # bound _done_events: the result lives in the DB from here on
+            self._done_events.pop(instance_id, None)
         return self._result_of(self._instance_row(instance_id))
 
     async def resume_pending(self) -> list[str]:
@@ -230,6 +233,9 @@ class WorkflowEngine:
             self._db.commit()
         ev = self._done_events.setdefault(iid, asyncio.Event())
         ev.set()
+        # waiters hold their own reference; drop ours so fire-and-forget
+        # instances don't leak one Event each
+        self._done_events.pop(iid, None)
 
     async def _run_instance(self, iid: str) -> None:
         row = self._instance_row(iid)

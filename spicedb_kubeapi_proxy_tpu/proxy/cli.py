@@ -1,0 +1,49 @@
+"""CLI entry point (reference cmd/spicedb-kubeapi-proxy/main.go:20-64).
+
+``python -m spicedb_kubeapi_proxy_tpu.proxy.cli --rule-file rules.yaml
+--upstream-url https://kube:6443 ...`` — signal-aware serve loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from .options import add_flags, options_from_args
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="spicedb-kubeapi-proxy-tpu",
+        description="TPU-native authorizing kube-apiserver proxy",
+    )
+    add_flags(parser)
+    parser.add_argument("-v", "--verbosity", type=int, default=1)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 3 else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    opts = options_from_args(args)
+    cfg = opts.complete()
+
+    async def serve():
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await cfg.run()
+        logging.info("serving on %s:%d", cfg.server.host, cfg.server.port)
+        await stop.wait()
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Options: configuration, completion, validation.
+
+Mirrors /root/reference/pkg/proxy/options.go:49-449: rule-file parsing into
+a matcher, engine endpoint selection (``embedded://`` in-process engine —
+which IS the TPU engine here, also reachable as ``tpu://`` per the
+BASELINE.json north star), workflow database path, upstream kube
+connection, authentication mode, and functional options for embedding.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..authz import AuthzDeps
+from ..dtx import ActivityHandler, WorkflowEngine, register_workflows
+from ..dtx.workflow import LOCK_MODE_OPTIMISTIC, LOCK_MODE_PESSIMISTIC
+from ..engine import Engine
+from ..rules.matcher import MapMatcher
+from .authn import HeaderAuthenticator
+from .server import Server
+from .upstream import HttpUpstream
+
+EMBEDDED_ENDPOINT = "embedded://"
+TPU_ENDPOINT = "tpu://"
+
+DEFAULT_WORKFLOW_DB = "/tmp/dtx.sqlite"  # reference options.go:41
+
+
+class OptionsError(ValueError):
+    pass
+
+
+@dataclass
+class Options:
+    # engine backend: embedded:// | tpu:// (both in-process; tpu:// is the
+    # default and runs the reachability kernels on the available JAX
+    # backend). Remote host:port engines are a later milestone.
+    engine_endpoint: str = TPU_ENDPOINT
+    bootstrap_files: list = field(default_factory=list)
+    bootstrap_content: Optional[str] = None  # yaml text
+    rule_files: list = field(default_factory=list)
+    rule_content: Optional[str] = None
+    # upstream kube-apiserver
+    upstream_url: Optional[str] = None
+    upstream_token: Optional[str] = None
+    upstream_ca_file: Optional[str] = None
+    upstream_client_cert: Optional[str] = None
+    upstream_client_key: Optional[str] = None
+    upstream_insecure: bool = False
+    # an injected upstream callable overrides the URL (embedding/tests)
+    upstream: Optional[object] = None
+    # serving
+    bind_host: str = "127.0.0.1"
+    bind_port: int = 8443
+    # dual-write
+    workflow_database_path: str = DEFAULT_WORKFLOW_DB
+    lock_mode: str = LOCK_MODE_PESSIMISTIC
+
+    def validate(self) -> None:
+        if self.engine_endpoint not in (EMBEDDED_ENDPOINT, TPU_ENDPOINT):
+            raise OptionsError(
+                f"unsupported engine endpoint {self.engine_endpoint!r} "
+                f"(supported: {EMBEDDED_ENDPOINT}, {TPU_ENDPOINT})")
+        if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
+            raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
+        if not (self.rule_files or self.rule_content):
+            raise OptionsError("at least one rule file is required")
+        if self.upstream is None and not self.upstream_url:
+            raise OptionsError("an upstream kube-apiserver URL is required")
+
+    def complete(self) -> "CompletedConfig":
+        self.validate()
+        rule_text = "\n---\n".join(
+            [open(f).read() for f in self.rule_files]
+            + ([self.rule_content] if self.rule_content else []))
+        matcher = MapMatcher.from_yaml(rule_text)
+        bootstrap = "\n---\n".join(
+            [open(f).read() for f in self.bootstrap_files]
+            + ([self.bootstrap_content] if self.bootstrap_content else []))
+        engine = Engine(bootstrap=bootstrap or None)
+        upstream = self.upstream or HttpUpstream(
+            self.upstream_url,
+            token=self.upstream_token,
+            ca_file=self.upstream_ca_file,
+            client_cert=self.upstream_client_cert,
+            client_key=self.upstream_client_key,
+            insecure_skip_verify=self.upstream_insecure,
+        )
+        workflow = WorkflowEngine(db_path=self.workflow_database_path)
+        register_workflows(workflow)
+        ActivityHandler(engine, upstream).register(workflow)
+        deps = AuthzDeps(
+            matcher=matcher, engine=engine, upstream=upstream,
+            workflow=workflow, default_lock_mode=self.lock_mode,
+        )
+        server = Server(deps, HeaderAuthenticator(),
+                        host=self.bind_host, port=self.bind_port)
+        return CompletedConfig(self, engine, workflow, deps, server)
+
+
+@dataclass
+class CompletedConfig:
+    options: Options
+    engine: Engine
+    workflow: WorkflowEngine
+    deps: AuthzDeps
+    server: Server
+
+    async def run(self) -> None:
+        """Start serving: resume pending dual-writes, listen, serve
+        (reference Server.Run errgroup, server.go:164-202)."""
+        await self.workflow.resume_pending()
+        await self.server.start()
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    """CLI flags (reference AddFlags, options.go:196-207)."""
+    parser.add_argument("--engine-endpoint", default=TPU_ENDPOINT,
+                        help="embedded:// or tpu:// (in-process TPU engine)")
+    parser.add_argument("--bootstrap", action="append", default=[],
+                        help="schema/relationships bootstrap YAML (repeatable)")
+    parser.add_argument("--rule-file", action="append", default=[],
+                        help="ProxyRule YAML file (repeatable)")
+    parser.add_argument("--upstream-url", help="upstream kube-apiserver URL")
+    parser.add_argument("--upstream-token", help="bearer token for upstream")
+    parser.add_argument("--upstream-ca-file")
+    parser.add_argument("--upstream-client-cert")
+    parser.add_argument("--upstream-client-key")
+    parser.add_argument("--upstream-insecure", action="store_true")
+    parser.add_argument("--bind-host", default="127.0.0.1")
+    parser.add_argument("--bind-port", type=int, default=8443)
+    parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
+    parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
+                        choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+
+
+def options_from_args(args: argparse.Namespace) -> Options:
+    return Options(
+        engine_endpoint=args.engine_endpoint,
+        bootstrap_files=args.bootstrap,
+        rule_files=args.rule_file,
+        upstream_url=args.upstream_url,
+        upstream_token=args.upstream_token,
+        upstream_ca_file=args.upstream_ca_file,
+        upstream_client_cert=args.upstream_client_cert,
+        upstream_client_key=args.upstream_client_key,
+        upstream_insecure=args.upstream_insecure,
+        bind_host=args.bind_host,
+        bind_port=args.bind_port,
+        workflow_database_path=args.workflow_database_path,
+        lock_mode=args.lock_mode,
+    )

@@ -61,7 +61,16 @@ def parse_request_info(method: str, path: str,
         return info  # bare discovery (/api/v1)
     info.is_resource_request = True
 
-    # namespaces/<ns>/<resource>/... except when namespaces IS the resource
+    # namespaces/<ns>/<resource>/... except when namespaces IS the resource;
+    # /namespaces/<name>/{status,finalize} are subresources OF a namespace
+    # (k8s RequestInfo special case), not namespaced resources
+    if rest[0] == "namespaces" and len(rest) == 3 and \
+            rest[2] in ("status", "finalize"):
+        info.resource = "namespaces"
+        info.name = rest[1]
+        info.subresource = rest[2]
+        _finish_verb(info, query)
+        return info
     if rest[0] == "namespaces" and len(rest) >= 3:
         info.namespace = rest[1]
         rest = rest[2:]

@@ -1,0 +1,213 @@
+"""The proxy server: asyncio HTTP/1.1 serving the authorization middleware.
+
+Mirrors /root/reference/pkg/proxy/server.go: a handler chain (panic
+recovery → logging → request-info → authentication → authorization →
+reverse proxy) mounted alongside /readyz and /livez
+(server.go:85-94,147-155). Built on stdlib asyncio streams — no external
+HTTP framework — with chunked transfer for watch streams.
+
+The handler core operates on ProxyRequest/ProxyResponse, so the exact same
+chain serves the socket listener, the in-memory transport
+(pkg/inmemory role, inmemory.py), and tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Optional
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from ..authz import AuthzDeps, authorize
+from ..proxy.authn import AuthenticationError, HeaderAuthenticator
+from ..proxy.requestinfo import parse_request_info
+from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
+from ..utils.metrics import metrics
+
+log = logging.getLogger("sdbkp.proxy")
+
+MAX_BODY = 64 * 1024 * 1024
+
+
+class Server:
+    """Serves the handler chain over TCP; also exposes `handle` for
+    in-memory clients (reference GetEmbeddedClient, server.go:303-350)."""
+
+    def __init__(self, deps: AuthzDeps,
+                 authenticator: Optional[HeaderAuthenticator] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.deps = deps
+        self.authenticator = authenticator or HeaderAuthenticator()
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    # -- handler chain -------------------------------------------------------
+
+    async def handle(self, req: ProxyRequest) -> ProxyResponse:
+        """Panic recovery → logging → request info → authn → authz."""
+        start = time.monotonic()
+        try:
+            resp = await self._handle_inner(req)
+        except Exception as e:  # panic recovery (server.go:149)
+            log.error("panic serving %s %s: %s\n%s", req.method, req.path, e,
+                      traceback.format_exc())
+            metrics.counter("proxy_panics").inc()
+            resp = kube_status(500, "internal error")
+        dur = time.monotonic() - start
+        metrics.counter("proxy_requests_total",
+                        verb=(req.request_info.verb if req.request_info
+                              else req.method),
+                        code=resp.status).inc()
+        metrics.histogram("proxy_request_seconds").observe(dur)
+        log.info("%s %s -> %d (%.1fms)", req.method, req.path, resp.status,
+                 dur * 1e3)
+        return resp
+
+    async def _handle_inner(self, req: ProxyRequest) -> ProxyResponse:
+        if req.path == "/readyz" or req.path == "/livez":
+            return ProxyResponse(status=200, body=b"ok")
+        if req.path == "/metrics":
+            return ProxyResponse(
+                status=200, headers={"Content-Type": "text/plain"},
+                body=metrics.render().encode())
+        if req.request_info is None:
+            req.request_info = parse_request_info(req.method, req.path,
+                                                  req.query)
+        if req.user is None:
+            try:
+                req.user = self.authenticator.authenticate(req.headers)
+            except AuthenticationError as e:
+                return kube_status(401, str(e), "Unauthorized")
+        return await authorize(req, self.deps)
+
+    # -- TCP serving ---------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("proxy listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await _read_request(reader)
+                if req is None:
+                    return
+                resp = await self.handle(req)
+                keep_alive = req.headers.get("Connection", "").lower() != "close"
+                await _write_response(writer, resp)
+                if resp.stream is not None or not keep_alive:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("connection handler error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+
+async def _read_request(reader: asyncio.StreamReader) -> Optional[ProxyRequest]:
+    try:
+        request_line = await reader.readline()
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    if not request_line:
+        return None
+    parts = request_line.decode("latin-1").strip().split(" ")
+    if len(parts) != 3:
+        return None
+    method, target, _version = parts
+    headers: dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode("latin-1").partition(":")
+        k, v = k.strip(), v.strip()
+        if k.lower() in ("x-remote-group",) and k in headers:
+            headers[k] = headers[k] + "," + v  # repeated group headers
+        else:
+            headers[k] = v
+    body = b""
+    if "Content-Length" in {k.title(): None for k in headers}:
+        n = int(next(v for k, v in headers.items()
+                     if k.lower() == "content-length"))
+        if n > MAX_BODY:
+            return None
+        body = await reader.readexactly(n)
+    elif any(k.lower() == "transfer-encoding"
+             and "chunked" in v.lower() for k, v in headers.items()):
+        chunks = []
+        while True:
+            size_line = await reader.readline()
+            size = int(size_line.strip().split(b";")[0], 16)
+            if size == 0:
+                await reader.readline()
+                break
+            chunks.append(await reader.readexactly(size))
+            await reader.readline()
+        body = b"".join(chunks)
+    u = urlsplit(target)
+    query = parse_qs(u.query, keep_blank_values=True)
+    return ProxyRequest(method=method, path=unquote(u.path), query=query,
+                        headers=headers, body=body)
+
+
+async def _write_response(writer: asyncio.StreamWriter,
+                          resp: ProxyResponse) -> None:
+    headers = dict(resp.headers)
+    if resp.stream is not None:
+        headers.pop("Content-Length", None)
+        headers["Transfer-Encoding"] = "chunked"
+    else:
+        headers["Content-Length"] = str(len(resp.body))
+    headers.setdefault("Content-Type", "application/json")
+    lines = [f"HTTP/1.1 {resp.status} {_reason(resp.status)}\r\n"]
+    for k, v in headers.items():
+        lines.append(f"{k}: {v}\r\n")
+    lines.append("\r\n")
+    writer.write("".join(lines).encode("latin-1"))
+    await writer.drain()
+    if resp.stream is None:
+        writer.write(resp.body)
+        await writer.drain()
+        return
+    try:
+        async for frame in resp.stream:
+            writer.write(f"{len(frame):x}\r\n".encode())
+            writer.write(frame)
+            writer.write(b"\r\n")
+            await writer.drain()
+    finally:
+        try:
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+def _reason(status: int) -> str:
+    return {
+        200: "OK", 201: "Created", 202: "Accepted", 204: "No Content",
+        400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+        404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
+        422: "Unprocessable Entity", 500: "Internal Server Error",
+        502: "Bad Gateway", 504: "Gateway Timeout",
+    }.get(status, "Status")

@@ -71,9 +71,6 @@ _TOKEN_RE = _re.compile(
     _re.VERBOSE,
 )
 
-_KEYWORDS = {"true", "false", "null", "if", "else", "let", "in"}
-
-
 @dataclass
 class _Tok:
     kind: str
@@ -524,9 +521,19 @@ class _Parser:
             while self.accept(","):
                 args.append(self.parse_expr())
         self.expect(")")
-        fn = _FUNCTIONS.get(name)
-        if fn is None:
+        raw_fn = _FUNCTIONS.get(name)
+        if raw_fn is None:
             raise ExprError(f"unknown function {name!r}")
+
+        def fn(vals, _raw=raw_fn, _name=name):
+            try:
+                return _raw(vals)
+            except ExprError:
+                raise
+            except (TypeError, ValueError, AttributeError, KeyError,
+                    IndexError) as e:
+                raise ExprError(f"{_name}(): {e}") from None
+
         if name == "has":
             # CEL has(): never throws on missing paths
             arg = args[0]
@@ -562,13 +569,25 @@ def _get_field(v, name: str):
 
 
 def _call_method(v, name: str, args: list):
+    if name == "or":
+        # .or(default) exists precisely to absorb missing/null receivers
+        if v is MISSING or v is None:
+            return args[0]
+        return v
     if v is MISSING or v is None:
         raise ExprError(f".{name}() on null")
     try:
         m = _METHODS[name]
     except KeyError:
         raise ExprError(f"unknown method .{name}()") from None
-    return m(v, args)
+    try:
+        return m(v, args)
+    except ExprError:
+        raise
+    except (TypeError, ValueError, AttributeError, KeyError, IndexError) as e:
+        # runtime type mismatches surface as recoverable expression errors
+        # (so the `|` fallback and rule-level handlers catch them)
+        raise ExprError(f".{name}(): {e}") from None
 
 
 def _m_split(v, args):
@@ -593,7 +612,6 @@ _METHODS: dict[str, Callable] = {
     "endsWith": lambda v, a: v.endswith(a[0]),
     "ends_with": lambda v, a: v.endswith(a[0]),
     "matches": lambda v, a: bool(_re.search(a[0], v)),
-    "or": lambda v, a: v,  # reached only when v is non-null
     "keys": lambda v, a: sorted(v.keys()),
     "values": lambda v, a: [v[k] for k in sorted(v.keys())],
     "exists": lambda v, a: a[0] in v,

@@ -1,0 +1,115 @@
+"""Minimal in-process metrics: counters + histograms, Prometheus text format.
+
+The reference advertises metrics support but wires no exporter of its own
+(SURVEY.md §5 — embedded SpiceDB metrics are explicitly disabled); the TPU
+build adds real ones: request counts/latency, engine checks/sec, fixpoint
+iterations, compile counts. Rendered at /metrics by the proxy server.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, buckets=None):
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.n += 1
+            self.total += v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound)."""
+        with self._lock:
+            if self.n == 0:
+                return 0.0
+            target = q * self.n
+            acc = 0
+            for i, b in enumerate(self.buckets):
+                acc += self.counts[i]
+                if acc >= target:
+                    return b
+            return float("inf")
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, Counter] = {}
+        self._hists: dict[tuple, Histogram] = {}
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = (name,) + tuple(sorted(labels.items()))
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter()
+            return c
+
+    def histogram(self, name: str, buckets=None, **labels) -> Histogram:
+        key = (name,) + tuple(sorted(labels.items()))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(buckets)
+            return h
+
+    def render(self) -> str:
+        out = []
+        with self._lock:
+            for key, c in sorted(self._counters.items()):
+                out.append(f"{_fmt(key)} {c.value}")
+            for key, h in sorted(self._hists.items()):
+                name = key[0]
+                labels = key[1:]
+                out.append(f"{_fmt((name + '_count',) + labels)} {h.n}")
+                out.append(f"{_fmt((name + '_sum',) + labels)} {h.total}")
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+
+
+def _fmt(key: tuple) -> str:
+    name = key[0]
+    labels = key[1:]
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+metrics = Registry()

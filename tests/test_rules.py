@@ -355,3 +355,23 @@ match:
 def test_rule_validation_errors(yaml_text, msg):
     with pytest.raises(RuleValidationError, match=msg):
         parse_rule_configs(yaml_text)
+
+
+def test_review_regressions_expr():
+    # .or() absorbs missing/null receivers
+    assert ev('object.metadata.labels["team"].or("unowned")',
+              {"object": {"metadata": {"labels": {}}}}) == "unowned"
+    assert ev('x.or("d")', {"x": "real"}) == "real"
+    # runtime type errors are recoverable ExprErrors, caught by `|`
+    assert ev("int(request.name) | 0", {"request": {"name": "abc"}}) == 0
+    with pytest.raises(ExprError):
+        ev("request.name.length()", {"request": {"name": 5}})
+
+
+def test_namespace_subresources_requestinfo():
+    from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
+    i = parse_request_info("PUT", "/api/v1/namespaces/default/finalize")
+    assert (i.resource, i.name, i.subresource, i.namespace) == \
+        ("namespaces", "default", "finalize", "")
+    i2 = parse_request_info("GET", "/api/v1/namespaces/default/pods")
+    assert (i2.resource, i2.namespace, i2.verb) == ("pods", "default", "list")

@@ -1,0 +1,177 @@
+"""Headline benchmark: the BASELINE.md north-star config.
+
+Filter a 100k-pod list response against a 10M-relationship graph on one
+chip — the reference's prefilter/list hot path (SURVEY.md §3.3:
+runLookupResources + filterList) executed as one slot-space reachability
+query (`Engine.lookup_resources_mask`). Also reports bulk-check throughput
+(reference CheckBulkPermissions path, SURVEY.md §3.2) on stderr.
+
+Prints ONE JSON line on stdout:
+    {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}
+vs_baseline is the 50 ms BASELINE.json target divided by the measured p50
+(>1.0 means the target is beaten).
+
+Usage: python bench.py [--quick]   (--quick: small graph, CPU-friendly)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BENCH_SCHEMA = """
+use expiration
+
+definition user {}
+definition group {
+  relation member: user
+}
+definition namespace {
+  relation creator: user
+  relation viewer: user | group#member
+  permission admin = creator
+  permission view = viewer + creator
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user
+  permission edit = creator
+  permission view = viewer + creator + namespace->view
+}
+"""
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_engine(n_pods: int, n_users: int, n_ns: int, n_groups: int,
+                 n_rels: int, seed: int = 0):
+    """Synthesize the graph columnar-side (no per-row Python objects)."""
+    from spicedb_kubeapi_proxy_tpu.engine import Engine
+    from spicedb_kubeapi_proxy_tpu.models import parse_schema
+
+    rng = np.random.default_rng(seed)
+    pods = np.char.add("ns/p", np.arange(n_pods).astype(str))
+    users = np.char.add("u", np.arange(n_users).astype(str))
+    groups = np.char.add("g", np.arange(n_groups).astype(str))
+    nss = np.char.add("ns", np.arange(n_ns).astype(str))
+
+    cols = {k: [] for k in ("resource_type", "resource_id", "relation",
+                            "subject_type", "subject_id", "subject_relation")}
+
+    def add(rt, rid, rl, st, sid, srl=None):
+        n = len(rid)
+        cols["resource_type"].append(np.full(n, rt))
+        cols["resource_id"].append(rid)
+        cols["relation"].append(np.full(n, rl))
+        cols["subject_type"].append(np.full(n, st))
+        cols["subject_id"].append(sid)
+        cols["subject_relation"].append(
+            np.full(n, srl if srl is not None else ""))
+
+    # group membership: ~20 users per group
+    gm = min(20 * n_groups, n_rels // 20)
+    add("group", groups[rng.integers(n_groups, size=gm)], "member",
+        "user", users[rng.integers(n_users, size=gm)])
+    # namespace viewer grants via groups (2 per ns) — exercises the
+    # group#member userset + namespace->view arrow rewrite chain
+    nv = 2 * n_ns
+    add("namespace", nss[rng.integers(n_ns, size=nv)], "viewer",
+        "group", groups[rng.integers(n_groups, size=nv)], "member")
+    # every pod lives in a namespace
+    pod_ns = np.char.add("ns", rng.integers(n_ns, size=n_pods).astype(str))
+    add("pod", pods, "namespace", "namespace", pod_ns)
+    # the rest: flat pod#viewer@user direct grants, deduplicated
+    n_flat = n_rels - gm - nv - n_pods
+    pair = rng.integers(0, n_pods * n_users, size=int(n_flat * 1.01),
+                        dtype=np.int64)
+    pair = np.unique(pair)[:n_flat]
+    rng.shuffle(pair)
+    add("pod", pods[pair // n_users], "viewer", "user", users[pair % n_users])
+
+    rels_cols = {k: np.concatenate(v) for k, v in cols.items()}
+    total = len(rels_cols["resource_id"])
+    log(f"built columns: {total} relationships")
+
+    e = Engine(schema=parse_schema(BENCH_SCHEMA))
+    t0 = time.perf_counter()
+    e.bulk_load(rels_cols)
+    log(f"bulk_load: {time.perf_counter() - t0:.1f}s")
+    return e, total
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small graph (CI / CPU smoke)")
+    ap.add_argument("--trials", type=int, default=21)
+    args = ap.parse_args()
+
+    if args.quick:
+        n_pods, n_users, n_ns, n_groups, n_rels = 2_000, 500, 50, 50, 50_000
+    else:
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            100_000, 10_000, 1_000, 1_000, 10_000_000)
+
+    import jax
+
+    log(f"jax {jax.__version__} devices={jax.devices()}")
+    e, total = build_engine(n_pods, n_users, n_ns, n_groups, n_rels)
+
+    t0 = time.perf_counter()
+    cg = e.compiled()
+    log(f"compile_graph: {time.perf_counter() - t0:.1f}s "
+        f"(M={cg.M} slots, E={cg.n_edges} edges)")
+
+    # -- p50 list-filter latency: one user's visibility mask over all pods --
+    rng = np.random.default_rng(1)
+    subjects = [f"u{rng.integers(n_users)}" for _ in range(args.trials)]
+    t0 = time.perf_counter()
+    mask, _ = e.lookup_resources_mask("pod", "view", "user", subjects[0])
+    log(f"warmup (jit compile + run): {time.perf_counter() - t0:.1f}s; "
+        f"visible={int(mask.sum())}/{n_pods}")
+    lat = []
+    for u in subjects:
+        t0 = time.perf_counter()
+        mask, _ = e.lookup_resources_mask("pod", "view", "user", u)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(lat, 50))
+    p99 = float(np.percentile(lat, 99))
+    log(f"list-filter latency over {len(lat)} trials: "
+        f"p50={p50:.2f}ms p99={p99:.2f}ms")
+
+    # -- bulk-check throughput (stderr only) --
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem
+
+    B, per = (8, 64) if args.quick else (64, 1024)
+    items = [
+        CheckItem("pod", f"ns/p{rng.integers(n_pods)}", "view",
+                  "user", f"u{b}")
+        for b in rng.integers(n_users, size=B)
+        for _ in range(per)
+    ]
+    e.check_bulk(items[: B * per])  # warmup shape
+    t0 = time.perf_counter()
+    e.check_bulk(items)
+    dt = time.perf_counter() - t0
+    checks_per_s = len(items) / dt
+    log(f"bulk check: {len(items)} checks in {dt * 1e3:.1f}ms "
+        f"= {checks_per_s:,.0f} checks/s/chip")
+
+    print(json.dumps({
+        "metric": (
+            f"p50 list-filter latency, {n_pods} pods @ {total} rels, 1 chip"),
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(50.0 / p50, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,7 +97,11 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
             "Forbidden")
 
     try:
-        if not run_checks(deps.engine, rules, input):
+        # to_thread keeps the event loop free while the device query's
+        # readback is in flight (concurrent requests pipeline their
+        # dispatches; the reference fans checks out over goroutines,
+        # check.go:77-93)
+        if not await asyncio.to_thread(run_checks, deps.engine, rules, input):
             return kube_status(
                 403,
                 f"user {user.name!r} is not permitted to {info.verb} "
@@ -157,7 +161,8 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
         resp = apply_filter(resp, allowed, input)
     if post_filters and info.verb == "list":
         try:
-            resp = filter_list_response(deps.engine, post_filters, input, resp)
+            resp = await asyncio.to_thread(
+                filter_list_response, deps.engine, post_filters, input, resp)
         except ExprError as e:
             return kube_status(401, f"postfilter: {e}")
 
@@ -165,7 +170,8 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
     if info.verb == "get" and resp.status < 300 \
        and any(r.post_checks for r in rules):
         try:
-            if not run_checks(deps.engine, rules, input, post=True):
+            if not await asyncio.to_thread(
+                    run_checks, deps.engine, rules, input, post=True):
                 return kube_status(
                     403,
                     f"user {user.name!r} is not permitted to {info.verb} "

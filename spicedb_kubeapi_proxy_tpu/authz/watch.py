@@ -39,6 +39,14 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     rel = pf.rel.generate(input)[0]
     base_data = input.template_data()
 
+    # Capture the revision BEFORE the prefilter snapshot: a grant landing
+    # between the two is then re-checked by the event loop (idempotent)
+    # instead of being lost. Running the prefilter eagerly (not inside the
+    # streaming generator) also lets PreFilterError surface as a 500 before
+    # the 200/chunked headers are committed.
+    start_rev = engine.revision
+    allowed = await run_prefilter(engine, pf, input)
+
     def map_id(obj_id: str) -> Optional[tuple[str, str]]:
         data = dict(base_data)
         data["resourceId"] = obj_id
@@ -51,9 +59,7 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
         return (ns or "", name)
 
     async def frames() -> AsyncIterator[bytes]:
-        # initial allowed set (the prefilter lookup)
-        allowed = await run_prefilter(engine, pf, input)
-        last_rev = engine.revision
+        last_rev = start_rev
         buffered: dict[tuple, bytes] = {}
         frame_q: asyncio.Queue = asyncio.Queue()
 
@@ -76,7 +82,7 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                         if e.relationship.resource_type == rel.resource_type
                     })
                     if ids:
-                        results = engine.check_bulk([
+                        results = await asyncio.to_thread(engine.check_bulk, [
                             CheckItem(rel.resource_type, oid,
                                       rel.resource_relation,
                                       rel.subject_type, rel.subject_id,

@@ -54,6 +54,21 @@ class WatchEvent:
     relationship: Relationship
 
 
+class EngineFuture:
+    """A dispatched engine query: ``result()`` blocks and post-processes.
+    ``fut`` is a :class:`~...ops.reachability.QueryFuture` or ``None`` for
+    trivially-resolved queries."""
+
+    __slots__ = ("_fut", "_fin")
+
+    def __init__(self, fut, fin):
+        self._fut = fut
+        self._fin = fin
+
+    def result(self):
+        return self._fin(None if self._fut is None else self._fut.result())
+
+
 class Engine:
     """In-process relationship-graph engine (the ``embedded://`` / ``tpu://``
     backend). Thread-safe."""
@@ -169,8 +184,14 @@ class Engine:
         """CheckBulkPermissions: evaluate all items in one device pass,
         batching distinct subjects along B (reference check.go:22-48 issues
         one bulk RPC per request; here the whole bulk is one fixpoint)."""
+        return self.check_bulk_async(items, now=now).result()
+
+    def check_bulk_async(self, items: list[CheckItem],
+                         now: Optional[float] = None) -> "EngineFuture":
+        """Dispatch a bulk check without blocking (device→host readback
+        overlaps with other in-flight queries); ``.result()`` to wait."""
         if not items:
-            return []
+            return EngineFuture(None, lambda _: [])
         cg = self.compiled()
         objs = self._objects_by_name()
         subjects: dict[tuple, int] = {}
@@ -191,8 +212,8 @@ class Engine:
                                           it.resource_id, objs)
             q_batch[i] = row
         seeds = np.asarray(seed_rows, dtype=np.int32)
-        out = cg.query(seeds, q_slots, q_batch, now=now)
-        return [bool(x) for x in out]
+        fut = cg.query_async(seeds, q_slots, q_batch, now=now)
+        return EngineFuture(fut, lambda out: [bool(x) for x in out])
 
     def lookup_resources(self, resource_type: str, permission: str,
                          subject_type: str, subject_id: str,
@@ -219,23 +240,41 @@ class Engine:
         (bool mask over the type's object index space, per-type interner).
         Callers with a list of candidate names map name->index and test the
         mask directly — no per-object RPC or string materialization."""
+        return self.lookup_resources_mask_async(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now,
+        ).result()
+
+    def lookup_resources_mask_async(self, resource_type: str, permission: str,
+                                    subject_type: str, subject_id: str,
+                                    subject_relation: Optional[str] = None,
+                                    now: Optional[float] = None):
+        """Non-blocking mask lookup; ``.result()`` -> (mask, interner).
+        Concurrent list requests dispatch back-to-back and overlap their
+        readbacks — the reference's goroutine-per-prefilter overlap
+        (pkg/authz/responsefilterer.go:165-183) without the goroutines."""
         cg = self.compiled()
         objs = self._objects_by_name()
         off = cg.offset_of(resource_type, permission)
         n = cg.type_sizes.get(resource_type)
         interner = objs.get(resource_type)
         if off is None or interner is None:
-            return None, None
+            return EngineFuture(None, lambda _: (None, None))
         seeds = np.asarray(
             [cg.encode_subject(subject_type, subject_id, subject_relation, objs)],
             dtype=np.int32,
         )
         q_slots = off + np.arange(n, dtype=np.int32)
         q_batch = np.zeros(n, dtype=np.int32)
-        out = np.array(cg.query(seeds, q_slots, q_batch, now=now))
-        out[0] = False  # void
-        out[1] = False  # wildcard pseudo-object
-        return out, interner
+        fut = cg.query_async(seeds, q_slots, q_batch, now=now)
+
+        def fin(out):
+            out = np.array(out)
+            out[0] = False  # void
+            out[1] = False  # wildcard pseudo-object
+            return out, interner
+
+        return EngineFuture(fut, fin)
 
     # -- watch --------------------------------------------------------------
 

@@ -41,10 +41,27 @@ class Interner:
         return self._to_str[i]
 
     def intern_many(self, strings) -> np.ndarray:
-        """Intern a sequence of strings, returning int32 ids."""
+        """Intern a sequence of strings, returning int32 ids.
+
+        Vectorized for bulk loads: one ``np.unique`` pass over the column,
+        then a Python loop only over the (typically tiny) vocabulary. New
+        ids are assigned in sorted-unique order rather than first-occurrence
+        order — callers never depend on id assignment order.
+        """
+        n = len(strings)
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
+        if n > 1024:
+            arr = np.asarray(strings)
+            uniq, inv = np.unique(arr, return_inverse=True)
+            ids = np.fromiter(
+                (self.intern(s) for s in uniq.tolist()),
+                dtype=np.int32, count=len(uniq),
+            )
+            return ids[inv.reshape(-1)]
         to_id = self._to_id
         to_str = self._to_str
-        out = np.empty(len(strings), dtype=np.int32)
+        out = np.empty(n, dtype=np.int32)
         for k, s in enumerate(strings):
             i = to_id.get(s)
             if i is None:

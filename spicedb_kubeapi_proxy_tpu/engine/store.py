@@ -347,11 +347,12 @@ class Store:
 
             def intern_typed(type_col, id_col):
                 tids = self.types.intern_many(type_col)
+                ids = np.asarray(id_col)
                 out = np.empty(n, dtype=np.int32)
                 for tid in np.unique(tids).tolist():
                     sel = tids == tid
                     out[sel] = self._obj_interner(int(tid)).intern_many(
-                        [id_col[i] for i in np.flatnonzero(sel).tolist()]
+                        ids[sel]
                     )
                 return tids, out
 

@@ -44,6 +44,7 @@ re-specializes only when a bucket grows.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -77,6 +78,10 @@ DEFAULT_MAX_ITERS = 128
 _JIT_CACHE: dict = {}
 _JIT_CACHE_MAX = 32
 
+# serializes lazy device-state init across worker threads (one lock for all
+# graphs: init is rare — once per store revision — and never nests)
+_DEV_INIT_LOCK = threading.Lock()
+
 
 class ConvergenceError(RuntimeError):
     """The fixpoint hit its iteration budget before converging — the analog
@@ -90,6 +95,35 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+@dataclass
+class _BlockMeta:
+    """One dense relation block: edges between a (src slot range, dst slot
+    range) pair compiled to a dense int8 matrix ``A[n_dst, n_src]`` so one
+    propagation hop over the block is an MXU matmul ``A @ V[src_range]``
+    instead of elementwise gathers (TPU gathers are scalar-bound; matmuls
+    stream at HBM bandwidth). Only never-expiring edges are eligible —
+    expiring edges stay on the residual gather/segment path where the
+    query-time clock masks them."""
+
+    dst_off: int
+    n_dst: int
+    src_off: int
+    n_src: int
+    # host-side local edge coordinates used to materialize A on device
+    dst_local: np.ndarray
+    src_local: np.ndarray
+
+
+# dense-block eligibility: a block must carry enough edges to beat the
+# segment path (DENSE_MIN_EDGES), must fit in memory (DENSE_MAX_CELLS), and
+# big blocks must additionally be dense enough that streaming A beats
+# scalar gathers (DENSE_MIN_DENSITY)
+DENSE_MIN_EDGES = 1024
+DENSE_MIN_CELLS = 1 << 24  # 16M cells (16 MiB int8) — density waived below
+DENSE_MIN_DENSITY = 5e-4
+DENSE_MAX_CELLS = 3 << 30  # 3 GiB
 
 
 @dataclass
@@ -115,12 +149,19 @@ class CompiledGraph:
     slot_offset: dict  # (type_name, rel_name) -> offset
     type_sizes: dict  # type_name -> object count (incl. void/wildcard)
     # host edge arrays, sorted by dst, padded to bucket; pad rows point at
-    # the trash slot with -inf expiration (never valid)
+    # the trash slot with -inf expiration (never valid). The FULL edge set
+    # lives here (the sharded path consumes it directly); the single-chip
+    # path splits it into dense blocks + a residual at _dev() time.
     src: np.ndarray
     dst: np.ndarray
     exp_rel: np.ndarray  # float32 seconds relative to base_time; +inf = never
     n_edges: int
     programs: list  # topo-ordered _PermProgram list
+    # dense-block split (see _BlockMeta): blocks cover the big never-expiring
+    # relation ranges; res_idx indexes the edges that stay on the
+    # gather/segment path (expiring, tiny, or too-sparse-to-densify)
+    blocks: list = field(default_factory=list)
+    res_idx: Optional[np.ndarray] = None
     # lazily-populated device state
     _device: dict = field(default_factory=dict)
 
@@ -201,14 +242,52 @@ class CompiledGraph:
             self.M,
             tuple((p.dst_off, p.size, expr_sig(p.expr, p.leaf_off))
                   for p in self.programs),
+            tuple((b.dst_off, b.n_dst, b.src_off, b.n_src)
+                  for b in self.blocks),
+            # padded residual length: the only residual property that is
+            # baked into traced shapes (edge values are runtime args)
+            -1 if self.res_idx is None
+            else _next_bucket(max(len(self.res_idx), 1)),
         )
 
     def _dev(self):
+        # concurrent first queries (asyncio.to_thread workers) race to
+        # initialize; build into a local dict and publish atomically
         d = self._device
         if not d:
-            d["src"] = jnp.asarray(self.src)
-            d["dst"] = jnp.asarray(self.dst)
-            d["exp"] = jnp.asarray(self.exp_rel)
+            with _DEV_INIT_LOCK:
+                return self._dev_locked()
+        return d
+
+    def _dev_locked(self):
+        d = self._device
+        if not d:
+            d = {}
+            if self.res_idx is None:
+                # no dense split computed: everything rides the segment path
+                res_src, res_dst, res_exp = self.src, self.dst, self.exp_rel
+            else:
+                n_res = len(self.res_idx)
+                E_pad = _next_bucket(max(n_res, 1))
+                res_src = np.full(E_pad, self.M, dtype=np.int32)
+                res_dst = np.full(E_pad, self.M, dtype=np.int32)
+                res_exp = np.full(E_pad, -np.inf, dtype=np.float32)
+                # res_idx is ascending into dst-sorted edge arrays, so the
+                # residual stays dst-sorted (indices_are_sorted=True relies
+                # on this)
+                res_src[:n_res] = self.src[self.res_idx]
+                res_dst[:n_res] = self.dst[self.res_idx]
+                res_exp[:n_res] = self.exp_rel[self.res_idx]
+            d["src"] = jnp.asarray(res_src)
+            d["dst"] = jnp.asarray(res_dst)
+            d["exp"] = jnp.asarray(res_exp)
+
+            d["blocks"] = tuple(
+                jnp.zeros((b.n_dst, b.n_src), dtype=jnp.int8)
+                .at[jnp.asarray(b.dst_local), jnp.asarray(b.src_local)]
+                .set(1)
+                for b in self.blocks
+            )
             sig = self.signature()
             run = _JIT_CACHE.get(sig)
             if run is None:
@@ -218,17 +297,25 @@ class CompiledGraph:
                     _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
                 _JIT_CACHE[sig] = run
             d["run"] = run
-        return d
+            self._device = d
+        return self._device
 
-    def query(
+    def query_async(
         self,
         seed_slots: np.ndarray,  # int32 [B, 2] (subject slot, wildcard slot)
         q_slots: np.ndarray,  # int32 [Q]
         q_batch: np.ndarray,  # int32 [Q] batch row per query
         now: Optional[float] = None,
         max_iters: int = DEFAULT_MAX_ITERS,
-    ) -> np.ndarray:
-        """Run the fixpoint; returns bool [Q]."""
+    ) -> "QueryFuture":
+        """Dispatch the fixpoint without blocking.
+
+        The device→host copy is started eagerly (``copy_to_host_async``) so
+        concurrent queries overlap their readback latency — the analog of
+        the reference overlapping its LookupResources RPC with the upstream
+        kube request (pkg/authz/responsefilterer.go:165-183). Call
+        ``.result()`` on the returned future to wait.
+        """
         d = self._dev()
         B = seed_slots.shape[0]
         Q = len(q_slots)
@@ -242,16 +329,48 @@ class CompiledGraph:
         qb[:Q] = q_batch
         now_rel = np.float32((time.time() if now is None else now) - self.base_time)
         out, converged = d["run"](
-            d["src"], d["dst"], d["exp"],
+            d["blocks"], d["src"], d["dst"], d["exp"],
             jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
             now_rel, max_iters=max_iters,
         )
-        if not bool(converged):
+        try:
+            out.copy_to_host_async()
+            converged.copy_to_host_async()
+        except AttributeError:  # non-jax array backends in tests
+            pass
+        return QueryFuture(out, converged, Q, max_iters)
+
+    def query(
+        self,
+        seed_slots: np.ndarray,
+        q_slots: np.ndarray,
+        q_batch: np.ndarray,
+        now: Optional[float] = None,
+        max_iters: int = DEFAULT_MAX_ITERS,
+    ) -> np.ndarray:
+        """Run the fixpoint synchronously; returns bool [Q]."""
+        return self.query_async(
+            seed_slots, q_slots, q_batch, now=now, max_iters=max_iters
+        ).result()
+
+
+@dataclass
+class QueryFuture:
+    """A dispatched reachability query. ``result()`` blocks and validates
+    convergence."""
+
+    _out: object
+    _converged: object
+    _q: int
+    _max_iters: int
+
+    def result(self) -> np.ndarray:
+        if not bool(self._converged):
             raise ConvergenceError(
-                f"reachability did not converge within {max_iters} iterations "
-                "(graph deeper than the dispatch budget)"
+                f"reachability did not converge within {self._max_iters} "
+                "iterations (graph deeper than the dispatch budget)"
             )
-        return np.asarray(out)[:Q]
+        return np.asarray(self._out)[: self._q]
 
 
 def _apply_program(cg: CompiledGraph, V):
@@ -283,13 +402,39 @@ def _apply_program(cg: CompiledGraph, V):
     return V
 
 
-def _run(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots, q_batch,
-         now_rel, *, max_iters: int):
+def _propagate(cg: CompiledGraph, blocks, src, dst, valid, V):
+    """One hop: dense relation blocks as MXU matmuls + residual edges as a
+    gather/segment-max. Returns prop [M+1, B] uint8."""
+    Mp1 = cg.M + 1
+    B = V.shape[1]
+    # residual (expiring / sparse / tiny) edges: gather + segment-max
+    gathered = V[src] & valid[:, None]  # [E_res, B]
+    prop = jax.ops.segment_max(
+        gathered, dst, num_segments=Mp1, indices_are_sorted=True
+    )
+    # dense blocks: A[n_dst, n_src] @ V[src_range] on the MXU; >0 -> reached
+    for bm, A in zip(cg.blocks, blocks):
+        frontier = jax.lax.dynamic_slice(
+            V, (bm.src_off, 0), (bm.n_src, B)
+        ).astype(jnp.int8)
+        contrib = (
+            jnp.dot(A, frontier, preferred_element_type=jnp.int32) > 0
+        ).astype(jnp.uint8)
+        cur = jax.lax.dynamic_slice(prop, (bm.dst_off, 0), (bm.n_dst, B))
+        prop = jax.lax.dynamic_update_slice(
+            prop, cur | contrib, (bm.dst_off, 0)
+        )
+    return prop
+
+
+def _run(cg: CompiledGraph, blocks, src, dst, exp_rel, seeds, q_slots,
+         q_batch, now_rel, *, max_iters: int):
     """The jitted fixpoint. V layout: [M+1, B] uint8 (slot-major so the
-    segment reduction runs over the leading axis)."""
+    segment reduction runs over the leading axis and dense blocks matmul
+    directly against slot ranges)."""
     B = seeds.shape[0]
     Mp1 = cg.M + 1
-    valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E]
+    valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E_res]
 
     brange = jnp.arange(B, dtype=jnp.int32)
     base = jnp.zeros((Mp1, B), dtype=jnp.uint8)
@@ -300,10 +445,7 @@ def _run(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots, q_batch,
     base = _apply_program(cg, base)
 
     def step(V):
-        gathered = V[src] & valid[:, None]  # [E, B]
-        prop = jax.ops.segment_max(
-            gathered, dst, num_segments=Mp1, indices_are_sorted=True
-        )
+        prop = _propagate(cg, blocks, src, dst, valid, V)
         return _apply_program(cg, prop | base)
 
     def cond(state):
@@ -513,6 +655,47 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     dst_p[:n_edges] = dst
     exp_p[:n_edges] = exp
 
+    # ---- dense/residual split (single-chip MXU path) ----
+    # ranges: every (type, rel) slot range, ascending; edges map to a
+    # (dst range, src range) pair by binary search
+    range_items = sorted(slot_offset.items(), key=lambda kv: kv[1])
+    offs = np.asarray([o for _, o in range_items], dtype=np.int64)
+    sizes = np.asarray(
+        [type_sizes[t] for (t, _), _ in range_items], dtype=np.int64
+    )
+    blocks: list[_BlockMeta] = []
+    res_parts: list[np.ndarray] = []
+    if n_edges:
+        never_expires = exp == np.inf
+        dst_rid = np.searchsorted(offs, dst, side="right") - 1
+        src_rid = np.searchsorted(offs, src, side="right") - 1
+        key = dst_rid * len(offs) + src_rid
+        # expiring edges always ride the residual path (query-time clock)
+        key = np.where(never_expires, key, -1)
+        uniq, inv, counts = np.unique(key, return_inverse=True,
+                                      return_counts=True)
+        for ui, (k, cnt) in enumerate(zip(uniq.tolist(), counts.tolist())):
+            sel = np.flatnonzero(inv == ui)
+            if k < 0:
+                res_parts.append(sel)
+                continue
+            d_rid, s_rid = divmod(k, len(offs))
+            n_dst, n_src = int(sizes[d_rid]), int(sizes[s_rid])
+            cells = n_dst * n_src
+            if (cnt < DENSE_MIN_EDGES or cells > DENSE_MAX_CELLS
+                    or (cells > DENSE_MIN_CELLS
+                        and cnt / cells < DENSE_MIN_DENSITY)):
+                res_parts.append(sel)
+                continue
+            blocks.append(_BlockMeta(
+                dst_off=int(offs[d_rid]), n_dst=n_dst,
+                src_off=int(offs[s_rid]), n_src=n_src,
+                dst_local=(dst[sel] - offs[d_rid]).astype(np.int32),
+                src_local=(src[sel] - offs[s_rid]).astype(np.int32),
+            ))
+    res_idx = (np.sort(np.concatenate(res_parts)) if res_parts
+               else np.empty(0, dtype=np.int64))
+
     # ---- elementwise programs ----
     programs: list[_PermProgram] = []
     for tname in sorted(schema.definitions):
@@ -558,4 +741,6 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         exp_rel=exp_p,
         n_edges=n_edges,
         programs=programs,
+        blocks=blocks,
+        res_idx=res_idx,
     )

@@ -1,0 +1,19 @@
+"""Device-mesh sharding of the reachability engine.
+
+The reference scales by delegating graph traversal to SpiceDB and fanning
+requests out over goroutines (SURVEY.md §2.5); here the same two scale
+dimensions map onto a 2-D ``jax.sharding.Mesh``:
+
+- ``graph`` axis — the edge tensor is sharded across chips (the reference's
+  "bigger graph than one machine" dimension; SpiceDB horizontal dispatch).
+  Each chip propagates over its edge shard and the shards are joined with a
+  collective max over ICI each fixpoint step.
+- ``data`` axis — the query batch (concurrent requests: bulk checks, list
+  prefilters) is sharded across chips, the analog of the reference's
+  per-request goroutine fan-out (pkg/authz/check.go:77-93).
+"""
+
+from .mesh import make_mesh
+from .sharded import ShardedGraph
+
+__all__ = ["make_mesh", "ShardedGraph"]

@@ -1,0 +1,63 @@
+"""Mesh construction for the sharded engine.
+
+One 2-D mesh, axes ``("data", "graph")``:
+
+- ``data``  — data parallelism over concurrent queries (requests).
+- ``graph`` — edge-tensor parallelism within one query (the model/tensor
+  axis of this workload: the graph, not weights, is the big operand).
+
+Axis sizes must multiply to the device count. By default the graph axis
+takes as many devices as possible while keeping the data axis at least 2
+when there are at least 4 devices — list-filter latency (BASELINE.md
+target) is bounded by per-query propagation, which only the graph axis
+accelerates, while throughput under concurrency (BASELINE config 5) comes
+from the data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    data: Optional[int] = None,
+    graph: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the ``("data", "graph")`` mesh over ``n_devices`` devices.
+
+    Any of ``data`` / ``graph`` may be given; missing sizes are derived.
+    ``devices`` overrides the device list (defaults to ``jax.devices()``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = list(devices)[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(devices)} available"
+        )
+    if data is None and graph is None:
+        data = 2 if n_devices >= 4 and n_devices % 2 == 0 else 1
+        graph = n_devices // data
+    elif data is None:
+        if n_devices % graph:
+            raise ValueError(f"graph={graph} does not divide {n_devices}")
+        data = n_devices // graph
+    elif graph is None:
+        if n_devices % data:
+            raise ValueError(f"data={data} does not divide {n_devices}")
+        graph = n_devices // data
+    if data * graph != n_devices:
+        raise ValueError(
+            f"data*graph = {data}*{graph} != n_devices = {n_devices}"
+        )
+    import numpy as np
+
+    arr = np.asarray(devices).reshape(data, graph)
+    return Mesh(arr, axis_names=("data", "graph"))

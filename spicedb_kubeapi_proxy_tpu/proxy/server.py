@@ -107,7 +107,9 @@ class Server:
                 if req is None:
                     return
                 resp = await self.handle(req)
-                keep_alive = req.headers.get("Connection", "").lower() != "close"
+                conn_hdr = next((v for k, v in req.headers.items()
+                                 if k.lower() == "connection"), "")
+                keep_alive = conn_hdr.lower() != "close"
                 await _write_response(writer, resp)
                 if resp.stream is not None or not keep_alive:
                     return
@@ -155,12 +157,16 @@ async def _read_request(reader: asyncio.StreamReader) -> Optional[ProxyRequest]:
     elif any(k.lower() == "transfer-encoding"
              and "chunked" in v.lower() for k, v in headers.items()):
         chunks = []
+        total = 0
         while True:
             size_line = await reader.readline()
             size = int(size_line.strip().split(b";")[0], 16)
             if size == 0:
                 await reader.readline()
                 break
+            total += size
+            if total > MAX_BODY:  # same cap as Content-Length bodies
+                return None
             chunks.append(await reader.readexactly(size))
             await reader.readline()
         body = b"".join(chunks)

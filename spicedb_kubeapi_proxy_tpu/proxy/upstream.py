@@ -55,9 +55,19 @@ class HttpUpstream:
             headers = {k: v for k, v in req.headers.items()
                        if k.lower() not in HOP_HEADERS
                        and not k.lower().startswith("x-remote-")
-                       and k.lower() != "authorization"}
+                       and k.lower() not in ("authorization", "accept")}
             headers["Host"] = f"{self.host}:{self.port}"
-            headers["Accept"] = headers.get("Accept", "application/json")
+            # the filterer can only parse JSON, so strip every non-JSON
+            # media range from the Accept before forwarding (client-go
+            # defaults to 'application/vnd.kubernetes.protobuf,
+            # application/json' — forwarding that verbatim would let the
+            # apiserver negotiate protobuf); JSON ranges incl. ;as=Table
+            # pass through
+            accept = next((v for k, v in req.headers.items()
+                           if k.lower() == "accept"), "")
+            accept = ",".join(r for r in accept.split(",")
+                              if "json" in r.lower()) or "application/json"
+            headers["Accept"] = accept
             headers["Connection"] = "close"
             if self.token:
                 headers["Authorization"] = f"Bearer {self.token}"

@@ -428,6 +428,40 @@ def test_tpu_matches_oracle_fuzz():
         )
 
 
+def test_dense_block_path_matches_oracle(monkeypatch):
+    """Force the dense MXU block path (normally >=1024 edges per block) on
+    the fuzz graphs and assert oracle parity — covers block splitting,
+    local-coordinate construction, and the matmul hop (review finding:
+    blocks path untested at default thresholds)."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    rng = np.random.default_rng(7)
+    e = Engine(schema=parse_schema(INTERSECT_SCHEMA))
+    users = [f"u{i}" for i in range(6)]
+    ops = set()
+    for g in range(4):
+        for u in rng.choice(users, size=2, replace=False):
+            ops.add(f"group:g{g}#member@user:{u}")
+    for d in range(10):
+        for u in rng.choice(users, size=2, replace=False):
+            ops.add(f"doc:d{d}#reader@user:{u}")
+        ops.add(f"doc:d{d}#owner@user:{rng.choice(users)}")
+        if rng.random() < 0.5:
+            ops.add(f"doc:d{d}#banned@user:{rng.choice(users)}")
+        ops.add(f"doc:d{d}#reader@group:g{rng.integers(4)}#member")
+        ops.add(f"doc:d{d}#org@org:o{rng.integers(3)}")
+    for o_ in range(3):
+        ops.add(f"org:o{o_}#admin@user:{rng.choice(users)}")
+    e.write_relationships(touch(*ops))
+    cg = e.compiled()
+    assert cg.blocks, "expected dense blocks with DENSE_MIN_EDGES=1"
+    assert len(cg.res_idx) < cg.n_edges, "some edges must leave the residual"
+    assert_engine_matches_oracle(
+        e, subjects=[("user", u) for u in users] + [("user", "nobody")]
+    )
+
+
 def test_check_bulk_mixed_subjects_and_unknowns():
     e = make_engine(
         "namespace:ns1#creator@user:alice",

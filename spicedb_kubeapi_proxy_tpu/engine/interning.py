@@ -52,7 +52,10 @@ class Interner:
         if n == 0:
             return np.empty(0, dtype=np.int32)
         if n > 1024:
-            arr = np.asarray(strings)
+            # object dtype keeps elements pointer-sized; a fixed-width
+            # unicode array would cost 4*maxlen bytes per element (one long
+            # outlier id would blow up a 10M-row column)
+            arr = np.asarray(strings, dtype=object)
             uniq, inv = np.unique(arr, return_inverse=True)
             ids = np.fromiter(
                 (self.intern(s) for s in uniq.tolist()),

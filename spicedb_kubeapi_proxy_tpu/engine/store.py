@@ -347,7 +347,8 @@ class Store:
 
             def intern_typed(type_col, id_col):
                 tids = self.types.intern_many(type_col)
-                ids = np.asarray(id_col)
+                # object dtype: avoid 4*maxlen-per-element fixed-width unicode
+                ids = np.asarray(id_col, dtype=object)
                 out = np.empty(n, dtype=np.int32)
                 for tid in np.unique(tids).tolist():
                     sel = tids == tid

@@ -6,6 +6,16 @@ from typing import Iterable, Optional
 
 import numpy as np
 
+from .. import native
+
+
+def _as_str(s) -> str:
+    """Every path interns str keys: bytes columns ('S' dtype, or bytes
+    elements in lists) decode identically whether they ride the native
+    hash-unique, np.unique, or the small-column dict loop. surrogateescape
+    keeps non-UTF8 bytes deterministic instead of raising on one path."""
+    return s.decode(errors="surrogateescape") if isinstance(s, bytes) else s
+
 
 class Interner:
     """Monotone string→int table. Index 0 is reserved for ``reserved[0]``, etc.
@@ -54,11 +64,32 @@ class Interner:
         if n > 1024:
             # object dtype keeps elements pointer-sized; a fixed-width
             # unicode array would cost 4*maxlen bytes per element (one long
-            # outlier id would blow up a 10M-row column)
-            arr = np.asarray(strings, dtype=object)
+            # outlier id would blow up a 10M-row column). Columns that are
+            # ALREADY fixed-width numpy arrays keep their layout — that is
+            # the zero-copy input to the native hash-unique.
+            arr = (strings if isinstance(strings, np.ndarray)
+                   else np.asarray(strings, dtype=object))
+            res = None
+            if arr.ndim == 1 and arr.dtype.kind in "SU":
+                barr = arr
+                if arr.dtype.kind == "U":
+                    try:
+                        barr = arr.astype("S")
+                    except UnicodeEncodeError:
+                        barr = None
+                if barr is not None and barr.dtype.itemsize:
+                    res = native.unique_inverse(barr)
+            if res is not None:
+                uniq_rows, inv = res
+                uniq = arr[uniq_rows]
+                ids = np.fromiter(
+                    (self.intern(_as_str(s)) for s in uniq.tolist()),
+                    dtype=np.int32, count=len(uniq_rows),
+                )
+                return ids[inv]
             uniq, inv = np.unique(arr, return_inverse=True)
             ids = np.fromiter(
-                (self.intern(s) for s in uniq.tolist()),
+                (self.intern(_as_str(s)) for s in uniq.tolist()),
                 dtype=np.int32, count=len(uniq),
             )
             return ids[inv.reshape(-1)]
@@ -66,6 +97,7 @@ class Interner:
         to_str = self._to_str
         out = np.empty(n, dtype=np.int32)
         for k, s in enumerate(strings):
+            s = _as_str(s)
             i = to_id.get(s)
             if i is None:
                 i = len(to_str)

@@ -347,8 +347,11 @@ class Store:
 
             def intern_typed(type_col, id_col):
                 tids = self.types.intern_many(type_col)
-                # object dtype: avoid 4*maxlen-per-element fixed-width unicode
-                ids = np.asarray(id_col, dtype=object)
+                # pass ndarrays through unchanged (fixed-width columns feed
+                # the native hash-unique zero-copy); lists become object
+                # arrays to avoid 4*maxlen-per-element unicode inflation
+                ids = (id_col if isinstance(id_col, np.ndarray)
+                       else np.asarray(id_col, dtype=object))
                 out = np.empty(n, dtype=np.int32)
                 for tid in np.unique(tids).tolist():
                     sel = tids == tid

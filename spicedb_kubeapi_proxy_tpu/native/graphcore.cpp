@@ -1,0 +1,112 @@
+// Host-side graph-builder core for the TPU engine.
+//
+// The reference is pure Go and delegates graph traversal to SpiceDB
+// (SURVEY.md §2.5: no native components exist upstream); this library is the
+// NEW native tier the rebuild mandates: the host-side hot path that turns
+// relationship columns into device-ready edge tensors. Two operations
+// dominate snapshot refresh at the 10M-relationship scale (BASELINE.md):
+//
+//   1. bulk string interning (unique + inverse over id columns)
+//   2. the stable sort of edges by destination slot
+//
+// Both are pure functions over flat buffers so the Python side (ctypes, see
+// __init__.py) keeps ownership of all state and falls back to numpy when the
+// library is unavailable.
+//
+// Build: g++ -O3 -std=c++17 -fPIC -shared graphcore.cpp -o libgraphcore.so
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// FNV-1a over a fixed-width field (NUL padding participates on both sides of
+// any comparison, so padded equality is exact equality).
+static inline uint64_t hash_bytes(const char* p, int64_t len) {
+  uint64_t h = 1469598103934665603ull;
+  for (int64_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(p[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+struct Slot {
+  int64_t row;   // first-occurrence row index, -1 = empty
+  uint64_t hash;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Hash-based unique+inverse over a fixed-width string column (numpy 'S'
+// layout: n rows of `width` bytes). Writes the inverse (id per row, dense in
+// first-occurrence order) to inv_out[n] and first-occurrence row indices to
+// uniq_rows_out (capacity n). Returns the unique count.
+int64_t unique_inverse_fixed(const char* data, int64_t width, int64_t n,
+                             int32_t* inv_out, int64_t* uniq_rows_out) {
+  if (n <= 0) return 0;
+  // open addressing, power-of-two capacity >= 2n
+  uint64_t cap = 16;
+  while (cap < static_cast<uint64_t>(n) * 2) cap <<= 1;
+  std::vector<Slot> table(cap, Slot{-1, 0});
+  const uint64_t mask = cap - 1;
+  int64_t n_uniq = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* s = data + i * width;
+    const uint64_t h = hash_bytes(s, width);
+    uint64_t j = h & mask;
+    for (;;) {
+      Slot& slot = table[j];
+      if (slot.row < 0) {
+        slot.row = i;
+        slot.hash = h;
+        uniq_rows_out[n_uniq] = i;
+        inv_out[i] = static_cast<int32_t>(n_uniq);
+        ++n_uniq;
+        break;
+      }
+      if (slot.hash == h &&
+          std::memcmp(data + slot.row * width, s, width) == 0) {
+        inv_out[i] = inv_out[slot.row];
+        break;
+      }
+      j = (j + 1) & mask;
+    }
+  }
+  return n_uniq;
+}
+
+// Stable ascending sort permutation of non-negative int64 keys (LSD radix,
+// 16-bit digits). out_perm[n] receives row indices; equal keys keep input
+// order — compile_graph relies on this to keep residual edges dst-sorted.
+void sort_perm_i64(const int64_t* keys, int64_t n, int64_t* out_perm) {
+  if (n <= 0) return;
+  int64_t max_key = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    out_perm[i] = i;
+    if (keys[i] > max_key) max_key = keys[i];
+  }
+  std::vector<int64_t> tmp(n);
+  int64_t* src = out_perm;
+  int64_t* dst = tmp.data();
+  for (int shift = 0; shift < 64 && (max_key >> shift) != 0; shift += 16) {
+    int64_t counts[65536] = {0};
+    for (int64_t i = 0; i < n; ++i)
+      ++counts[(keys[src[i]] >> shift) & 0xffff];
+    int64_t total = 0;
+    for (int b = 0; b < 65536; ++b) {
+      int64_t c = counts[b];
+      counts[b] = total;
+      total += c;
+    }
+    for (int64_t i = 0; i < n; ++i)
+      dst[counts[(keys[src[i]] >> shift) & 0xffff]++] = src[i];
+    std::swap(src, dst);
+  }
+  if (src != out_perm) std::memcpy(out_perm, src, n * sizeof(int64_t));
+}
+
+}  // extern "C"

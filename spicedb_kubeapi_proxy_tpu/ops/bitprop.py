@@ -1,0 +1,153 @@
+"""Bit-packed block propagation: a Pallas TPU kernel for the latency path.
+
+One hop over a dense relation block computes ``out[d, b] = OR_s A[d, s] &
+V[s, b]`` (reached(dst) = any reached src with an edge). The int8 MXU
+matmul used for large batches streams ``n_dst * n_src`` bytes of A from HBM
+per hop; a single-subject query (B=1 — the reference's per-request
+LookupResources, pkg/authz/lookups.go:49-65, which BASELINE.md turns into
+the p50 list-filter target) is therefore HBM-bound on an operand that is
+99.5% zeros at bench density.
+
+Packing the src axis into uint32 words shrinks the streamed operand 8x
+(one bit per potential edge) and turns the hop into an (AND, OR)-semiring
+contraction the VPU executes directly:
+
+    out[d, b] = (OR_k A_bits[d, k] & V_bits[b, k]) != 0
+
+The kernel tiles dst over the grid, keeps the packed frontier resident in
+VMEM, and OR-accumulates 128-word lanes; the lane reduction happens once
+per (tile, b). Large batches (B > BIT_B_MAX) keep using the MXU matmul —
+at B=1024 the systolic array amortizes the A stream across the batch and
+wins; at B<=8 this kernel's 8x-smaller stream wins.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BIT_B_MAX = 8  # batches up to this ride the bit kernel (beyond: MXU matmul)
+TILE_D = 256  # dst rows per grid step
+LANES = 128
+
+# uint8 out tiles need sublane multiples of 32; uint32 A tiles need src
+# words >= one lane row. Blocks smaller than this use the matmul path.
+MIN_DST = 32
+MIN_SRC = 32
+
+
+def eligible(n_dst: int, n_src: int) -> bool:
+    return n_dst % MIN_DST == 0 and n_src % MIN_SRC == 0
+
+
+def kernel_enabled() -> bool:
+    """Bit kernel runs on TPU; tests force the interpreter with
+    SDBKP_BITPROP=interpret (CPU default stays on the matmul path)."""
+    mode = os.environ.get("SDBKP_BITPROP", "auto")
+    if mode == "0":
+        return False
+    if mode == "interpret":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return os.environ.get("SDBKP_BITPROP") == "interpret" \
+        or jax.default_backend() != "tpu"
+
+
+def pack_block_host(dst_local: np.ndarray, src_local: np.ndarray,
+                    n_dst: int, n_src: int) -> np.ndarray:
+    """Edges -> uint32 bit matrix [n_dst, K_pad]; bit w of word k set means
+    an edge from src ``32k + w``. K padded to the 128-lane width."""
+    k0 = (n_src + 31) // 32
+    k_pad = -(-k0 // LANES) * LANES
+    bits = np.zeros((n_dst, k_pad), dtype=np.uint32)
+    word = src_local // 32
+    bit = (src_local % 32).astype(np.uint32)
+    np.bitwise_or.at(bits, (dst_local, word), np.uint32(1) << bit)
+    return bits
+
+
+def pack_frontier(frontier: jax.Array, n_src: int) -> jax.Array:
+    """uint8 frontier [n_src, B] -> packed [8, K_pad] uint32 (B rows used).
+
+    Device-side: a reshape + shift + sum over the 32-bit word axis, then a
+    small transpose. Cost is O(n_src * B) — negligible next to the hop.
+    """
+    b = frontier.shape[1]
+    k0 = n_src // 32
+    shifts = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    words = jnp.sum(
+        frontier.astype(jnp.uint32).reshape(k0, 32, b)
+        * shifts[None, :, None],
+        axis=1,
+    )  # [K0, B]
+    k_pad = -(-k0 // LANES) * LANES
+    out = jnp.zeros((BIT_B_MAX, k_pad), dtype=jnp.uint32)
+    return jax.lax.dynamic_update_slice(out, words.T, (0, 0))
+
+
+def _bit_kernel(n_b: int, a_ref, v_ref, out_ref):
+    # int32 throughout: Mosaic has no unsigned reductions, and mixing i1
+    # masks across int32/uint8 tilings forces unsupported relayouts
+    tile_d = a_ref.shape[0]
+    k = a_ref.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tile_d, LANES), 1)
+    out = jnp.zeros((tile_d, LANES), dtype=jnp.int32)
+    for b in range(n_b):  # static: n_b <= BIT_B_MAX
+        acc = jnp.zeros((tile_d, LANES), dtype=jnp.uint32)
+        for kc in range(k // LANES):  # static unroll over lane chunks
+            sl = slice(kc * LANES, (kc + 1) * LANES)
+            acc = acc | (a_ref[:, sl] & v_ref[b, sl][None, :])
+        hit = jnp.max((acc != 0).astype(jnp.int32), axis=1,
+                      keepdims=True)  # [tile_d, 1] in {0, 1}
+        out = out | jnp.where(lane == b, hit, 0)
+    out_ref[:] = out
+
+
+def bit_or_matmul(a_bits: jax.Array, v_bits: jax.Array, n_b: int) -> jax.Array:
+    """(AND, OR) contraction: a_bits [n_dst, K] uint32, v_bits
+    [BIT_B_MAX, K] uint32 -> reached [n_dst, n_b] uint8."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_dst, k = a_bits.shape
+    # largest tile that divides n_dst exactly (eligible() guarantees the
+    # 32-row floor divides), so the grid covers every row
+    tile_d = next(t for t in (TILE_D, 128, 64, 32) if n_dst % t == 0)
+    out = pl.pallas_call(
+        partial(_bit_kernel, n_b),
+        grid=(n_dst // tile_d,),
+        in_specs=[
+            pl.BlockSpec((tile_d, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BIT_B_MAX, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_d, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_dst, LANES), jnp.int32),
+        interpret=_interpret(),
+    )(a_bits, v_bits)
+    return out[:, :n_b].astype(jnp.uint8)
+
+
+def bit_hop_reference(a_bits: np.ndarray, frontier: np.ndarray) -> np.ndarray:
+    """Pure-numpy oracle of one packed hop (tests)."""
+    n_dst, k = a_bits.shape
+    n_src, n_b = frontier.shape
+    out = np.zeros((n_dst, n_b), dtype=np.uint8)
+    for b in range(n_b):
+        idx = np.flatnonzero(frontier[:, b])
+        words = idx // 32
+        bits = np.uint32(1) << (idx % 32).astype(np.uint32)
+        v = np.zeros(k, dtype=np.uint32)
+        np.bitwise_or.at(v, words, bits)
+        out[:, b] = ((a_bits & v[None, :]).any(axis=1)).astype(np.uint8)
+    return out

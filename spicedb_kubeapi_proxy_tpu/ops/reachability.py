@@ -48,13 +48,15 @@ import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from . import bitprop
+from .. import native
 from ..models.schema import (
     Arrow,
     Exclude,
@@ -65,7 +67,9 @@ from ..models.schema import (
     Schema,
     Union,
 )
-from ..engine.store import Snapshot
+
+if TYPE_CHECKING:  # break the ops <-> engine import cycle: annotation only
+    from ..engine.store import Snapshot
 
 SELF_REL = "__self"
 VOID_IDX = 0  # reserved per-type object index for unknown ids
@@ -288,7 +292,21 @@ class CompiledGraph:
                 .set(1)
                 for b in self.blocks
             )
-            sig = self.signature()
+            # bit-packed duals of the dense blocks for the small-batch
+            # latency path (ops/bitprop.py); None = block stays matmul-only.
+            # Packing + device residency is skipped entirely when the bit
+            # kernel cannot run (the toggle is part of the jit-cache key,
+            # so no trace reads the bits in that case).
+            bits_on = bitprop.kernel_enabled()
+            d["blocks_bits"] = tuple(
+                jnp.asarray(bitprop.pack_block_host(
+                    b.dst_local, b.src_local, b.n_dst, b.n_src))
+                if bits_on and bitprop.eligible(b.n_dst, b.n_src) else None
+                for b in self.blocks
+            )
+            # the bit-kernel toggle is baked into traces, so it is part of
+            # the shared-function cache key
+            sig = (self.signature(), bitprop.kernel_enabled())
             run = _JIT_CACHE.get(sig)
             if run is None:
                 run = jax.jit(partial(_run, self),
@@ -329,7 +347,7 @@ class CompiledGraph:
         qb[:Q] = q_batch
         now_rel = np.float32((time.time() if now is None else now) - self.base_time)
         out, converged = d["run"](
-            d["blocks"], d["src"], d["dst"], d["exp"],
+            d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
             jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
             now_rel, max_iters=max_iters,
         )
@@ -402,8 +420,9 @@ def _apply_program(cg: CompiledGraph, V):
     return V
 
 
-def _propagate(cg: CompiledGraph, blocks, src, dst, valid, V):
-    """One hop: dense relation blocks as MXU matmuls + residual edges as a
+def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid, V):
+    """One hop: dense relation blocks as MXU matmuls (large batch) or
+    bit-packed VPU contractions (small batch), plus residual edges as a
     gather/segment-max. Returns prop [M+1, B] uint8."""
     Mp1 = cg.M + 1
     B = V.shape[1]
@@ -412,14 +431,22 @@ def _propagate(cg: CompiledGraph, blocks, src, dst, valid, V):
     prop = jax.ops.segment_max(
         gathered, dst, num_segments=Mp1, indices_are_sorted=True
     )
-    # dense blocks: A[n_dst, n_src] @ V[src_range] on the MXU; >0 -> reached
-    for bm, A in zip(cg.blocks, blocks):
+    # B is static under trace, so the representation choice is baked into
+    # the compiled program: bit kernel streams 8x less HBM per hop at
+    # B<=BIT_B_MAX; the MXU matmul amortizes A across large batches
+    use_bits = B <= bitprop.BIT_B_MAX and bitprop.kernel_enabled()
+    for bm, A, Abits in zip(cg.blocks, blocks, blocks_bits):
         frontier = jax.lax.dynamic_slice(
             V, (bm.src_off, 0), (bm.n_src, B)
-        ).astype(jnp.int8)
-        contrib = (
-            jnp.dot(A, frontier, preferred_element_type=jnp.int32) > 0
-        ).astype(jnp.uint8)
+        )
+        if use_bits and Abits is not None:
+            vb = bitprop.pack_frontier(frontier, bm.n_src)
+            contrib = bitprop.bit_or_matmul(Abits, vb, B)
+        else:
+            contrib = (
+                jnp.dot(A, frontier.astype(jnp.int8),
+                        preferred_element_type=jnp.int32) > 0
+            ).astype(jnp.uint8)
         cur = jax.lax.dynamic_slice(prop, (bm.dst_off, 0), (bm.n_dst, B))
         prop = jax.lax.dynamic_update_slice(
             prop, cur | contrib, (bm.dst_off, 0)
@@ -427,8 +454,8 @@ def _propagate(cg: CompiledGraph, blocks, src, dst, valid, V):
     return prop
 
 
-def _run(cg: CompiledGraph, blocks, src, dst, exp_rel, seeds, q_slots,
-         q_batch, now_rel, *, max_iters: int):
+def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel, seeds,
+         q_slots, q_batch, now_rel, *, max_iters: int):
     """The jitted fixpoint. V layout: [M+1, B] uint8 (slot-major so the
     segment reduction runs over the leading axis and dense blocks matmul
     directly against slot ranges)."""
@@ -445,7 +472,7 @@ def _run(cg: CompiledGraph, blocks, src, dst, exp_rel, seeds, q_slots,
     base = _apply_program(cg, base)
 
     def step(V):
-        prop = _propagate(cg, blocks, src, dst, valid, V)
+        prop = _propagate(cg, blocks, blocks_bits, src, dst, valid, V)
         return _apply_program(cg, prop | base)
 
     def cond(state):
@@ -643,7 +670,9 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     dst = np.concatenate(dsts) if dsts else np.empty(0, dtype=np.int64)
     exp = np.concatenate(exps) if exps else np.empty(0, dtype=np.float32)
 
-    order = np.argsort(dst, kind="stable")
+    order = native.sort_perm(dst)
+    if order is None:
+        order = np.argsort(dst, kind="stable")
     src, dst, exp = src[order], dst[order], exp[order]
 
     n_edges = len(src)

@@ -29,12 +29,21 @@ _load_failed = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", _SRC, "-o", _LIB]
+    # build to a private temp path and publish atomically: a killed or
+    # concurrent compile must never leave a truncated .so that poisons the
+    # mtime-based cache for every later process
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         log.warning("native build failed (%s); using numpy fallbacks", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

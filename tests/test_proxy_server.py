@@ -201,6 +201,51 @@ def test_inmemory_client(env):
     asyncio.run(go())
 
 
+def test_deploy_files_end_to_end(env):
+    """The shipped deploy/ rule set + bootstrap schema serve a full
+    create -> isolate -> delete cycle (namespaces and namespaced pods)."""
+    async def go():
+        fake = FakeKube()
+        import os
+        deploy = os.path.join(os.path.dirname(__file__), "..", "deploy")
+        cfg = Options(
+            rule_files=[os.path.join(deploy, "rules.yaml")],
+            bootstrap_files=[os.path.join(deploy, "bootstrap.yaml")],
+            upstream=fake,
+            workflow_database_path=env,
+        ).complete()
+        await cfg.workflow.resume_pending()
+        alice = InMemoryClient(cfg.server.handle, user="alice")
+        bob = InMemoryClient(cfg.server.handle, user="bob")
+
+        resp = await alice.post("/api/v1/namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "team-a"}})
+        assert resp.status == 201
+        # pods in alice's namespace: create, list isolation, delete
+        resp = await alice.post("/api/v1/namespaces/team-a/pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "api", "namespace": "team-a"}})
+        assert resp.status == 201, resp.body
+        resp = await bob.post("/api/v1/namespaces/team-a/pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "intruder", "namespace": "team-a"}})
+        assert resp.status == 403
+        resp = await alice.get("/api/v1/pods")
+        assert [o["metadata"]["name"]
+                for o in json.loads(resp.body)["items"]] == ["api"]
+        resp = await bob.get("/api/v1/pods")
+        assert json.loads(resp.body)["items"] == []
+        resp = await alice.delete("/api/v1/namespaces/team-a/pods/api")
+        assert resp.status == 200, resp.body
+        # deleteByFilter cleaned up every pod relationship
+        from spicedb_kubeapi_proxy_tpu.engine import RelationshipFilter
+        assert not cfg.engine.store.exists(
+            RelationshipFilter(resource_type="pod"))
+        await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
 def test_options_validation(env):
     from spicedb_kubeapi_proxy_tpu.proxy.options import Options, OptionsError
     with pytest.raises(OptionsError, match="rule file"):

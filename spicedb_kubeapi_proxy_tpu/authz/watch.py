@@ -43,8 +43,9 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     # between the two is then re-checked by the event loop (idempotent)
     # instead of being lost. Running the prefilter eagerly (not inside the
     # streaming generator) also lets PreFilterError surface as a 500 before
-    # the 200/chunked headers are committed.
-    start_rev = engine.revision
+    # the 200/chunked headers are committed. Engine calls go through
+    # to_thread: a remote (tcp://) engine blocks on a socket.
+    start_rev = await asyncio.to_thread(lambda: engine.revision)
     allowed = await run_prefilter(engine, pf, input)
 
     def map_id(obj_id: str) -> Optional[tuple[str, str]]:
@@ -74,7 +75,8 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
         try:
             while True:
                 # 1) drain permission transitions from the engine log
-                events = engine.watch_since(last_rev)
+                events = await asyncio.to_thread(engine.watch_since,
+                                                 last_rev)
                 if events:
                     last_rev = max(e.revision for e in events)
                     ids = sorted({

@@ -287,7 +287,10 @@ class WorkflowEngine:
                 if fn is None:
                     raise RuntimeError(f"unknown activity {call.name!r}")
                 try:
-                    out = fn(ctx, **call.args)
+                    # activities do blocking I/O (engine sockets for a
+                    # remote tcp:// engine, kube HTTP) — keep them off the
+                    # event loop so concurrent workflows/requests proceed
+                    out = await asyncio.to_thread(fn, ctx, **call.args)
                     if asyncio.iscoroutine(out):
                         out = await out
                 except (WorkflowCrash, FailPointError):

@@ -1,0 +1,421 @@
+"""Remote engine endpoint: the TPU engine served over TCP.
+
+The reference proxy can point at a remote SpiceDB (`--spicedb-endpoint
+host:port` with bearer token, /root/reference/pkg/proxy/options.go:325-369)
+instead of the embedded one. This module is that deployment shape for the
+TPU engine: one engine host owns the chip and N proxy replicas consume the
+same engine API remotely — ``EngineServer`` wraps an in-process
+:class:`Engine`; ``RemoteEngine`` is a drop-in client exposing the exact
+surface the proxy consumes (check_bulk, lookup_resources,
+write/read/delete relationships, watch_since, revision, store.exists).
+
+Protocol: 4-byte big-endian length-prefixed JSON frames.
+    request:  {"op": str, "token": str?, ...args}
+    response: {"ok": true, "result": ...}
+            | {"ok": false, "kind": str, "error": str}
+Errors round-trip by kind so precondition failures and schema violations
+keep their meaning across the wire (the dual-write activities branch on
+them). Transport security is left to the surrounding infrastructure; a
+shared bearer token gates requests like the reference's token option.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import socket
+import struct
+import threading
+from dataclasses import asdict
+from typing import Optional
+
+from ..models.tuples import Relationship
+from .engine import CheckItem, Engine, SchemaViolation, WatchEvent
+from .store import (
+    Precondition,
+    PreconditionFailed,
+    RelationshipFilter,
+    StoreError,
+    WriteOp,
+)
+
+log = logging.getLogger("sdbkp.engine.remote")
+
+MAX_FRAME = 256 * 1024 * 1024
+
+_ERROR_KINDS = {
+    "precondition": PreconditionFailed,
+    "schema": SchemaViolation,
+    "store": StoreError,
+}
+
+
+class RemoteEngineError(RuntimeError):
+    pass
+
+
+# -- codecs ------------------------------------------------------------------
+
+
+def _rel_to_dict(r: Relationship) -> dict:
+    return asdict(r)
+
+
+def _rel_from_dict(d: dict) -> Relationship:
+    return Relationship(**d)
+
+
+def _filter_from_dict(d: dict) -> RelationshipFilter:
+    return RelationshipFilter(**d)
+
+
+# -- framing -----------------------------------------------------------------
+
+
+def _pack(msg: dict) -> bytes:
+    body = json.dumps(msg).encode()
+    return struct.pack(">I", len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (n,) = struct.unpack(">I", header)
+    if n > MAX_FRAME:
+        raise RemoteEngineError(f"frame of {n} bytes exceeds limit")
+    body = await reader.readexactly(n)
+    return json.loads(body)
+
+
+# -- server ------------------------------------------------------------------
+
+
+class EngineServer:
+    """Serves an :class:`Engine` to remote proxies. Device queries run in
+    worker threads (asyncio.to_thread) so slow fixpoints never stall other
+    connections' dispatches — concurrent queries pipeline on the device the
+    same way in-process callers do."""
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1",
+                 port: int = 0, token: Optional[str] = None):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.token = token
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("engine listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await _read_frame(reader)
+                if req is None:
+                    return
+                resp = await self._dispatch(req)
+                writer.write(_pack(resp))
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            log.exception("engine connection error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(self, req: dict) -> dict:
+        if self.token and req.get("token") != self.token:
+            return {"ok": False, "kind": "auth", "error": "invalid token"}
+        op = req.get("op")
+        try:
+            fn = getattr(self, f"_op_{op}", None)
+            if fn is None:
+                return {"ok": False, "kind": "proto",
+                        "error": f"unknown op {op!r}"}
+            result = await asyncio.to_thread(fn, req)
+            return {"ok": True, "result": result}
+        except PreconditionFailed as e:
+            return {"ok": False, "kind": "precondition", "error": str(e)}
+        except SchemaViolation as e:
+            return {"ok": False, "kind": "schema", "error": str(e)}
+        except StoreError as e:
+            return {"ok": False, "kind": "store", "error": str(e)}
+        except Exception as e:
+            log.exception("engine op %s failed", op)
+            return {"ok": False, "kind": "internal", "error": str(e)}
+
+    # -- ops (run in worker threads) ----------------------------------------
+
+    def _op_check_bulk(self, req: dict):
+        items = [CheckItem(*it) for it in req["items"]]
+        return self.engine.check_bulk(items, now=req.get("now"))
+
+    def _op_lookup_resources(self, req: dict):
+        return self.engine.lookup_resources(
+            req["resource_type"], req["permission"], req["subject_type"],
+            req["subject_id"], req.get("subject_relation"),
+            now=req.get("now"))
+
+    def _op_write_relationships(self, req: dict):
+        ops = [WriteOp(o["op"], _rel_from_dict(o["rel"]))
+               for o in req["ops"]]
+        pcs = [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
+               for p in req.get("preconditions", [])]
+        return self.engine.write_relationships(ops, pcs)
+
+    def _op_delete_relationships(self, req: dict):
+        pcs = [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
+               for p in req.get("preconditions", [])]
+        return self.engine.delete_relationships(
+            _filter_from_dict(req["filter"]), pcs)
+
+    def _op_read_relationships(self, req: dict):
+        return [_rel_to_dict(r) for r in self.engine.read_relationships(
+            _filter_from_dict(req["filter"]))]
+
+    def _op_watch_since(self, req: dict):
+        return [
+            {"revision": e.revision, "operation": e.operation,
+             "rel": _rel_to_dict(e.relationship)}
+            for e in self.engine.watch_since(req["revision"])
+        ]
+
+    def _op_revision(self, req: dict):
+        return self.engine.revision
+
+    def _op_exists(self, req: dict):
+        return self.engine.store.exists(_filter_from_dict(req["filter"]))
+
+
+# -- client ------------------------------------------------------------------
+
+
+class _StoreShim:
+    """The sliver of Store the proxy touches remotely (idempotency-key and
+    lock existence probes)."""
+
+    def __init__(self, client: "RemoteEngine"):
+        self._client = client
+
+    def exists(self, f: RelationshipFilter) -> bool:
+        return self._client._call("exists", filter=asdict(f))
+
+
+class RemoteEngine:
+    """Synchronous client with the Engine surface the proxy consumes.
+    Thread-safe: a small connection pool lets concurrent request handlers
+    (asyncio.to_thread workers) issue queries in parallel."""
+
+    def __init__(self, host: str, port: int, token: Optional[str] = None,
+                 timeout: float = 300.0, connect_timeout: float = 10.0,
+                 pool_size: int = 8):
+        self.host = host
+        self.port = port
+        self.token = token
+        # response wait: generous — the first query after a snapshot
+        # refresh pays an XLA compile measured in tens of seconds at the
+        # 10M-relationship scale, and a timed-out-but-completing server op
+        # would otherwise be retried against a still-busy server
+        self.timeout = timeout
+        self.connect_timeout = connect_timeout
+        self._pool: list[socket.socket] = []
+        self._pool_lock = threading.Lock()
+        self._pool_size = pool_size
+        self.store = _StoreShim(self)
+
+    # -- transport ----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.connect_timeout)
+        s.settimeout(self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _acquire(self) -> tuple[socket.socket, bool]:
+        """-> (socket, came_from_pool)."""
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop(), True
+        return self._connect(), False
+
+    def _release(self, s: socket.socket) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(s)
+                return
+        s.close()
+
+    def close(self) -> None:
+        with self._pool_lock:
+            for s in self._pool:
+                s.close()
+            self._pool.clear()
+
+    def _call(self, op: str, **args):
+        msg = {"op": op, **args}
+        if self.token:
+            msg["token"] = self.token
+        payload = _pack(msg)
+        s, pooled = self._acquire()
+        try:
+            resp = self._round_trip(s, payload)
+        except socket.timeout:
+            # never retry a timeout: the server may still be processing
+            # (retrying a write against a busy server double-applies it)
+            s.close()
+            raise
+        except (ConnectionError, BrokenPipeError, OSError):
+            s.close()
+            if not pooled:
+                raise
+            # a REUSED connection failing at the connection level almost
+            # always means the engine host restarted and the pooled socket
+            # is stale (peer FIN) — whether during send or while reading
+            # the response header; one retry on a fresh connect recovers
+            s = self._connect()
+            try:
+                resp = self._round_trip(s, payload)
+            except Exception:
+                s.close()
+                raise
+        self._release(s)
+        if resp.get("ok"):
+            return resp.get("result")
+        kind = resp.get("kind", "internal")
+        err = resp.get("error", "")
+        raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
+
+    def _round_trip(self, s: socket.socket, payload: bytes) -> dict:
+        s.sendall(payload)
+        return self._read_response(s)
+
+    def _read_response(self, s: socket.socket) -> dict:
+        header = self._recv_exact(s, 4)
+        (n,) = struct.unpack(">I", header)
+        if n > MAX_FRAME:
+            raise RemoteEngineError(f"frame of {n} bytes exceeds limit")
+        return json.loads(self._recv_exact(s, n))
+
+    @staticmethod
+    def _recv_exact(s: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionResetError("engine connection closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    # -- engine surface ------------------------------------------------------
+
+    def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
+        return self.check_bulk([item], now=now)[0]
+
+    def check_bulk(self, items: list, now: Optional[float] = None) -> list:
+        return self._call(
+            "check_bulk", now=now,
+            items=[[it.resource_type, it.resource_id, it.permission,
+                    it.subject_type, it.subject_id, it.subject_relation]
+                   for it in items])
+
+    def lookup_resources(self, resource_type: str, permission: str,
+                         subject_type: str, subject_id: str,
+                         subject_relation: Optional[str] = None,
+                         now: Optional[float] = None) -> list:
+        return self._call(
+            "lookup_resources", resource_type=resource_type,
+            permission=permission, subject_type=subject_type,
+            subject_id=subject_id, subject_relation=subject_relation,
+            now=now)
+
+    def write_relationships(self, ops: list,
+                            preconditions: list = ()) -> int:
+        return self._call(
+            "write_relationships",
+            ops=[{"op": o.op, "rel": _rel_to_dict(o.rel)} for o in ops],
+            preconditions=[{"filter": asdict(p.filter),
+                            "must_exist": p.must_exist}
+                           for p in preconditions])
+
+    def delete_relationships(self, f: RelationshipFilter,
+                             preconditions: list = ()) -> int:
+        return self._call(
+            "delete_relationships", filter=asdict(f),
+            preconditions=[{"filter": asdict(p.filter),
+                            "must_exist": p.must_exist}
+                           for p in preconditions])
+
+    def read_relationships(self, f: RelationshipFilter):
+        return [_rel_from_dict(d)
+                for d in self._call("read_relationships", filter=asdict(f))]
+
+    def watch_since(self, revision: int) -> list:
+        return [
+            WatchEvent(d["revision"], d["operation"],
+                       _rel_from_dict(d["rel"]))
+            for d in self._call("watch_since", revision=revision)
+        ]
+
+    @property
+    def revision(self) -> int:
+        return self._call("revision")
+
+
+def main(argv=None) -> int:
+    """Standalone engine host: ``python -m
+    spicedb_kubeapi_proxy_tpu.engine.remote --bootstrap schema.yaml
+    --bind-port 50051`` — the TPU-owning process proxies connect to."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(prog="sdbkp-engine",
+                                 description="TPU engine host")
+    ap.add_argument("--bootstrap", action="append", default=[],
+                    help="schema/relationships bootstrap YAML (repeatable)")
+    ap.add_argument("--bind-host", default="127.0.0.1")
+    ap.add_argument("--bind-port", type=int, default=50051)
+    ap.add_argument("--token", help="shared bearer token")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    bootstrap = "\n---\n".join(open(f).read() for f in args.bootstrap) or None
+    engine = Engine(bootstrap=bootstrap)
+    server = EngineServer(engine, args.bind_host, args.bind_port,
+                          token=args.token)
+
+    async def serve():
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await server.start()
+        await stop.wait()
+        await server.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -24,6 +24,7 @@ from .upstream import HttpUpstream
 
 EMBEDDED_ENDPOINT = "embedded://"
 TPU_ENDPOINT = "tpu://"
+REMOTE_ENDPOINT_PREFIX = "tcp://"  # remote engine host (engine/remote.py)
 
 DEFAULT_WORKFLOW_DB = "/tmp/dtx.sqlite"  # reference options.go:41
 
@@ -36,8 +37,10 @@ class OptionsError(ValueError):
 class Options:
     # engine backend: embedded:// | tpu:// (both in-process; tpu:// is the
     # default and runs the reachability kernels on the available JAX
-    # backend). Remote host:port engines are a later milestone.
+    # backend) | tcp://host:port (a remote engine host, engine/remote.py —
+    # the reference's remote-SpiceDB deployment shape, options.go:325-369)
     engine_endpoint: str = TPU_ENDPOINT
+    engine_token: Optional[str] = None  # bearer token for tcp:// endpoints
     bootstrap_files: list = field(default_factory=list)
     bootstrap_content: Optional[str] = None  # yaml text
     rule_files: list = field(default_factory=list)
@@ -58,11 +61,31 @@ class Options:
     workflow_database_path: str = DEFAULT_WORKFLOW_DB
     lock_mode: str = LOCK_MODE_PESSIMISTIC
 
+    def _parse_remote(self) -> Optional[tuple[str, int]]:
+        """(host, port) for tcp:// endpoints, None otherwise; raises on a
+        malformed tcp:// endpoint."""
+        if not self.engine_endpoint.startswith(REMOTE_ENDPOINT_PREFIX):
+            return None
+        hostport = self.engine_endpoint[len(REMOTE_ENDPOINT_PREFIX):]
+        host, _, port = hostport.rpartition(":")
+        if not host or not port.isdigit():
+            raise OptionsError(
+                f"invalid engine endpoint {self.engine_endpoint!r} "
+                "(expected tcp://host:port)")
+        return host, int(port)
+
     def validate(self) -> None:
-        if self.engine_endpoint not in (EMBEDDED_ENDPOINT, TPU_ENDPOINT):
+        remote = self._parse_remote()
+        if remote is None and self.engine_endpoint not in (EMBEDDED_ENDPOINT,
+                                                           TPU_ENDPOINT):
             raise OptionsError(
                 f"unsupported engine endpoint {self.engine_endpoint!r} "
-                f"(supported: {EMBEDDED_ENDPOINT}, {TPU_ENDPOINT})")
+                f"(supported: {EMBEDDED_ENDPOINT}, {TPU_ENDPOINT}, "
+                f"{REMOTE_ENDPOINT_PREFIX}host:port)")
+        if remote and (self.bootstrap_files or self.bootstrap_content):
+            raise OptionsError(
+                "bootstrap applies to in-process engines; a tcp:// engine "
+                "host owns its own bootstrap")
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
         if not (self.rule_files or self.rule_content):
@@ -76,10 +99,16 @@ class Options:
             [open(f).read() for f in self.rule_files]
             + ([self.rule_content] if self.rule_content else []))
         matcher = MapMatcher.from_yaml(rule_text)
-        bootstrap = "\n---\n".join(
-            [open(f).read() for f in self.bootstrap_files]
-            + ([self.bootstrap_content] if self.bootstrap_content else []))
-        engine = Engine(bootstrap=bootstrap or None)
+        remote = self._parse_remote()
+        if remote is not None:
+            from ..engine.remote import RemoteEngine
+
+            engine = RemoteEngine(*remote, token=self.engine_token)
+        else:
+            bootstrap = "\n---\n".join(
+                [open(f).read() for f in self.bootstrap_files]
+                + ([self.bootstrap_content] if self.bootstrap_content else []))
+            engine = Engine(bootstrap=bootstrap or None)
         upstream = self.upstream or HttpUpstream(
             self.upstream_url,
             token=self.upstream_token,
@@ -118,7 +147,10 @@ class CompletedConfig:
 def add_flags(parser: argparse.ArgumentParser) -> None:
     """CLI flags (reference AddFlags, options.go:196-207)."""
     parser.add_argument("--engine-endpoint", default=TPU_ENDPOINT,
-                        help="embedded:// or tpu:// (in-process TPU engine)")
+                        help="embedded:// | tpu:// (in-process TPU engine) "
+                             "| tcp://host:port (remote engine host)")
+    parser.add_argument("--engine-token",
+                        help="bearer token for tcp:// engine endpoints")
     parser.add_argument("--bootstrap", action="append", default=[],
                         help="schema/relationships bootstrap YAML (repeatable)")
     parser.add_argument("--rule-file", action="append", default=[],
@@ -139,6 +171,7 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
 def options_from_args(args: argparse.Namespace) -> Options:
     return Options(
         engine_endpoint=args.engine_endpoint,
+        engine_token=args.engine_token,
         bootstrap_files=args.bootstrap,
         rule_files=args.rule_file,
         upstream_url=args.upstream_url,

@@ -250,12 +250,32 @@ class RemoteEngine:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def _acquire(self) -> tuple[socket.socket, bool]:
-        """-> (socket, came_from_pool)."""
-        with self._pool_lock:
-            if self._pool:
-                return self._pool.pop(), True
-        return self._connect(), False
+    def _acquire(self) -> socket.socket:
+        """A live connection: pooled sockets are liveness-probed first, so
+        a stale one (engine host restarted, peer FIN pending) is replaced
+        BEFORE any request bytes are written — retrying after a send could
+        double-apply a write the server already processed."""
+        while True:
+            with self._pool_lock:
+                if not self._pool:
+                    break
+                s = self._pool.pop()
+            try:
+                s.setblocking(False)
+                try:
+                    probe = s.recv(1)
+                    alive = False  # b'' (FIN) or stray data: discard
+                except (BlockingIOError, InterruptedError):
+                    alive = True
+                    probe = None
+                if alive:
+                    s.settimeout(self.timeout)
+                    return s
+                del probe
+            except OSError:
+                pass
+            s.close()
+        return self._connect()
 
     def _release(self, s: socket.socket) -> None:
         with self._pool_lock:
@@ -275,28 +295,16 @@ class RemoteEngine:
         if self.token:
             msg["token"] = self.token
         payload = _pack(msg)
-        s, pooled = self._acquire()
+        s = self._acquire()
         try:
+            # no retry once bytes are on the wire: the server may have
+            # processed the op even if the connection then died, and
+            # replaying a write would double-apply it (staleness is
+            # handled by the pre-send liveness probe in _acquire)
             resp = self._round_trip(s, payload)
-        except socket.timeout:
-            # never retry a timeout: the server may still be processing
-            # (retrying a write against a busy server double-applies it)
+        except Exception:
             s.close()
             raise
-        except (ConnectionError, BrokenPipeError, OSError):
-            s.close()
-            if not pooled:
-                raise
-            # a REUSED connection failing at the connection level almost
-            # always means the engine host restarted and the pooled socket
-            # is stale (peer FIN) — whether during send or while reading
-            # the response header; one retry on a fresh connect recovers
-            s = self._connect()
-            try:
-                resp = self._round_trip(s, payload)
-            except Exception:
-                s.close()
-                raise
         self._release(s)
         if resp.get("ok"):
             return resp.get("result")

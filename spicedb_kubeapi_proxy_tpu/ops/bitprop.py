@@ -75,22 +75,22 @@ def pack_block_host(dst_local: np.ndarray, src_local: np.ndarray,
 
 
 def pack_frontier(frontier: jax.Array, n_src: int) -> jax.Array:
-    """uint8 frontier [n_src, B] -> packed [8, K_pad] uint32 (B rows used).
+    """uint8 frontier [B, n_src] -> packed [8, K_pad] uint32 (B rows used).
 
-    Device-side: a reshape + shift + sum over the 32-bit word axis, then a
-    small transpose. Cost is O(n_src * B) — negligible next to the hop.
+    Device-side: a reshape + shift + sum over the 32-bit word axis. Cost
+    is O(n_src * B) — negligible next to the hop.
     """
-    b = frontier.shape[1]
+    b = frontier.shape[0]
     k0 = n_src // 32
     shifts = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
     words = jnp.sum(
-        frontier.astype(jnp.uint32).reshape(k0, 32, b)
-        * shifts[None, :, None],
-        axis=1,
-    )  # [K0, B]
+        frontier.astype(jnp.uint32).reshape(b, k0, 32)
+        * shifts[None, None, :],
+        axis=2,
+    )  # [B, K0]
     k_pad = -(-k0 // LANES) * LANES
     out = jnp.zeros((BIT_B_MAX, k_pad), dtype=jnp.uint32)
-    return jax.lax.dynamic_update_slice(out, words.T, (0, 0))
+    return jax.lax.dynamic_update_slice(out, words, (0, 0))
 
 
 def _bit_kernel(n_b: int, a_ref, v_ref, out_ref):

@@ -101,6 +101,13 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+# Every slot range is padded to a multiple of LANE so the state tensor can
+# live as [B, rows, LANE] with ranges row-aligned: slot s = (s // LANE,
+# s % LANE). Without this, a [M, B] layout at B=1 pads the lane axis 1->128
+# and every elementwise op streams 128x more HBM than the state holds.
+LANE = 128
+
+
 @dataclass
 class _BlockMeta:
     """One dense relation block: edges between a (src slot range, dst slot
@@ -392,15 +399,18 @@ class QueryFuture:
 
 
 def _apply_program(cg: CompiledGraph, V):
-    """Recompute every permission slot range from its expression (static
-    slices; offsets are compile-time constants)."""
+    """Recompute every permission slot range from its expression. V is
+    [B, rows, LANE]; every range offset/size is a multiple of LANE, so a
+    range is a row-aligned static slice along axis 1."""
 
     def ev(expr: Expr, p: _PermProgram):
         if isinstance(expr, Nil):
-            return jnp.zeros((p.size,) + V.shape[1:], dtype=V.dtype)
+            return jnp.zeros((V.shape[0], p.size // LANE, LANE),
+                             dtype=V.dtype)
         if isinstance(expr, (RelationRef, Arrow)):
             off = p.leaf_off[expr]
-            return jax.lax.dynamic_slice_in_dim(V, off, p.size, axis=0)
+            return jax.lax.dynamic_slice_in_dim(
+                V, off // LANE, p.size // LANE, axis=1)
         if isinstance(expr, Union):
             out = ev(expr.operands[0], p)
             for e in expr.operands[1:]:
@@ -416,64 +426,84 @@ def _apply_program(cg: CompiledGraph, V):
         raise TypeError(f"unknown expr {expr!r}")
 
     for p in cg.programs:
-        V = jax.lax.dynamic_update_slice_in_dim(V, ev(p.expr, p), p.dst_off, axis=0)
+        V = jax.lax.dynamic_update_slice_in_dim(
+            V, ev(p.expr, p), p.dst_off // LANE, axis=1)
     return V
 
 
 def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid, V):
     """One hop: dense relation blocks as MXU matmuls (large batch) or
     bit-packed VPU contractions (small batch), plus residual edges as a
-    gather/segment-max. Returns prop [M+1, B] uint8."""
-    Mp1 = cg.M + 1
-    B = V.shape[1]
-    # residual (expiring / sparse / tiny) edges: gather + segment-max
-    gathered = V[src] & valid[:, None]  # [E_res, B]
+    gather/segment-max. V is [B, rows, LANE]; returns prop in the flat
+    [B, rows*LANE] view (caller reshapes)."""
+    B = V.shape[0]
+    Mp = V.shape[1] * LANE  # M + trash row
+    Vflat = V.reshape(B, Mp)
+    # residual (expiring / sparse / tiny) edges: gather + segment-max over
+    # the slot axis (edge arrays index flat slots; trash padding lands in
+    # the trash row)
+    gathered = (Vflat[:, src] & valid[None, :]).T  # [E_res, B]
     prop = jax.ops.segment_max(
-        gathered, dst, num_segments=Mp1, indices_are_sorted=True
-    )
+        gathered, dst, num_segments=Mp, indices_are_sorted=True
+    ).T  # [B, Mp]
     # B is static under trace, so the representation choice is baked into
     # the compiled program: bit kernel streams 8x less HBM per hop at
     # B<=BIT_B_MAX; the MXU matmul amortizes A across large batches
     use_bits = B <= bitprop.BIT_B_MAX and bitprop.kernel_enabled()
     for bm, A, Abits in zip(cg.blocks, blocks, blocks_bits):
         frontier = jax.lax.dynamic_slice(
-            V, (bm.src_off, 0), (bm.n_src, B)
-        )
+            Vflat, (0, bm.src_off), (B, bm.n_src)
+        )  # [B, n_src]
         if use_bits and Abits is not None:
             vb = bitprop.pack_frontier(frontier, bm.n_src)
-            contrib = bitprop.bit_or_matmul(Abits, vb, B)
+            contrib = bitprop.bit_or_matmul(Abits, vb, B).T  # [B, n_dst]
         else:
             contrib = (
-                jnp.dot(A, frontier.astype(jnp.int8),
-                        preferred_element_type=jnp.int32) > 0
-            ).astype(jnp.uint8)
-        cur = jax.lax.dynamic_slice(prop, (bm.dst_off, 0), (bm.n_dst, B))
+                jax.lax.dot_general(
+                    frontier.astype(jnp.int8), A,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32) > 0
+            ).astype(jnp.uint8)  # [B, n_dst]
+        cur = jax.lax.dynamic_slice(prop, (0, bm.dst_off), (B, bm.n_dst))
         prop = jax.lax.dynamic_update_slice(
-            prop, cur | contrib, (bm.dst_off, 0)
+            prop, cur | contrib, (0, bm.dst_off)
         )
     return prop
 
 
+def _seed_base(cg: CompiledGraph, seeds):
+    """Seed the [B, rows, LANE] state from subject/wildcard slot pairs and
+    run the permission programs once. The single source of the layout
+    invariants (rows = M/LANE + trash row; trash row stays 0 so unknown
+    subjects seed nothing) — both the single-chip and sharded fixpoints
+    build their base here."""
+    B = seeds.shape[0]
+    rows = cg.M // LANE + 1  # + trash row (slots M .. M+LANE-1)
+    Mp = rows * LANE
+    brange = jnp.arange(B, dtype=jnp.int32)
+    base = jnp.zeros((B, Mp), dtype=jnp.uint8)
+    base = base.at[brange, seeds[:, 0]].max(1)
+    base = base.at[brange, seeds[:, 1]].max(1)
+    base = base.at[:, cg.M:].set(0)
+    return _apply_program(cg, base.reshape(B, rows, LANE))
+
+
 def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel, seeds,
          q_slots, q_batch, now_rel, *, max_iters: int):
-    """The jitted fixpoint. V layout: [M+1, B] uint8 (slot-major so the
-    segment reduction runs over the leading axis and dense blocks matmul
-    directly against slot ranges)."""
+    """The jitted fixpoint. V layout: [B, rows, LANE] uint8 — the slot
+    space rides the lane axis so a B=1 query streams exactly M bytes per
+    elementwise pass instead of a lane-padded 128x that; slot s lives at
+    (s // LANE, s % LANE) and every range is row-aligned."""
     B = seeds.shape[0]
-    Mp1 = cg.M + 1
+    rows = cg.M // LANE + 1  # + trash row (slots M .. M+LANE-1)
+    Mp = rows * LANE
     valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E_res]
-
-    brange = jnp.arange(B, dtype=jnp.int32)
-    base = jnp.zeros((Mp1, B), dtype=jnp.uint8)
-    base = base.at[seeds[:, 0], brange].max(1)
-    base = base.at[seeds[:, 1], brange].max(1)
-    # the trash slot must stay 0: unknown subjects seed nothing
-    base = base.at[cg.M].set(0)
-    base = _apply_program(cg, base)
+    base = _seed_base(cg, seeds)
 
     def step(V):
         prop = _propagate(cg, blocks, blocks_bits, src, dst, valid, V)
-        return _apply_program(cg, prop | base)
+        return _apply_program(
+            cg, prop.reshape(B, rows, LANE) | base)
 
     def cond(state):
         V, prev_changed, it = state
@@ -488,7 +518,8 @@ def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel, seeds,
     V, still_changing, _ = jax.lax.while_loop(cond, body, (V0, jnp.bool_(True), 0))
     # still_changing at loop exit means we hit max_iters before convergence;
     # surface it so the host can raise instead of silently denying
-    return V[q_slots, q_batch].astype(jnp.bool_), jnp.logical_not(still_changing)
+    out = V.reshape(B, Mp)[q_batch, q_slots].astype(jnp.bool_)
+    return out, jnp.logical_not(still_changing)
 
 
 # ---------------------------------------------------------------------------
@@ -555,8 +586,10 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         n = len(snapshot.objects[tid]) if tid is not None and tid in snapshot.objects \
             else 2
         # bucket-pad the per-type object space so slot offsets (and thus the
-        # jit signature) stay stable as objects are interned within a bucket
-        n = _next_bucket(max(n, 2), 8)
+        # jit signature) stay stable as objects are interned within a
+        # bucket; the LANE floor keeps every slot range row-aligned in the
+        # [B, rows, LANE] state layout
+        n = _next_bucket(max(n, 2), LANE)
         type_sizes[tname] = n
         slot_offset[(tname, SELF_REL)] = off
         off += n

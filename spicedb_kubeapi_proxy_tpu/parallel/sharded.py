@@ -40,34 +40,35 @@ from ..ops.reachability import (
     CompiledGraph,
     ConvergenceError,
     DEFAULT_MAX_ITERS,
+    LANE,
     _apply_program,
     _next_bucket,
+    _seed_base,
 )
 
 
 def _run_sharded(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots,
                  now_rel, *, max_iters: int):
     """Per-device body (inside shard_map). Shapes are the LOCAL shards:
-    src/dst/exp_rel [E/ng]; seeds [B/nd, 2]; q_slots [B/nd, Q]."""
+    src/dst/exp_rel [E/ng]; seeds [B/nd, 2]; q_slots [B/nd, Q]. State
+    layout matches the single-chip fixpoint: [B, rows, LANE] with the
+    slot space on the lane axis."""
     B = seeds.shape[0]
-    Mp1 = cg.M + 1
+    rows = cg.M // LANE + 1  # + trash row
+    Mp = rows * LANE
     valid = (exp_rel > now_rel).astype(jnp.uint8)
-
     brange = jnp.arange(B, dtype=jnp.int32)
-    base = jnp.zeros((Mp1, B), dtype=jnp.uint8)
-    base = base.at[seeds[:, 0], brange].max(1)
-    base = base.at[seeds[:, 1], brange].max(1)
-    base = base.at[cg.M].set(0)  # trash slot stays 0
-    base = _apply_program(cg, base)
+    base = _seed_base(cg, seeds)
 
     def step(V):
-        gathered = V[src] & valid[:, None]  # [E_local, B]
+        Vflat = V.reshape(B, Mp)
+        gathered = (Vflat[:, src] & valid[None, :]).T  # [E_local, B]
         # edges are dst-sorted globally, so each contiguous chunk is sorted
         prop = jax.ops.segment_max(
-            gathered, dst, num_segments=Mp1, indices_are_sorted=True
-        )
+            gathered, dst, num_segments=Mp, indices_are_sorted=True
+        ).T  # [B, Mp]
         prop = jax.lax.pmax(prop, "graph")  # join edge shards over ICI
-        return _apply_program(cg, prop | base)
+        return _apply_program(cg, prop.reshape(B, rows, LANE) | base)
 
     def cond(state):
         _, prev_changed, it = state
@@ -84,7 +85,7 @@ def _run_sharded(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots,
     V, still_changing, _ = jax.lax.while_loop(
         cond, body, (base, jnp.int32(1), 0)
     )
-    out = V[q_slots, brange[:, None]].astype(jnp.bool_)  # [B_local, Q]
+    out = V.reshape(B, Mp)[brange[:, None], q_slots].astype(jnp.bool_)
     return out, (still_changing == 0)
 
 

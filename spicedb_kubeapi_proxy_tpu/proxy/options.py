@@ -68,10 +68,13 @@ class Options:
             return None
         hostport = self.engine_endpoint[len(REMOTE_ENDPOINT_PREFIX):]
         host, _, port = hostport.rpartition(":")
-        if not host or not port.isdigit():
+        if not host or not port.isdigit() or not 0 < int(port) < 65536:
             raise OptionsError(
                 f"invalid engine endpoint {self.engine_endpoint!r} "
                 "(expected tcp://host:port)")
+        # bracketed IPv6 literals: tcp://[::1]:50051
+        if host.startswith("[") and host.endswith("]"):
+            host = host[1:-1]
         return host, int(port)
 
     def validate(self) -> None:

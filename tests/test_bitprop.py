@@ -34,7 +34,8 @@ def test_kernel_matches_oracle(monkeypatch, n_dst, n_src, n_b):
     a_bits = bitprop.pack_block_host(dst, src, n_dst, n_src)
     frontier = (rng.random((n_src, n_b)) < 0.1).astype(np.uint8)
 
-    vb = bitprop.pack_frontier(jnp.asarray(frontier), n_src)
+    # engine layout: frontier rows are batch lanes [B, n_src]
+    vb = bitprop.pack_frontier(jnp.asarray(frontier.T.copy()), n_src)
     got = np.asarray(bitprop.bit_or_matmul(
         jnp.asarray(a_bits), vb, n_b))
     want = bitprop.bit_hop_reference(a_bits, frontier)

@@ -402,11 +402,17 @@ def main(argv=None) -> int:
     ap.add_argument("--bind-host", default="127.0.0.1")
     ap.add_argument("--bind-port", type=int, default=50051)
     ap.add_argument("--token", help="shared bearer token")
+    ap.add_argument("--snapshot-path",
+                    help="relationship-store snapshot: loaded at boot if "
+                         "present, saved on graceful shutdown")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     bootstrap = "\n---\n".join(open(f).read() for f in args.bootstrap) or None
     engine = Engine(bootstrap=bootstrap)
+    if engine.load_snapshot_if_exists(args.snapshot_path):
+        log.info("loaded snapshot %s (revision %d)", args.snapshot_path,
+                 engine.revision)
     server = EngineServer(engine, args.bind_host, args.bind_port,
                           token=args.token)
 
@@ -418,6 +424,9 @@ def main(argv=None) -> int:
         await server.start()
         await stop.wait()
         await server.stop()
+        if args.snapshot_path:
+            engine.save_snapshot(args.snapshot_path)
+            log.info("saved snapshot to %s", args.snapshot_path)
 
     asyncio.run(serve())
     return 0

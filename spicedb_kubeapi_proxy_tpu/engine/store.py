@@ -463,6 +463,81 @@ class Store:
             )
             return self._watch_log[i:]
 
+    # -- durability ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist the store to one compressed npz: live rows compacted
+        into a single chunk plus the interner string tables. The watch log
+        is NOT persisted — a watcher resuming against a restored store gets
+        the kube "resourceVersion too old" treatment (re-list + re-watch),
+        the same contract as crossing the in-memory retention horizon."""
+        import json
+        import os
+
+        with self._lock:
+            live = [cols.take(np.flatnonzero(alive))
+                    for cols, alive in zip(self._chunks, self._alive)
+                    if np.any(alive)]
+            cols = Columns.concat(live)
+            meta = {
+                "revision": self.revision,
+                "types": self.types.strings(),
+                "relations": self.relations.strings(),
+                "objects": {str(tid): it.strings()
+                            for tid, it in self.objects.items()},
+            }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            # stream straight into the temp file (no in-memory archive
+            # copy), then publish atomically: no torn snapshots
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, rt=cols.rt, rid=cols.rid, rl=cols.rl, st=cols.st,
+                    sid=cols.sid, srl=cols.srl, exp=cols.exp,
+                    meta=np.frombuffer(json.dumps(meta).encode(),
+                                       dtype=np.uint8),
+                )
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load(self, path: str) -> None:
+        """Replace this store's contents with a saved snapshot."""
+        import json
+
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"].tobytes()).decode())
+            cols = Columns(
+                z["rt"].astype(np.int32), z["rid"].astype(np.int32),
+                z["rl"].astype(np.int32), z["st"].astype(np.int32),
+                z["sid"].astype(np.int32), z["srl"].astype(np.int32),
+                z["exp"].astype(np.float64),
+            )
+        with self._lock:
+            self.types = Interner()
+            for s in meta["types"]:
+                self.types.intern(s)
+            self.relations = Interner()
+            for s in meta["relations"]:
+                self.relations.intern(s)
+            self.objects = {}
+            for tid, strings in meta["objects"].items():
+                it = Interner()
+                for s in strings:
+                    it.intern(s)
+                self.objects[int(tid)] = it
+            self._chunks = [cols]
+            self._alive = [np.ones(len(cols), dtype=bool)]
+            self._index = None
+            self.revision = int(meta["revision"])
+            self._watch_log = []
+            # watchers from before the snapshot must re-list
+            self._watch_oldest_rev = self.revision
+
     def snapshot(self) -> Snapshot:
         """Immutable columnar view of all live tuples for the compiler.
 

@@ -40,6 +40,9 @@ def main(argv=None) -> int:
         await stop.wait()
         await cfg.server.stop()
         await cfg.workflow.shutdown()
+        if opts.snapshot_path and hasattr(cfg.engine, "save_snapshot"):
+            cfg.engine.save_snapshot(opts.snapshot_path)
+            logging.info("saved snapshot to %s", opts.snapshot_path)
 
     asyncio.run(serve())
     return 0

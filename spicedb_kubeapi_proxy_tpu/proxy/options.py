@@ -60,6 +60,9 @@ class Options:
     # dual-write
     workflow_database_path: str = DEFAULT_WORKFLOW_DB
     lock_mode: str = LOCK_MODE_PESSIMISTIC
+    # relationship-store snapshot: loaded at boot when the file exists,
+    # saved on graceful shutdown (in-process engines only)
+    snapshot_path: Optional[str] = None
 
     def _parse_remote(self) -> Optional[tuple[str, int]]:
         """(host, port) for tcp:// endpoints, None otherwise; raises on a
@@ -89,6 +92,10 @@ class Options:
             raise OptionsError(
                 "bootstrap applies to in-process engines; a tcp:// engine "
                 "host owns its own bootstrap")
+        if remote and self.snapshot_path:
+            raise OptionsError(
+                "snapshot-path applies to in-process engines; pass it to "
+                "the tcp:// engine host instead")
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
         if not (self.rule_files or self.rule_content):
@@ -112,6 +119,7 @@ class Options:
                 [open(f).read() for f in self.bootstrap_files]
                 + ([self.bootstrap_content] if self.bootstrap_content else []))
             engine = Engine(bootstrap=bootstrap or None)
+            engine.load_snapshot_if_exists(self.snapshot_path)
         upstream = self.upstream or HttpUpstream(
             self.upstream_url,
             token=self.upstream_token,
@@ -128,8 +136,26 @@ class Options:
             workflow=workflow, default_lock_mode=self.lock_mode,
         )
         server = Server(deps, HeaderAuthenticator(),
-                        host=self.bind_host, port=self.bind_port)
+                        host=self.bind_host, port=self.bind_port,
+                        config_dump=self.debug_dump())
         return CompletedConfig(self, engine, workflow, deps, server)
+
+    # fields safe to expose on /debug/config — an ALLOWLIST so a future
+    # credential-bearing Options field fails safe (omitted) instead of
+    # leaking until someone extends a denylist
+    _DUMP_FIELDS = (
+        "engine_endpoint", "bootstrap_files", "rule_files", "upstream_url",
+        "upstream_insecure", "bind_host", "bind_port",
+        "workflow_database_path", "lock_mode", "snapshot_path",
+    )
+
+    def debug_dump(self) -> dict:
+        """Secret-free options dump for /debug/config (the reference
+        sanitizes via debugmap struct tags, options.go:50-82)."""
+        out = {k: getattr(self, k) for k in self._DUMP_FIELDS}
+        for k in ("upstream_token", "engine_token"):
+            out[k] = "<redacted>" if getattr(self, k) else None
+        return out
 
 
 @dataclass
@@ -167,6 +193,9 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--bind-host", default="127.0.0.1")
     parser.add_argument("--bind-port", type=int, default=8443)
     parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
+    parser.add_argument("--snapshot-path",
+                        help="relationship-store snapshot file: loaded at "
+                             "boot if present, saved on graceful shutdown")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
 
@@ -187,4 +216,5 @@ def options_from_args(args: argparse.Namespace) -> Options:
         bind_port=args.bind_port,
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
+        snapshot_path=args.snapshot_path,
     )

@@ -38,11 +38,15 @@ class Server:
 
     def __init__(self, deps: AuthzDeps,
                  authenticator: Optional[HeaderAuthenticator] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 config_dump: Optional[dict] = None):
         self.deps = deps
         self.authenticator = authenticator or HeaderAuthenticator()
         self.host = host
         self.port = port
+        # sanitized options for /debug/config (the reference's debugmap
+        # struct tags produce the same kind of secret-free dump)
+        self.config_dump = config_dump
         self._server: Optional[asyncio.AbstractServer] = None
 
     # -- handler chain -------------------------------------------------------
@@ -82,6 +86,14 @@ class Server:
                 req.user = self.authenticator.authenticate(req.headers)
             except AuthenticationError as e:
                 return kube_status(401, str(e), "Unauthorized")
+        if req.path == "/debug/config":
+            # authenticated-only: the dump is allowlisted, but config
+            # topology still doesn't belong on an open endpoint
+            import json as _json
+
+            return ProxyResponse(
+                status=200, headers={"Content-Type": "application/json"},
+                body=_json.dumps(self.config_dump or {}, indent=2).encode())
         return await authorize(req, self.deps)
 
     # -- TCP serving ---------------------------------------------------------

@@ -169,9 +169,17 @@ def test_full_http_round_trips(env):
         assert json.loads(nxt)["object"]["metadata"]["name"] == "team-b"
         writer.close()
 
-        # metrics rendered
+        # metrics rendered (proxy + engine families)
         status, _, body = await noauth.request("GET", "/metrics")
         assert status == 200 and b"proxy_requests_total" in body
+        assert b"engine_checks_total" in body
+        # sanitized config dump: authenticated-only, secrets redacted
+        status, _, _ = await noauth.request("GET", "/debug/config")
+        assert status == 401
+        status, _, body = await alice.request("GET", "/debug/config")
+        dump = json.loads(body)
+        assert status == 200 and dump["engine_endpoint"]
+        assert "upstream_token" in dump and dump["upstream_token"] is None
 
         fake.stop_watches()
         await cfg.server.stop()

@@ -186,7 +186,7 @@ def run_suite(quick: bool) -> None:
 
     # -- config 3: nested-group userset rewrites, ~1M rels ------------------
     n_users, n_g2, n_g1, n_g0, n_ns = (np.array(
-        [100_000, 20_000, 2_000, 200, 50_000]) // scale).tolist()
+        [100_000, 20_000, 2_000, 200, 200_000]) // scale).tolist()
     schema = parse_schema("""
 definition user {}
 definition group { relation member: user | group#member }
@@ -212,8 +212,9 @@ definition namespace {
     g1 = np.char.add("g1-", np.arange(n_g1).astype(str))
     g0 = np.char.add("g0-", np.arange(n_g0).astype(str))
     nss = np.char.add("ns", np.arange(n_ns).astype(str))
-    # leaf membership: ~8 users per g2; g2 in g1; g1 in g0; ns viewer g0
-    m = 8 * n_g2
+    # leaf membership: ~40 users per g2; g2 in g1; g1 in g0; ns viewer g0
+    # (totals ~1M relationships at full scale, BASELINE config 3)
+    m = 40 * n_g2
     add("group", g2[rng.integers(n_g2, size=m)], "member",
         "user", users[rng.integers(n_users, size=m)], "")
     add("group", g1[rng.integers(n_g1, size=n_g2)], "member",

@@ -486,11 +486,16 @@ class Store:
                 "objects": {str(tid): it.strings()
                             for tid, it in self.objects.items()},
             }
-        tmp = f"{path}.tmp.{os.getpid()}"
+        import tempfile
+
+        # unique temp per save (mkstemp, not pid-keyed: concurrent saves in
+        # one process must not truncate each other), streamed directly (no
+        # in-memory archive copy), then published atomically
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)),
+            prefix=os.path.basename(path) + ".tmp.")
         try:
-            # stream straight into the temp file (no in-memory archive
-            # copy), then publish atomically: no torn snapshots
-            with open(tmp, "wb") as f:
+            with os.fdopen(fd, "wb") as f:
                 np.savez_compressed(
                     f, rt=cols.rt, rid=cols.rid, rl=cols.rl, st=cols.st,
                     sid=cols.sid, srl=cols.srl, exp=cols.exp,

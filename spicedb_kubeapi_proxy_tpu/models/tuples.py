@@ -117,15 +117,27 @@ def parse_relationship(text: str) -> Relationship:
     )
 
 
+# Permissive charsets for literal template fields: the goal is rejecting
+# STRUCTURAL leaks (a stray '#' splitting a subject relation, '@' inside a
+# field), not constraining identifiers — kube subjects legitimately carry
+# ':' (system:serviceaccount:ns:name) and label-derived relations '/'
+# (app.kubernetes.io/name).
+_TPL_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_./-]*$")
+_TPL_ID_RE = re.compile(r"^(?:[A-Za-z0-9_.=+/:-]+|\*)$")
+
+
 def parse_rel_fields(text: str) -> dict:
     """Split a (possibly templated) relationship string into its six fields
-    without validating contents — the rules engine compiles each field as an
-    expression (reference ParseRelSring, rules.go:1056-1073)."""
+    (reference ParseRelSring, rules.go:1056-1073). Fields containing a
+    ``{{ }}`` expression are left for the rules engine to compile; purely
+    literal fields are validated against the concrete charset so malformed
+    strings (`...@user:alice#a#b`) fail at parse time, not at request
+    time."""
     m = _TPL_RE.match(text.strip())
     if not m:
         raise TupleError(f"invalid relationship template: {text!r}")
     g = m.groupdict()
-    return {
+    out = {
         "resource_type": g["resource_type"],
         "resource_id": g["resource_id"],
         "relation": g["relation"],
@@ -133,3 +145,12 @@ def parse_rel_fields(text: str) -> dict:
         "subject_id": g["subject_id"],
         "subject_relation": g["subject_relation"] or None,
     }
+    for k, v in out.items():
+        if not v or "{{" in v:
+            continue
+        rx = _TPL_ID_RE if k in ("resource_id", "subject_id") \
+            else _TPL_IDENT_RE
+        if not rx.match(v) and v != "$":  # `$` = prefilter/filter wildcard
+            raise TupleError(
+                f"invalid relationship template field {k}={v!r} in {text!r}")
+    return out

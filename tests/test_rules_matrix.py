@@ -1,0 +1,270 @@
+"""Rule parse/compile/eval matrix — modeled on the reference's broad unit
+suites (rules_test.go TestParseRelString/TestCompile/TestCELConditions/
+TestMapMatcherMatch and proxyrule rule_test.go TestRuleParsing/
+TestValidation, SURVEY.md §4)."""
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.rules.compile import (
+    CompileError,
+    compile_rule,
+)
+from spicedb_kubeapi_proxy_tpu.rules.expr import ExprError
+from spicedb_kubeapi_proxy_tpu.rules.input import ResolveInput, UserInfo
+from spicedb_kubeapi_proxy_tpu.rules.matcher import MapMatcher, RequestMeta
+from spicedb_kubeapi_proxy_tpu.rules.proxyrule import (
+    RuleValidationError,
+    parse_rule_configs,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
+
+
+def _input(verb="create", resource="namespaces", name="dev", ns="",
+           user="alice", groups=(), body=None):
+    import json as _json
+
+    path = f"/api/v1/{resource}" if not ns else \
+        f"/api/v1/namespaces/{ns}/{resource}"
+    if verb in ("get", "delete", "update", "patch"):
+        path += f"/{name}"
+    info = parse_request_info(
+        "POST" if verb == "create" else "GET", path, {})
+    info.verb = verb
+    if body is None and verb == "create":
+        # creates resolve the name from the object body, like the reference
+        meta = {"name": name}
+        if ns:
+            meta["namespace"] = ns
+        body = {"metadata": meta}
+    return ResolveInput.create(
+        info, UserInfo(name=user, groups=list(groups)),
+        body=(_json.dumps(body).encode() if body is not None else None),
+        headers={})
+
+
+def _rule(yaml_text):
+    return compile_rule(parse_rule_configs(yaml_text)[0])
+
+
+# -- rel-string template parsing (TestParseRelString shape) ------------------
+
+REL_OK = [
+    # literal fields
+    ("ns:dev#viewer@user:alice", ("ns", "dev", "viewer", "user", "alice", "")),
+    # userset subject
+    ("ns:dev#viewer@group:eng#member",
+     ("ns", "dev", "viewer", "group", "eng", "member")),
+    # templates in every position
+    ("ns:{{name}}#viewer@user:{{user.name}}",
+     ("ns", "dev", "viewer", "user", "alice", "")),
+    # slash-joined namespaced name
+    ("pod:{{namespacedName}}#creator@user:{{user.name}}",
+     None),  # checked separately below
+]
+
+
+@pytest.mark.parametrize("tpl,want", REL_OK[:3])
+def test_rel_template_positions(tpl, want):
+    rule = _rule(f"""
+match: [{{apiVersion: v1, resource: namespaces, verbs: [create]}}]
+check: [{{tpl: "{tpl}"}}]
+""")
+    got = rule.checks[0].generate(_input())[0]
+    assert (got.resource_type, got.resource_id, got.resource_relation,
+            got.subject_type, got.subject_id, got.subject_relation) == want
+
+
+def test_rel_template_namespaced_name():
+    rule = _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+check: [{tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"}]
+""")
+    got = rule.checks[0].generate(
+        _input(resource="pods", ns="team-a", name="api",
+               body={"metadata": {"name": "api", "namespace": "team-a"}}))[0]
+    assert got.resource_id == "team-a/api"
+
+
+@pytest.mark.parametrize("bad", [
+    "ns:dev#viewer",          # no subject
+    "ns:dev@user:alice",      # no relation
+    "#viewer@user:alice",     # no resource
+    "ns:dev#viewer@user:alice#a#b",  # double subject relation
+])
+def test_rel_template_malformed(bad):
+    with pytest.raises((CompileError, RuleValidationError)):
+        _rule(f"""
+match: [{{apiVersion: v1, resource: namespaces, verbs: [create]}}]
+check: [{{tpl: "{bad}"}}]
+""")
+
+
+def test_literal_fields_allow_kube_identifier_charsets():
+    # service-account subjects carry ':'; label-derived relations carry
+    # '.'/'/'; both must flow through literal-field validation (review
+    # regression: the structural check must reject only '#'/'@' leaks)
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_rel_fields
+    f = parse_rel_fields(
+        "ns:x#admin@user:system:serviceaccount:default:builder")
+    assert f["subject_id"] == "system:serviceaccount:default:builder"
+    f = parse_rel_fields("pod:t/api#label-app.kubernetes.io/name@user:a")
+    assert f["relation"] == "label-app.kubernetes.io/name"
+
+
+def test_empty_resolved_field_is_an_error():
+    rule = _rule("""
+match: [{apiVersion: v1, resource: namespaces, verbs: [create]}]
+check: [{tpl: "ns:{{object.metadata.labels.missing}}#v@user:{{user.name}}"}]
+""")
+    with pytest.raises(ExprError, match="empty|null"):
+        rule.checks[0].generate(_input(body={"metadata": {"name": "dev"}}))
+
+
+# -- validation matrix (rule_test.go TestValidation shape) -------------------
+
+@pytest.mark.parametrize("doc,msg", [
+    ("match: []\ncheck: [{tpl: 'a:b#c@d:e'}]", "match is required"),
+    ("match: [{apiVersion: v1, resource: r}]", "needs verbs"),
+    ("match: [{apiVersion: v1, verbs: [get]}]", "needs apiVersion and resource"),
+    ("match: [{apiVersion: v1, resource: r, verbs: [frobnicate]}]",
+     "invalid verb"),
+    ("match: [{apiVersion: v1, resource: r, verbs: [get]}]\n"
+     "check: [{tpl: 'a:b#c@d:e', tupleSet: 'x'}]", "mutually exclusive"),
+    ("match: [{apiVersion: v1, resource: r, verbs: [get]}]\ncheck: [{}]",
+     "is required"),
+    ("match: [{apiVersion: v1, resource: r, verbs: [get]}]\n"
+     "lock: Sometimes", "invalid lock mode"),
+    ("match: [{apiVersion: v1, resource: r, verbs: [list]}]\n"
+     "postcheck: [{tpl: 'a:b#c@d:e'}]", "incompatible with verbs"),
+    ("match: [{apiVersion: v1, resource: r, verbs: [get]}]\n"
+     "prefilter: [{lookupMatchingResources: {tpl: 'a:$#c@d:e'}}]",
+     "fromObjectIDNameExpr"),
+    ("apiVersion: wrong/v9\n"
+     "match: [{apiVersion: v1, resource: r, verbs: [get]}]",
+     "unsupported apiVersion"),
+])
+def test_validation_matrix(doc, msg):
+    with pytest.raises(RuleValidationError, match=msg):
+        parse_rule_configs(doc)
+
+
+def test_multi_doc_parse_and_empty_docs():
+    docs = parse_rule_configs("""
+---
+match: [{apiVersion: v1, resource: a, verbs: [get]}]
+check: [{tpl: "a:{{name}}#v@user:{{user.name}}"}]
+---
+# empty doc skipped
+---
+metadata: {name: second}
+match: [{apiVersion: apps/v1, resource: b, verbs: [list]}]
+""")
+    assert len(docs) == 2
+    assert docs[1].name == "second"
+
+
+# -- matcher (TestMapMatcherMatch shape) -------------------------------------
+
+def test_matcher_group_version_and_verb_dispatch():
+    m = MapMatcher.from_yaml("""
+metadata: {name: core-get}
+match: [{apiVersion: v1, resource: pods, verbs: [get, list]}]
+---
+metadata: {name: apps}
+match: [{apiVersion: apps/v1, resource: deployments, verbs: [get]}]
+---
+metadata: {name: wide}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+""")
+    get_pods = m.match(RequestMeta("get", "", "v1", "pods"))
+    assert sorted(r.name for r in get_pods) == ["core-get", "wide"]
+    assert [r.name for r in m.match(RequestMeta("list", "", "v1", "pods"))] \
+        == ["core-get"]
+    assert [r.name for r in
+            m.match(RequestMeta("get", "apps", "v1", "deployments"))] \
+        == ["apps"]
+    # wrong group/version/verb -> no match
+    assert m.match(RequestMeta("get", "apps", "v2", "deployments")) == []
+    assert m.match(RequestMeta("delete", "", "v1", "pods")) == []
+
+
+# -- tupleSets ---------------------------------------------------------------
+
+def test_tupleset_generates_per_label_and_validates_items():
+    rule = _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+check:
+  - tupleSet: >-
+      object.metadata.labels.keys().map_each(
+        "pod:" + namespacedName + "#label-" + this + "@user:" + user.name)
+""")
+    body = {"metadata": {"name": "api", "namespace": "t",
+                         "labels": {"a": "1", "b": "2"}}}
+    rels = rule.checks[0].generate(
+        _input(resource="pods", ns="t", name="api", body=body))
+    assert sorted(r.resource_relation for r in rels) == ["label-a", "label-b"]
+
+    bad = _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+check: [{tupleSet: "['not-a-relationship']"}]
+""")
+    with pytest.raises(ExprError, match="item 0"):
+        bad.checks[0].generate(_input(resource="pods"))
+
+    notalist = _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+check: [{tupleSet: "user.name"}]
+""")
+    with pytest.raises(ExprError, match="list"):
+        notalist.checks[0].generate(_input(resource="pods"))
+
+
+def test_tupleset_rejected_where_single_rel_required():
+    with pytest.raises(CompileError, match="not allowed here"):
+        _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [delete]}]
+update:
+  deleteByFilter: [{tupleSet: "['a:b#c@d:e']"}]
+""")
+
+
+# -- if conditions -----------------------------------------------------------
+
+def test_if_conditions_matrix():
+    rule = _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+if:
+  - 'user.name == "alice" || "admins" in user.groups'
+  - 'request.verb == "create"'
+check: [{tpl: "pod:{{name}}#create@user:{{user.name}}"}]
+""")
+    assert rule.conditions_pass(_input(resource="pods", user="alice"))
+    assert rule.conditions_pass(
+        _input(resource="pods", user="bob", groups=("admins",)))
+    assert not rule.conditions_pass(_input(resource="pods", user="bob"))
+
+
+def test_if_condition_non_boolean_rejected():
+    rule = _rule("""
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+if: ['user.name']
+""")
+    with pytest.raises(ExprError, match="bool"):
+        rule.conditions_pass(_input(resource="pods"))
+
+
+# -- structured templates -----------------------------------------------------
+
+def test_structured_template_round_trip():
+    rule = _rule("""
+match: [{apiVersion: v1, resource: namespaces, verbs: [create]}]
+update:
+  creates:
+    - resource: {type: namespace, id: "{{name}}", relation: creator}
+      subject: {type: user, id: "{{user.name}}"}
+    - resource: {type: namespace, id: "{{name}}", relation: viewer}
+      subject: {type: group, id: devs, relation: member}
+""")
+    rels = [r.generate(_input())[0] for r in rule.update.creates]
+    assert str(rels[0]) == "namespace:dev#creator@user:alice"
+    assert str(rels[1]) == "namespace:dev#viewer@group:devs#member"

@@ -55,6 +55,15 @@ class WatchEvent:
     relationship: Relationship
 
 
+def mask_pseudo_objects(mask: np.ndarray) -> np.ndarray:
+    """Clear the reserved per-type pseudo-object indices (0 = void,
+    1 = the wildcard object '*') from a lookup mask — shared by the direct
+    and batched lookup paths so the slot layout lives in one place."""
+    mask[0] = False
+    mask[1] = False
+    return mask
+
+
 class EngineFuture:
     """A dispatched engine query: ``result()`` blocks and post-processes.
     ``fut`` is a :class:`~...ops.reachability.QueryFuture` or ``None`` for
@@ -88,8 +97,19 @@ class Engine:
         self.validate_writes = validate_writes
         self._lock = threading.RLock()
         self._compiled: Optional[CompiledGraph] = None
+        self._batcher = None
         if seed:
             self.write_relationships([WriteOp("touch", r) for r in seed])
+
+    def enable_lookup_batching(self, window: float = 0.002,
+                               max_rows: int = 8) -> None:
+        """Coalesce concurrent lookup_resources_mask calls into fused
+        device dispatches (engine/batcher.py) — trades up to ``window``
+        seconds of added latency for one dispatch per ``max_rows``
+        concurrent list prefilters."""
+        from .batcher import LookupBatcher
+
+        self._batcher = LookupBatcher(self, window=window, max_rows=max_rows)
 
     # -- write path ---------------------------------------------------------
 
@@ -272,7 +292,15 @@ class Engine:
         """Non-blocking mask lookup; ``.result()`` -> (mask, interner).
         Concurrent list requests dispatch back-to-back and overlap their
         readbacks — the reference's goroutine-per-prefilter overlap
-        (pkg/authz/responsefilterer.go:165-183) without the goroutines."""
+        (pkg/authz/responsefilterer.go:165-183) without the goroutines.
+        With batching enabled, concurrent calls fuse into one dispatch."""
+        if self._batcher is not None and now is None:
+            # explicit-now callers bypass the batcher: a fused batch runs
+            # at one dispatch-time clock, which is only equivalent to the
+            # unbatched path for now-less queries
+            return self._batcher.submit(
+                resource_type, permission, subject_type, subject_id,
+                subject_relation)
         cg = self.compiled()
         objs = self._objects_by_name()
         off = cg.offset_of(resource_type, permission)
@@ -293,10 +321,7 @@ class Engine:
         def fin(out):
             metrics.histogram("engine_lookup_seconds").observe(
                 time.perf_counter() - t0)
-            out = np.array(out)
-            out[0] = False  # void
-            out[1] = False  # wildcard pseudo-object
-            return out, interner
+            return mask_pseudo_objects(np.array(out)), interner
 
         return EngineFuture(fut, fin)
 

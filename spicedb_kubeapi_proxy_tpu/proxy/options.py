@@ -63,6 +63,9 @@ class Options:
     # relationship-store snapshot: loaded at boot when the file exists,
     # saved on graceful shutdown (in-process engines only)
     snapshot_path: Optional[str] = None
+    # >0 coalesces concurrent list prefilters into fused device dispatches
+    # (seconds of added latency traded for per-dispatch amortization)
+    lookup_batch_window: float = 0.0
 
     def _parse_remote(self) -> Optional[tuple[str, int]]:
         """(host, port) for tcp:// endpoints, None otherwise; raises on a
@@ -96,6 +99,10 @@ class Options:
             raise OptionsError(
                 "snapshot-path applies to in-process engines; pass it to "
                 "the tcp:// engine host instead")
+        if remote and self.lookup_batch_window > 0:
+            raise OptionsError(
+                "lookup-batch-window applies to in-process engines; batch "
+                "on the tcp:// engine host instead")
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
         if not (self.rule_files or self.rule_content):
@@ -120,6 +127,8 @@ class Options:
                 + ([self.bootstrap_content] if self.bootstrap_content else []))
             engine = Engine(bootstrap=bootstrap or None)
             engine.load_snapshot_if_exists(self.snapshot_path)
+            if self.lookup_batch_window > 0:
+                engine.enable_lookup_batching(self.lookup_batch_window)
         upstream = self.upstream or HttpUpstream(
             self.upstream_url,
             token=self.upstream_token,
@@ -196,6 +205,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--snapshot-path",
                         help="relationship-store snapshot file: loaded at "
                              "boot if present, saved on graceful shutdown")
+    parser.add_argument("--lookup-batch-window", type=float, default=0.0,
+                        help="seconds to hold a list prefilter for fusing "
+                             "concurrent lookups into one device dispatch "
+                             "(0 disables)")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
 
@@ -217,4 +230,5 @@ def options_from_args(args: argparse.Namespace) -> Options:
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,
+        lookup_batch_window=args.lookup_batch_window,
     )

@@ -20,6 +20,7 @@ import argparse
 import json
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -147,9 +148,9 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
             # scan's sequential While lowering this guarantees the K
             # queries execute back-to-back, never overlapped
             seeds, _ = jax.lax.optimization_barrier((seeds, dep))
-            out, _ = _run(cg, blocks, blocks_bits, src, dst, exp,
-                          seeds, qs, qb, now_rel,
-                          max_iters=DEFAULT_MAX_ITERS)
+            out, _, _ = _run(cg, blocks, blocks_bits, src, dst, exp,
+                             seeds, qs, qb, now_rel,
+                             max_iters=DEFAULT_MAX_ITERS)
             return out.astype(jnp.int32).sum(), out[:1]
         dep, _ = jax.lax.scan(body, jnp.int32(0), seed_stack)
         return dep
@@ -305,30 +306,73 @@ definition namespace {
         f"list-queries/s/chip ({dt * 1e3 / conc:.2f}ms/query amortized)")
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true",
-                    help="small graph (CI / CPU smoke)")
-    ap.add_argument("--suite", action="store_true",
-                    help="also run BASELINE eval configs 3-5")
-    ap.add_argument("--trials", type=int, default=21)
-    args = ap.parse_args()
+def init_backend(retries: int, delay: float):
+    """Initialize the JAX backend, surviving transient TPU-plugin failures.
 
-    if args.quick:
+    BENCH_r01 died at a bare ``jax.devices()`` — the axon TPU plugin can
+    fail with UNAVAILABLE on first contact. jax's backend discovery caches
+    nothing on *failure* (xla_bridge.backends() re-runs discovery while the
+    ``_backends`` dict is empty), so retrying the same call is meaningful.
+    After ``retries`` failed attempts we pin JAX_PLATFORMS=cpu and run
+    degraded rather than forfeit the round.
+
+    Returns (devices, degraded, error_string).
+    """
+    import jax
+
+    last: Optional[str] = None
+    for attempt in range(1, retries + 1):
+        try:
+            devs = jax.devices()
+            log(f"jax {jax.__version__} backend={jax.default_backend()} "
+                f"devices={devs}")
+            return devs, False, None
+        except RuntimeError as e:
+            last = str(e).splitlines()[0][:300]
+            log(f"backend init attempt {attempt}/{retries} failed: {last}")
+            if attempt < retries:
+                time.sleep(delay)
+    log("TPU backend unavailable after retries; falling back to CPU")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        log(f"jax {jax.__version__} degraded backend="
+            f"{jax.default_backend()} devices={devs}")
+        return devs, True, last
+    except RuntimeError as e:  # even CPU failed — let caller emit JSON
+        return None, True, f"{last}; cpu fallback: {e}"
+
+
+def _measure(args, result: dict) -> None:
+    """The benchmark body; fills ``result`` in place so the caller can emit
+    whatever was measured even if a later stage dies."""
+    devs, degraded, err = init_backend(args.retries, args.retry_delay)
+    result["degraded"] = degraded
+    if err:
+        result["backend_error"] = err
+    if devs is None:
+        raise RuntimeError(f"no JAX backend available: {err}")
+    import jax
+
+    result["backend"] = jax.default_backend()
+    quick = args.quick or (degraded and not args.force_full)
+    if quick and not args.quick:
+        log("degraded backend: shrinking to --quick config")
+    if quick:
         n_pods, n_users, n_ns, n_groups, n_rels = 2_000, 500, 50, 50, 50_000
     else:
         n_pods, n_users, n_ns, n_groups, n_rels = (
             100_000, 10_000, 1_000, 1_000, 10_000_000)
 
-    import jax
-
-    log(f"jax {jax.__version__} devices={jax.devices()}")
     e, total = build_engine(n_pods, n_users, n_ns, n_groups, n_rels)
+    result["n_pods"], result["n_rels"] = n_pods, total
 
     t0 = time.perf_counter()
     cg = e.compiled()
-    log(f"compile_graph: {time.perf_counter() - t0:.1f}s "
-        f"(M={cg.M} slots, E={cg.n_edges} edges)")
+    compile_s = time.perf_counter() - t0
+    log(f"compile_graph: {compile_s:.1f}s (M={cg.M} slots, "
+        f"E={cg.n_edges} edges)")
+    result["graph_compile_s"] = round(compile_s, 2)
 
     # -- p50 list-filter latency: one user's visibility mask over all pods --
     rng = np.random.default_rng(1)
@@ -344,32 +388,62 @@ def main() -> None:
         lat.append((time.perf_counter() - t0) * 1e3)
     p50_wall = float(np.percentile(lat, 50))
     p99_wall = float(np.percentile(lat, 99))
-
-    # Per-query device time, measured as a slope: run K data-dependent
-    # queries chained inside ONE dispatch (lax.scan carry forces
-    # serialization) and take (wall_K - wall_1) / (K - 1). Both terms are
-    # real end-to-end wall measurements, so the fixed per-dispatch cost —
-    # including the dev environment's chip tunnel RTT, which a
-    # locally-attached v5e does not pay — cancels without assumptions.
-    chain_est, p50_w1, p50_wk, k = _chained_device_estimate(
-        e, subjects, trials=max(args.trials // 2, 5))
     log(f"list-filter latency over {len(lat)} trials: "
         f"p50_wall={p50_wall:.2f}ms p99_wall={p99_wall:.2f}ms")
-    log(f"chained-dispatch slope: wall(1)={p50_w1:.2f}ms "
-        f"wall({k})={p50_wk:.2f}ms -> {chain_est:.2f}ms/query device time")
-    if chain_est >= 0.05:
-        p50 = chain_est
-        note = (f"device compute per query via K-chained dispatch slope — "
-                f"excludes fixed per-dispatch host overhead (sub-ms on "
-                f"locally-attached chips); single-dispatch wall p50 "
-                f"{p50_wall:.0f}ms incl dev-tunnel RTT")
-    else:
-        p50, note = p50_wall, "wall clock incl dev-tunnel RTT"
 
-    # -- bulk-check throughput (stderr only) --
+    # The headline value is the MEASURED wall p50 (vs_baseline divides the
+    # 50ms BASELINE target by it). The chained-dispatch slope — per-query
+    # device compute with fixed dispatch overhead cancelled — is reported
+    # as a separate field, never as the headline.
+    result["metric"] = (
+        f"p50 list-filter latency (wall), {n_pods} pods @ {total} rels, "
+        f"1 chip" + (" [DEGRADED: cpu]" if degraded else ""))
+    result["value"] = round(p50_wall, 3)
+    result["unit"] = "ms"
+    result["vs_baseline"] = round(50.0 / p50_wall, 2)
+    result["p50_wall_ms"] = round(p50_wall, 3)
+    result["p99_wall_ms"] = round(p99_wall, 3)
+
+    # fixpoint depth for this query shape (dispatch-depth analog)
+    objs = e._objects_by_name()
+    seeds = np.asarray(
+        [cg.encode_subject("user", subjects[0], None, objs)], dtype=np.int32)
+    off = cg.offset_of("pod", "view")
+    n = cg.type_sizes["pod"]
+    qf = cg.query_async(seeds, off + np.arange(n, dtype=np.int32),
+                        np.zeros(n, dtype=np.int32))
+    qf.result()
+    iters = qf.iterations()
+    result["fixpoint_iters"] = iters
+
+    try:
+        chain_est, p50_w1, p50_wk, k = _chained_device_estimate(
+            e, subjects, trials=max(args.trials // 2, 5))
+        log(f"chained-dispatch slope: wall(1)={p50_w1:.2f}ms "
+            f"wall({k})={p50_wk:.2f}ms -> {chain_est:.2f}ms/query "
+            f"device time")
+        result["device_ms_estimate"] = round(chain_est, 3)
+        # roofline: bytes touched per hop x hops / device time
+        hb = cg.hop_bytes(batch=1)
+        if chain_est > 0:
+            eff_gbps = hb["total"] * iters / (chain_est * 1e-3) / 1e9
+            # v5e HBM ~819 GB/s; v4 ~1228; CPU n/a — report raw GB/s and
+            # let the reader place it on the roofline for the actual chip
+            log(f"roofline: {hb['total'] / 1e6:.1f} MB/hop x {iters} hops "
+                f"= {hb['total'] * iters / 1e6:.0f} MB streamed -> "
+                f"{eff_gbps:.0f} GB/s effective "
+                f"(residual {hb['residual'] / 1e6:.1f} MB, blocks "
+                f"{hb['blocks'] / 1e6:.1f} MB, programs "
+                f"{hb['programs'] / 1e6:.1f} MB per hop)")
+            result["hop_mb"] = round(hb["total"] / 1e6, 1)
+            result["effective_gbps"] = round(eff_gbps, 1)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        log(f"chained-dispatch estimate failed (non-fatal): {ex}")
+
+    # -- bulk-check throughput --
     from spicedb_kubeapi_proxy_tpu.engine import CheckItem
 
-    B, per = (8, 64) if args.quick else (64, 1024)
+    B, per = (8, 64) if quick else (64, 1024)
     items = [
         CheckItem("pod", f"ns/p{rng.integers(n_pods)}", "view",
                   "user", f"u{b}")
@@ -383,18 +457,65 @@ def main() -> None:
     checks_per_s = len(items) / dt
     log(f"bulk check: {len(items)} checks in {dt * 1e3:.1f}ms "
         f"= {checks_per_s:,.0f} checks/s/chip")
+    result["checks_per_s_per_chip"] = round(checks_per_s)
 
-    print(json.dumps({
-        "metric": (
-            f"p50 list-filter latency ({note}), {n_pods} pods @ {total} "
-            f"rels, 1 chip"),
-        "value": round(p50, 3),
-        "unit": "ms",
-        "vs_baseline": round(50.0 / p50, 2),
-    }), flush=True)
+    # -- interleaved write -> fully-consistent read (incremental updates) --
+    from spicedb_kubeapi_proxy_tpu.engine.store import WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+
+    wlat = []
+    wr = min(args.trials, 11)
+    for i in range(wr):
+        e.write_relationships([WriteOp("touch", Relationship(
+            "pod", f"ns/p{int(rng.integers(n_pods))}", "viewer",
+            "user", f"u{int(rng.integers(n_users))}"))])
+        t0 = time.perf_counter()
+        e.lookup_resources_mask("pod", "view", "user",
+                                subjects[i % len(subjects)])
+        wlat.append((time.perf_counter() - t0) * 1e3)
+    p50_aw = float(np.percentile(wlat, 50))
+    log(f"fully-consistent read after write: p50={p50_aw:.2f}ms "
+        f"over {wr} write->read pairs")
+    result["p50_read_after_write_ms"] = round(p50_aw, 3)
 
     if args.suite:
-        run_suite(args.quick)
+        run_suite(quick)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small graph (CI / CPU smoke)")
+    ap.add_argument("--force-full", action="store_true",
+                    help="run the full 10M config even on a degraded "
+                         "(CPU) backend")
+    ap.add_argument("--suite", action="store_true",
+                    help="also run BASELINE eval configs 3-5")
+    ap.add_argument("--trials", type=int, default=21)
+    ap.add_argument("--retries", type=int, default=5,
+                    help="TPU backend init attempts before CPU fallback")
+    ap.add_argument("--retry-delay", type=float, default=15.0)
+    args = ap.parse_args()
+
+    # The contract: this process ALWAYS prints exactly one JSON line on
+    # stdout, whatever happens (BENCH_r01 printed nothing and forfeited
+    # the round). Partial results beat no results.
+    result: dict = {
+        "metric": "p50 list-filter latency (wall), not measured",
+        "value": None, "unit": "ms", "vs_baseline": None,
+    }
+    code = 0
+    try:
+        _measure(args, result)
+    except BaseException as e:  # noqa: BLE001 - emit, then re-signal
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"[:500]
+        result["degraded"] = True
+        code = 1
+    print(json.dumps(result), flush=True)
+    sys.exit(code)
 
 
 if __name__ == "__main__":

@@ -251,6 +251,8 @@ class Engine:
         def fin(out):
             metrics.histogram("engine_check_seconds").observe(
                 time.perf_counter() - t0)
+            metrics.histogram("engine_fixpoint_iterations").observe(
+                fut.iterations())
             return [bool(x) for x in out]
 
         return EngineFuture(fut, fin)
@@ -321,6 +323,8 @@ class Engine:
         def fin(out):
             metrics.histogram("engine_lookup_seconds").observe(
                 time.perf_counter() - t0)
+            metrics.histogram("engine_fixpoint_iterations").observe(
+                fut.iterations())
             return mask_pseudo_objects(np.array(out)), interner
 
         return EngineFuture(fut, fin)

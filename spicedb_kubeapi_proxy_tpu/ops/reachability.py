@@ -353,7 +353,7 @@ class CompiledGraph:
         qb = np.zeros(Q_pad, dtype=np.int32)
         qb[:Q] = q_batch
         now_rel = np.float32((time.time() if now is None else now) - self.base_time)
-        out, converged = d["run"](
+        out, converged, iters = d["run"](
             d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
             jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
             now_rel, max_iters=max_iters,
@@ -363,7 +363,7 @@ class CompiledGraph:
             converged.copy_to_host_async()
         except AttributeError:  # non-jax array backends in tests
             pass
-        return QueryFuture(out, converged, Q, max_iters)
+        return QueryFuture(out, converged, iters, Q, max_iters)
 
     def query(
         self,
@@ -378,14 +378,44 @@ class CompiledGraph:
             seed_slots, q_slots, q_batch, now=now, max_iters=max_iters
         ).result()
 
+    def hop_bytes(self, batch: int = 1) -> dict:
+        """Estimated HBM traffic per fixpoint hop (bytes) for roofline
+        reporting: residual gather/segment streams, dense-block operand
+        streams (bit-packed or int8 A), and the elementwise program passes.
+        An estimate of bytes *touched* — XLA fusion can only reduce it, so
+        effective-bandwidth numbers derived from it are conservative."""
+        rows = self.M // LANE + 1
+        Mp = rows * LANE
+        E_res = len(self.res_idx) if self.res_idx is not None \
+            else self.n_edges
+        E_pad = _next_bucket(max(E_res, 1))
+        # per edge: src+dst int32 + valid uint8 + B gathered bytes; plus
+        # the propagated state write
+        res = E_pad * (4 + 4 + 1 + batch) + batch * Mp
+        blocks = 0
+        use_bits = batch <= bitprop.BIT_B_MAX and bitprop.kernel_enabled()
+        for b in self.blocks:
+            if use_bits and bitprop.eligible(b.n_dst, b.n_src):
+                k0 = (b.n_src + 31) // 32
+                k_pad = -(-k0 // bitprop.LANES) * bitprop.LANES
+                blocks += b.n_dst * k_pad * 4
+            else:
+                blocks += b.n_dst * b.n_src
+        prog = sum(2 * p.size * batch for p in self.programs)
+        return {"residual": res, "blocks": blocks, "programs": prog,
+                "total": res + blocks + prog}
+
 
 @dataclass
 class QueryFuture:
     """A dispatched reachability query. ``result()`` blocks and validates
-    convergence."""
+    convergence. ``iterations()`` (valid after result/convergence check)
+    reports how many fixpoint hops the query ran — the analog of SpiceDB's
+    dispatch depth, exported to the metrics registry by the engine."""
 
     _out: object
     _converged: object
+    _iters: object
     _q: int
     _max_iters: int
 
@@ -396,6 +426,9 @@ class QueryFuture:
                 "iterations (graph deeper than the dispatch budget)"
             )
         return np.asarray(self._out)[: self._q]
+
+    def iterations(self) -> int:
+        return int(self._iters)
 
 
 def _apply_program(cg: CompiledGraph, V):
@@ -515,11 +548,12 @@ def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel, seeds,
         return V2, jnp.any(V2 != V), it + 1
 
     V0 = base
-    V, still_changing, _ = jax.lax.while_loop(cond, body, (V0, jnp.bool_(True), 0))
+    V, still_changing, iters = jax.lax.while_loop(
+        cond, body, (V0, jnp.bool_(True), 0))
     # still_changing at loop exit means we hit max_iters before convergence;
     # surface it so the host can raise instead of silently denying
     out = V.reshape(B, Mp)[q_batch, q_slots].astype(jnp.bool_)
-    return out, jnp.logical_not(still_changing)
+    return out, jnp.logical_not(still_changing), iters
 
 
 # ---------------------------------------------------------------------------

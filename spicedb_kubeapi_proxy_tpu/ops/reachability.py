@@ -361,6 +361,11 @@ class CompiledGraph:
         try:
             out.copy_to_host_async()
             converged.copy_to_host_async()
+            # iters feeds the fixpoint-iterations metric in the engine's
+            # result finalizer; without the prefetch that int() is a
+            # synchronous device roundtrip per query (a full tunnel RTT on
+            # remotely-attached chips)
+            iters.copy_to_host_async()
         except AttributeError:  # non-jax array backends in tests
             pass
         return QueryFuture(out, converged, iters, Q, max_iters)

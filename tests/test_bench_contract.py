@@ -1,0 +1,89 @@
+"""bench.py contract tests: one JSON line on stdout, whatever happens.
+
+Rounds 1 and 2 forfeited their perf evidence because bench.py crashed
+(r01) or was SIGTERMed with no JSON flushed (r02). These tests drive the
+three init failure modes end-to-end as subprocesses:
+
+- hung TPU plugin (probe times out)          -> degraded CPU run, JSON out
+- SIGTERM mid-run (driver timeout kill)      -> partial JSON flushed
+- deadline expiry (watchdog thread)          -> partial JSON flushed
+
+``BENCH_PROBE_CMD`` substitutes the TPU probe so a hung plugin is a
+``sleep`` and a lying probe is an ``echo``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _env(probe_cmd):
+    env = dict(os.environ)
+    env["BENCH_PROBE_CMD"] = probe_cmd
+    return env
+
+
+def _parse_only_line(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines!r}"
+    return json.loads(lines[0])
+
+
+def test_hung_plugin_falls_back_to_cpu_and_emits_json():
+    p = subprocess.run(
+        [sys.executable, BENCH, "--tiny", "--probe-timeout", "1",
+         "--retry-delay", "0", "--retries", "2"],
+        env=_env("sleep 300"), capture_output=True, text=True, timeout=300)
+    out = _parse_only_line(p.stdout)
+    assert p.returncode == 0
+    assert out["degraded"] is True
+    assert "hung plugin" in out["backend_error"]
+    assert out["value"] is not None and out["value"] > 0
+    assert "[DEGRADED: cpu]" in out["metric"]
+
+
+def test_sigterm_flushes_partial_json():
+    p = subprocess.Popen(
+        [sys.executable, BENCH, "--tiny", "--probe-timeout", "120"],
+        env=_env("sleep 300"), stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    time.sleep(2.0)  # inside the first (hung) probe attempt
+    p.send_signal(signal.SIGTERM)
+    stdout, _ = p.communicate(timeout=60)
+    out = _parse_only_line(stdout)
+    assert out["error"] == f"killed by signal {signal.SIGTERM}"
+    assert out["degraded"] is True
+    assert p.returncode == 128 + signal.SIGTERM
+
+
+def test_deadline_watchdog_emits_partial_json():
+    # the probe lies (echo tpu) and the parent then "hangs": simulated by a
+    # probe that passes but a deadline short enough to fire during measure
+    p = subprocess.run(
+        [sys.executable, BENCH, "--tiny", "--probe-timeout", "1",
+         "--retry-delay", "0", "--retries", "1", "--deadline", "1"],
+        env=_env("sleep 300"), capture_output=True, text=True, timeout=120)
+    out = _parse_only_line(p.stdout)
+    assert p.returncode == 2
+    assert "deadline" in out["error"]
+
+
+@pytest.mark.slow
+def test_healthy_cpu_quick_run_full_contract():
+    # a probe that reports CPU -> degraded but complete measurement
+    p = subprocess.run(
+        [sys.executable, BENCH, "--tiny", "--probe-timeout", "30",
+         "--retries", "1"],
+        env=_env("echo cpu"), capture_output=True, text=True, timeout=600)
+    out = _parse_only_line(p.stdout)
+    assert p.returncode == 0
+    assert out["vs_baseline"] is not None
+    assert out["checks_per_s_per_chip"] > 0

@@ -22,6 +22,7 @@ shared bearer token gates requests like the reference's token option.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import logging
 import socket
@@ -43,6 +44,11 @@ from .store import (
 log = logging.getLogger("sdbkp.engine.remote")
 
 MAX_FRAME = 256 * 1024 * 1024
+# Until a connection has authenticated once, frames are capped far smaller:
+# an auth frame is a few hundred bytes, and the big limit exists for bulk
+# relationship payloads that only authenticated peers may send. Without this
+# an unauthenticated socket could make the server buffer 256MiB per frame.
+MAX_FRAME_PREAUTH = 1024 * 1024
 
 _ERROR_KINDS = {
     "precondition": PreconditionFailed,
@@ -78,13 +84,14 @@ def _pack(msg: dict) -> bytes:
     return struct.pack(">I", len(body)) + body
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+async def _read_frame(reader: asyncio.StreamReader,
+                      limit: int = MAX_FRAME) -> Optional[dict]:
     try:
         header = await reader.readexactly(4)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     (n,) = struct.unpack(">I", header)
-    if n > MAX_FRAME:
+    if n > limit:
         raise RemoteEngineError(f"frame of {n} bytes exceeds limit")
     body = await reader.readexactly(n)
     return json.loads(body)
@@ -122,12 +129,16 @@ class EngineServer:
 
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
+        authed = not self.token
         try:
             while True:
-                req = await _read_frame(reader)
+                limit = MAX_FRAME if authed else MAX_FRAME_PREAUTH
+                req = await _read_frame(reader, limit=limit)
                 if req is None:
                     return
                 resp = await self._dispatch(req)
+                if resp.get("ok") or resp.get("kind") != "auth":
+                    authed = True
                 writer.write(_pack(resp))
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError):
@@ -142,7 +153,8 @@ class EngineServer:
                 pass
 
     async def _dispatch(self, req: dict) -> dict:
-        if self.token and req.get("token") != self.token:
+        if self.token and not hmac.compare_digest(
+                str(req.get("token") or ""), self.token):
             return {"ok": False, "kind": "auth", "error": "invalid token"}
         op = req.get("op")
         try:
@@ -250,11 +262,13 @@ class RemoteEngine:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def _acquire(self) -> socket.socket:
-        """A live connection: pooled sockets are liveness-probed first, so
-        a stale one (engine host restarted, peer FIN pending) is replaced
-        BEFORE any request bytes are written — retrying after a send could
-        double-apply a write the server already processed."""
+    def _acquire(self) -> tuple[socket.socket, bool]:
+        """(live connection, fresh?): pooled sockets are liveness-probed
+        first, so a stale one (engine host restarted, peer FIN pending) is
+        replaced BEFORE any request bytes are written — retrying after a
+        send could double-apply a write the server already processed.
+        ``fresh`` tells the caller the server hasn't authenticated this
+        connection yet (the pre-auth frame cap applies)."""
         while True:
             with self._pool_lock:
                 if not self._pool:
@@ -270,12 +284,12 @@ class RemoteEngine:
                     probe = None
                 if alive:
                     s.settimeout(self.timeout)
-                    return s
+                    return s, False
                 del probe
             except OSError:
                 pass
             s.close()
-        return self._connect()
+        return self._connect(), True
 
     def _release(self, s: socket.socket) -> None:
         with self._pool_lock:
@@ -295,8 +309,18 @@ class RemoteEngine:
         if self.token:
             msg["token"] = self.token
         payload = _pack(msg)
-        s = self._acquire()
+        s, fresh = self._acquire()
         try:
+            if fresh and self.token and len(payload) > MAX_FRAME_PREAUTH:
+                # the server caps pre-auth frames; upgrade a fresh
+                # connection with a cheap authenticated ping before the
+                # big frame so bulk first-requests aren't dropped
+                ping = self._round_trip(
+                    s, _pack({"op": "revision", "token": self.token}))
+                if not ping.get("ok"):
+                    raise _ERROR_KINDS.get(
+                        ping.get("kind", "internal"),
+                        RemoteEngineError)(ping.get("error", ""))
             # no retry once bytes are on the wire: the server may have
             # processed the op even if the connection then died, and
             # replaying a write would double-apply it (staleness is

@@ -33,7 +33,10 @@ _TPL_RE = re.compile(
 # plus '*' for wildcard subjects; an optional [expiration:...] trait must be
 # a well-formed suffix — trailing garbage is rejected, not absorbed.
 _IDENT = r"[A-Za-z_][A-Za-z0-9_/]*"
-_ID = r"[A-Za-z0-9_.=+/-]+|\*"
+# ids additionally allow '@' (email-shaped subjects like user:alice@example.com)
+# — unambiguous because the structural '@' separator is always preceded by
+# '#relation', and relations cannot contain '@'.
+_ID = r"[A-Za-z0-9_.=+/@-]+|\*"
 _REL_RE = re.compile(
     rf"^(?P<resource_type>{_IDENT}):(?P<resource_id>{_ID})#(?P<relation>{_IDENT})"
     rf"@(?P<subject_type>{_IDENT}):(?P<subject_id>{_ID})"
@@ -123,7 +126,7 @@ def parse_relationship(text: str) -> Relationship:
 # ':' (system:serviceaccount:ns:name) and label-derived relations '/'
 # (app.kubernetes.io/name).
 _TPL_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_./-]*$")
-_TPL_ID_RE = re.compile(r"^(?:[A-Za-z0-9_.=+/:-]+|\*)$")
+_TPL_ID_RE = re.compile(r"^(?:[A-Za-z0-9_.=+/:@-]+|\*)$")
 
 
 def parse_rel_fields(text: str) -> dict:

@@ -40,9 +40,41 @@ LANES = 128
 MIN_DST = 32
 MIN_SRC = 32
 
+# VMEM is ~16MiB/core; the kernel's resident set per grid step is the
+# (double-buffered) A tile + the packed frontier + out/accumulators. Blocks
+# whose packed rows blow this even at the smallest tile fall back to the MXU
+# matmul, which XLA tiles itself — otherwise Mosaic fails AT RUNTIME on the
+# first big-block query.
+VMEM_BUDGET = int(os.environ.get("SDBKP_BITPROP_VMEM_BYTES",
+                                 12 * 1024 * 1024))
+
+
+def _k_pad(n_src: int) -> int:
+    return -(-((n_src + 31) // 32) // LANES) * LANES
+
+
+def _vmem_bytes(tile_d: int, k: int) -> int:
+    # 2x A tile (pipeline double-buffering), packed frontier, out tile and
+    # two int32 accumulators
+    return (2 * tile_d + BIT_B_MAX) * k * 4 + 3 * tile_d * LANES * 4
+
+
+def _pick_tile_for_k(n_dst: int, k: int):
+    for t in (TILE_D, 128, 64, 32):
+        if n_dst % t == 0 and _vmem_bytes(t, k) <= VMEM_BUDGET:
+            return t
+    return None
+
+
+def pick_tile(n_dst: int, n_src: int):
+    """Largest dst tile that divides n_dst and fits VMEM, or None if even
+    the smallest tile does not fit (matmul fallback)."""
+    return _pick_tile_for_k(n_dst, _k_pad(n_src))
+
 
 def eligible(n_dst: int, n_src: int) -> bool:
-    return n_dst % MIN_DST == 0 and n_src % MIN_SRC == 0
+    return (n_dst % MIN_DST == 0 and n_src % MIN_SRC == 0
+            and pick_tile(n_dst, n_src) is not None)
 
 
 def kernel_enabled() -> bool:
@@ -118,9 +150,9 @@ def bit_or_matmul(a_bits: jax.Array, v_bits: jax.Array, n_b: int) -> jax.Array:
     from jax.experimental.pallas import tpu as pltpu
 
     n_dst, k = a_bits.shape
-    # largest tile that divides n_dst exactly (eligible() guarantees the
-    # 32-row floor divides), so the grid covers every row
-    tile_d = next(t for t in (TILE_D, 128, 64, 32) if n_dst % t == 0)
+    # largest tile that divides n_dst exactly AND fits the VMEM budget
+    # (eligible() guarantees one exists), so the grid covers every row
+    tile_d = _pick_tile_for_k(n_dst, k) or MIN_DST
     out = pl.pallas_call(
         partial(_bit_kernel, n_b),
         grid=(n_dst // tile_d,),

@@ -66,6 +66,9 @@ class Options:
     # >0 coalesces concurrent list prefilters into fused device dispatches
     # (seconds of added latency traded for per-dispatch amortization)
     lookup_batch_window: float = 0.0
+    # /debug/config stays 404 unless explicitly enabled — even a sanitized
+    # topology dump is opt-in, not default-on
+    enable_debug_config: bool = False
 
     def _parse_remote(self) -> Optional[tuple[str, int]]:
         """(host, port) for tcp:// endpoints, None otherwise; raises on a
@@ -146,7 +149,8 @@ class Options:
         )
         server = Server(deps, HeaderAuthenticator(),
                         host=self.bind_host, port=self.bind_port,
-                        config_dump=self.debug_dump())
+                        config_dump=(self.debug_dump()
+                                     if self.enable_debug_config else None))
         return CompletedConfig(self, engine, workflow, deps, server)
 
     # fields safe to expose on /debug/config — an ALLOWLIST so a future
@@ -211,6 +215,9 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "(0 disables)")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+    parser.add_argument("--enable-debug-config", action="store_true",
+                        help="serve the sanitized options dump on "
+                             "/debug/config (off by default)")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -231,4 +238,5 @@ def options_from_args(args: argparse.Namespace) -> Options:
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,
         lookup_batch_window=args.lookup_batch_window,
+        enable_debug_config=args.enable_debug_config,
     )

@@ -87,13 +87,16 @@ class Server:
             except AuthenticationError as e:
                 return kube_status(401, str(e), "Unauthorized")
         if req.path == "/debug/config":
-            # authenticated-only: the dump is allowlisted, but config
-            # topology still doesn't belong on an open endpoint
+            # flag-gated (Options.enable_debug_config) AND authenticated:
+            # the dump is allowlisted, but config topology still doesn't
+            # belong on an endpoint that exists by default
+            if self.config_dump is None:
+                return kube_status(404, "not found", "NotFound")
             import json as _json
 
             return ProxyResponse(
                 status=200, headers={"Content-Type": "application/json"},
-                body=_json.dumps(self.config_dump or {}, indent=2).encode())
+                body=_json.dumps(self.config_dump, indent=2).encode())
         return await authorize(req, self.deps)
 
     # -- TCP serving ---------------------------------------------------------

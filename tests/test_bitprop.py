@@ -47,6 +47,39 @@ def test_kernel_matches_oracle(monkeypatch, n_dst, n_src, n_b):
         want, (dense @ frontier.astype(np.int32) > 0).astype(np.uint8))
 
 
+def test_vmem_budget_bounds_eligibility(monkeypatch):
+    """Blocks whose packed rows cannot fit VMEM at any tile fall back to
+    the matmul path instead of failing Mosaic compilation at runtime."""
+    # small blocks: eligible, and bigger dst picks a bigger tile
+    assert bitprop.eligible(256, 4096)
+    assert bitprop.pick_tile(256, 4096) == 256
+
+    # 10M-src block: packed K ~ 312k words -> even a 32-row tile is
+    # ~2*32*312k*4 + 8*312k*4 ≈ 90MB >> budget
+    n_src_huge = 10_000_000 - (10_000_000 % 32)
+    assert not bitprop.eligible(512, n_src_huge)
+    assert bitprop.pick_tile(512, n_src_huge) is None
+
+    # mid-size: full 256 tile busts the budget but a smaller one fits ->
+    # still eligible, with a reduced tile
+    monkeypatch.setattr(bitprop, "VMEM_BUDGET", 2 * 1024 * 1024)
+    n_src_mid = 32 * 32 * bitprop.LANES  # K = 4096 words = 16KiB rows
+    t = bitprop.pick_tile(256, n_src_mid)
+    assert t is not None and t < 256
+    assert bitprop.eligible(256, n_src_mid)
+    # and the kernel actually runs with the reduced tile
+    monkeypatch.setenv("SDBKP_BITPROP", "interpret")
+    rng = np.random.default_rng(3)
+    dst, src = _random_block(rng, 64, n_src_mid, n_edges=200)
+    a_bits = bitprop.pack_block_host(dst, src, 64, n_src_mid)
+    frontier = np.zeros((n_src_mid, 1), dtype=np.uint8)
+    frontier[src[:5], 0] = 1
+    vb = bitprop.pack_frontier(jnp.asarray(frontier.T.copy()), n_src_mid)
+    got = np.asarray(bitprop.bit_or_matmul(jnp.asarray(a_bits), vb, 1))
+    np.testing.assert_array_equal(
+        got, bitprop.bit_hop_reference(a_bits, frontier))
+
+
 def test_engine_query_parity_bit_vs_matmul(monkeypatch):
     """Same engine queries through both block representations."""
     from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp

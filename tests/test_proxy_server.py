@@ -114,6 +114,7 @@ def test_full_http_round_trips(env):
             upstream_url=f"http://127.0.0.1:{upstream_port}",
             workflow_database_path=env,
             bind_port=0,
+            enable_debug_config=True,
         ).complete()
         await cfg.run()
         alice = HttpClient(cfg.server.port, "alice")
@@ -173,7 +174,8 @@ def test_full_http_round_trips(env):
         status, _, body = await noauth.request("GET", "/metrics")
         assert status == 200 and b"proxy_requests_total" in body
         assert b"engine_checks_total" in body
-        # sanitized config dump: authenticated-only, secrets redacted
+        # sanitized config dump: flag-gated AND authenticated-only,
+        # secrets redacted
         status, _, _ = await noauth.request("GET", "/debug/config")
         assert status == 401
         status, _, body = await alice.request("GET", "/debug/config")
@@ -205,6 +207,10 @@ def test_inmemory_client(env):
         resp = await alice.get("/api/v1/namespaces")
         assert [o["metadata"]["name"]
                 for o in json.loads(resp.body)["items"]] == ["mem"]
+        # /debug/config is flag-gated: default options serve 404 even to
+        # an authenticated user
+        resp = await alice.get("/debug/config")
+        assert resp.status == 404
         await cfg.workflow.shutdown()
     asyncio.run(go())
 

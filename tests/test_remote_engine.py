@@ -127,6 +127,51 @@ def test_remote_token_auth():
     run_with_server(e, fn_bad, token="sekrit")
 
 
+def test_preauth_frame_cap():
+    """An unauthenticated connection may not make the server buffer a huge
+    frame: pre-auth frames are capped at MAX_FRAME_PREAUTH and the
+    connection is dropped without reading the body. After auth, the same
+    size is accepted (and rejected only past the big MAX_FRAME)."""
+    import struct
+
+    from spicedb_kubeapi_proxy_tpu.engine import remote as remote_mod
+
+    e = Engine()
+
+    async def fn(remote):
+        # handshake once so we know the port; then talk raw
+        await asyncio.to_thread(remote.check_bulk, [
+            CheckItem("namespace", "x", "view", "user", "y")])
+        big = remote_mod.MAX_FRAME_PREAUTH + 1
+
+        # unauthenticated socket announcing an oversized frame: server
+        # must drop the connection instead of buffering the body
+        reader, writer = await asyncio.open_connection(remote.host,
+                                                       remote.port)
+        writer.write(struct.pack(">I", big))
+        await writer.drain()
+        got = await asyncio.wait_for(reader.read(4), timeout=5)
+        assert got == b""  # closed without a response frame
+        writer.close()
+
+        # authenticated connection: the same size sails through (a padded
+        # but valid request well over the pre-auth cap)
+        pad = "p" * big
+        resp = await asyncio.to_thread(
+            remote._call, "revision", _pad=pad)
+        assert isinstance(resp, int)
+
+        # a FRESH client whose very first request is oversized must also
+        # succeed (the client pings to authenticate before the big frame)
+        fresh = RemoteEngine(remote.host, remote.port, token="sekrit")
+        try:
+            resp = await asyncio.to_thread(fresh._call, "revision", _pad=pad)
+            assert isinstance(resp, int)
+        finally:
+            fresh.close()
+    run_with_server(e, fn, token="sekrit")
+
+
 def _repo_rules() -> str:
     import os
     return open(os.path.join(os.path.dirname(__file__), "..", "deploy",

@@ -159,6 +159,14 @@ def test_parse_relationship_roundtrip():
     r4 = parse_relationship("a:b#c@d:e#...")
     assert r4.subject_relation is None
 
+    # email-shaped subject ids: '@' inside an id field is data, not the
+    # structural separator (which always follows '#relation')
+    r5 = parse_relationship("namespace:x#viewer@user:alice@example.com")
+    assert r5.subject_id == "alice@example.com"
+    assert str(r5) == "namespace:x#viewer@user:alice@example.com"
+    r6 = parse_relationship("ns:x#viewer@group:eng@corp#member")
+    assert (r6.subject_id, r6.subject_relation) == ("eng@corp", "member")
+
 
 def test_parse_relationship_errors():
     for bad in ["nope", "a:b@c:d", "a:b#c@d", ":x#y@z:w"]:
@@ -174,6 +182,10 @@ def test_parse_rel_fields_templates():
     assert f["subject_relation"] is None
     f2 = parse_rel_fields("namespace:$#view@user:{{user.name}}")
     assert f2["resource_id"] == "$"
+    # literal template ids may carry '@' (user:alice@example.com in a rule
+    # template must compile, not fail at boot)
+    f3 = parse_rel_fields("namespace:x#viewer@user:alice@example.com")
+    assert f3["subject_id"] == "alice@example.com"
 
 
 def test_parse_bootstrap_default():

@@ -143,8 +143,8 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
         dtype=np.int32,
     )  # [k, 1, 2]
 
-    def chained(blocks, blocks_bits, src, dst, exp, seed_stack, qs, qb,
-                now_rel):
+    def chained(blocks, blocks_bits, src, dst, exp, dsrc, ddst, dexp,
+                seed_stack, qs, qb, now_rel):
         def body(dep, seeds):
             # optimization_barrier ties each query's input to the previous
             # result in a way XLA cannot fold away (an arithmetic no-op
@@ -153,14 +153,15 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
             # queries execute back-to-back, never overlapped
             seeds, _ = jax.lax.optimization_barrier((seeds, dep))
             out, _, _ = _run(cg, blocks, blocks_bits, src, dst, exp,
-                             seeds, qs, qb, now_rel,
+                             dsrc, ddst, dexp, seeds, qs, qb, now_rel,
                              max_iters=DEFAULT_MAX_ITERS)
             return out.astype(jnp.int32).sum(), out[:1]
         dep, _ = jax.lax.scan(body, jnp.int32(0), seed_stack)
         return dep
 
     fn = jax.jit(chained)
-    a = (d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"])
+    a = (d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
+         d["dsrc"], d["ddst"], d["dexp"])
     jqs, jqb = jnp.asarray(qs), jnp.asarray(qb)
     s1 = jnp.asarray(seed_stack[:1])
     sk = jnp.asarray(seed_stack)

@@ -21,7 +21,12 @@ import numpy as np
 from ..models.bootstrap import Bootstrap, DEFAULT_BOOTSTRAP, parse_bootstrap
 from ..models.schema import Schema
 from ..models.tuples import Relationship
-from ..ops.reachability import CompiledGraph, compile_graph
+from ..ops.reachability import (
+    CompiledGraph,
+    MAX_DELTA_RECORDS,
+    compile_graph,
+    incremental_update,
+)
 from ..utils.metrics import metrics
 from .evaluator import OracleEvaluator
 from .store import (
@@ -197,8 +202,17 @@ class Engine:
             }
 
     def compiled(self) -> CompiledGraph:
-        """Fully-consistent snapshot: recompile if the store moved."""
+        """Fully-consistent snapshot: a stale compiled graph is brought
+        current by an O(delta) incremental update (small writes — the
+        dual-write hot path) or a full recompile (bulk loads, schema-shaped
+        changes, oversized deltas)."""
         with self._lock:
+            cur = self._compiled
+            if cur is not None and cur.revision != self.store.revision:
+                inc = self._try_incremental(cur)
+                if inc is not None:
+                    self._compiled = inc
+                    return inc
             if self._compiled is None or \
                self._compiled.revision != self.store.revision:
                 t0 = time.perf_counter()
@@ -207,6 +221,29 @@ class Engine:
                 metrics.histogram("engine_graph_compile_seconds").observe(
                     time.perf_counter() - t0)
             return self._compiled
+
+    def _try_incremental(self, cur: CompiledGraph) -> Optional[CompiledGraph]:
+        st = self.store
+        with st._lock:
+            if cur.revision < st.unlogged_revision:
+                return None  # bulk-loaded/restored changes aren't in the log
+            try:
+                records = st.watch_since(cur.revision)
+            except StoreError:
+                return None  # history trimmed past our revision
+            rev = st.revision
+        if len(records) > MAX_DELTA_RECORDS:
+            return None
+        t0 = time.perf_counter()
+        from .store import OP_DELETE
+
+        delta = [(r.op == OP_DELETE, r.rel) for r in records]
+        new = incremental_update(cur, delta, rev, st)
+        if new is not None:
+            metrics.counter("engine_graph_incremental_updates_total").inc()
+            metrics.histogram("engine_graph_incremental_seconds").observe(
+                time.perf_counter() - t0)
+        return new
 
     def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
         return self.check_bulk([item], now=now)[0]

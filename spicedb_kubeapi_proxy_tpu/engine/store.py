@@ -142,6 +142,10 @@ class Store:
         self._alive: list[np.ndarray] = []  # bool per chunk
         self._index: Optional[dict[tuple, tuple[int, int]]] = None
         self.revision = 0
+        # highest revision whose changes are NOT in the watch log
+        # (bulk_load / snapshot restore) — incremental graph updates can
+        # only start from revisions at or after this point
+        self.unlogged_revision = 0
         self._watch_log: list[WatchRecord] = []
         # history retention: beyond the cap the oldest half is dropped and
         # watchers that far behind get a StoreError (re-list + re-watch,
@@ -376,6 +380,7 @@ class Store:
                 Columns(rt, rid, rl, st, sid, srl, exp), update_index=False
             )
             self.revision += 1
+            self.unlogged_revision = self.revision
             return self.revision
 
     def read(self, f: RelationshipFilter, now: Optional[float] = None
@@ -539,6 +544,7 @@ class Store:
             self._alive = [np.ones(len(cols), dtype=bool)]
             self._index = None
             self.revision = int(meta["revision"])
+            self.unlogged_revision = self.revision
             self._watch_log = []
             # watchers from before the snapshot must re-list
             self._watch_oldest_rev = self.revision

@@ -77,14 +77,40 @@ WILDCARD_IDX = 1  # reserved per-type object index for '*'
 
 DEFAULT_MAX_ITERS = 128
 
+# Incremental-update sizing: small writes append edges into a separate
+# dst-sorted "delta" segment (own gather/segment pass) instead of
+# recompiling the whole graph; invalidated base edges get their expiration
+# forced to -inf on device. Beyond these bounds a full recompile is cheaper
+# than dragging an ever-growing delta through every hop.
+DELTA_PAD_MIN = 1024  # delta segment floor (keeps the jit signature stable)
+DELTA_MAX_EDGES = 1 << 17
+MAX_DELTA_RECORDS = 8192
+
 # jitted fixpoint functions shared across CompiledGraph revisions with equal
 # signatures (bounded: distinct schemas/bucket layouts, not revisions)
 _JIT_CACHE: dict = {}
 _JIT_CACHE_MAX = 32
 
 # serializes lazy device-state init across worker threads (one lock for all
-# graphs: init is rare — once per store revision — and never nests)
-_DEV_INIT_LOCK = threading.Lock()
+# graphs: init is rare — once per store revision); re-entrant so the shared
+# jit-cache helper can take it from both the init path (already holding it)
+# and incremental_update
+_DEV_INIT_LOCK = threading.RLock()
+
+
+def _jit_run_for(cg: "CompiledGraph"):
+    """The jitted fixpoint for cg's signature, shared across revisions.
+    Cache mutation is serialized on _DEV_INIT_LOCK — _dev_locked and
+    incremental_update would otherwise race the get/evict/insert."""
+    sig = (cg.signature(), bitprop.kernel_enabled())
+    with _DEV_INIT_LOCK:
+        run = _JIT_CACHE.get(sig)
+        if run is None:
+            run = jax.jit(partial(_run, cg), static_argnames=("max_iters",))
+            if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+                _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+            _JIT_CACHE[sig] = run
+    return run
 
 
 class ConvergenceError(RuntimeError):
@@ -173,6 +199,28 @@ class CompiledGraph:
     # gather/segment path (expiring, tiny, or too-sparse-to-densify)
     blocks: list = field(default_factory=list)
     res_idx: Optional[np.ndarray] = None
+    # incremental-update state (engine write path, incremental_update()):
+    # a small dst-sorted delta edge segment consumed by its own
+    # gather/segment pass each hop, and the (src, dst) pairs of base edges
+    # invalidated since the last full compile (consumed by ShardedGraph so
+    # a sharded view of an incrementally-updated graph stays consistent)
+    delta_src: Optional[np.ndarray] = None  # int32 [D_pad], trash-padded
+    delta_dst: Optional[np.ndarray] = None
+    delta_exp: Optional[np.ndarray] = None  # float32 rel to base_time
+    n_delta: int = 0
+    dead_pairs: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst)
+    # host residual views (padded, dst-sorted) for incremental search
+    res_src: Optional[np.ndarray] = None
+    res_dst: Optional[np.ndarray] = None
+    res_exp: Optional[np.ndarray] = None
+    # compile-time lookup tables reused by the incremental path
+    range_offs: Optional[np.ndarray] = None  # ascending slot-range offsets
+    block_index: dict = field(default_factory=dict)  # (dst_off,src_off)->i
+    self_off: Optional[np.ndarray] = None  # [n_types+1]
+    rel_off: Optional[np.ndarray] = None  # [n_types+1, n_rels+1]
+    relperm_off: Optional[np.ndarray] = None
+    # (resource tid, tupleset rel id, term slot offset, tgt_off[n_types+1])
+    arrow_maps: list = field(default_factory=list)
     # lazily-populated device state
     _device: dict = field(default_factory=dict)
 
@@ -259,7 +307,15 @@ class CompiledGraph:
             # baked into traced shapes (edge values are runtime args)
             -1 if self.res_idx is None
             else _next_bucket(max(len(self.res_idx), 1)),
+            # padded delta-segment length (grows by buckets under
+            # incremental updates; each growth re-specializes once)
+            self._delta_pad(),
         )
+
+    def _delta_pad(self) -> int:
+        if self.delta_src is not None:
+            return len(self.delta_src)
+        return _next_bucket(max(self.n_delta, 1), DELTA_PAD_MIN)
 
     def _dev(self):
         # concurrent first queries (asyncio.to_thread workers) race to
@@ -274,7 +330,10 @@ class CompiledGraph:
         d = self._device
         if not d:
             d = {}
-            if self.res_idx is None:
+            if self.res_src is not None:
+                res_src, res_dst, res_exp = \
+                    self.res_src, self.res_dst, self.res_exp
+            elif self.res_idx is None:
                 # no dense split computed: everything rides the segment path
                 res_src, res_dst, res_exp = self.src, self.dst, self.exp_rel
             else:
@@ -292,6 +351,8 @@ class CompiledGraph:
             d["src"] = jnp.asarray(res_src)
             d["dst"] = jnp.asarray(res_dst)
             d["exp"] = jnp.asarray(res_exp)
+            d["dsrc"], d["ddst"], d["dexp"] = (
+                jnp.asarray(a) for a in self._delta_host())
 
             d["blocks"] = tuple(
                 jnp.zeros((b.n_dst, b.n_src), dtype=jnp.int8)
@@ -313,17 +374,18 @@ class CompiledGraph:
             )
             # the bit-kernel toggle is baked into traces, so it is part of
             # the shared-function cache key
-            sig = (self.signature(), bitprop.kernel_enabled())
-            run = _JIT_CACHE.get(sig)
-            if run is None:
-                run = jax.jit(partial(_run, self),
-                              static_argnames=("max_iters",))
-                if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
-                    _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
-                _JIT_CACHE[sig] = run
-            d["run"] = run
+            d["run"] = _jit_run_for(self)
             self._device = d
         return self._device
+
+    def _delta_host(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Host delta segment (padded, dst-sorted); empty = all trash."""
+        if self.delta_src is not None:
+            return self.delta_src, self.delta_dst, self.delta_exp
+        pad = self._delta_pad()
+        return (np.full(pad, self.M, dtype=np.int32),
+                np.full(pad, self.M, dtype=np.int32),
+                np.full(pad, -np.inf, dtype=np.float32))
 
     def query_async(
         self,
@@ -355,6 +417,7 @@ class CompiledGraph:
         now_rel = np.float32((time.time() if now is None else now) - self.base_time)
         out, converged, iters = d["run"](
             d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
+            d["dsrc"], d["ddst"], d["dexp"],
             jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
             now_rel, max_iters=max_iters,
         )
@@ -407,8 +470,9 @@ class CompiledGraph:
             else:
                 blocks += b.n_dst * b.n_src
         prog = sum(2 * p.size * batch for p in self.programs)
-        return {"residual": res, "blocks": blocks, "programs": prog,
-                "total": res + blocks + prog}
+        delta = self._delta_pad() * (4 + 4 + 1 + batch)
+        return {"residual": res + delta, "blocks": blocks, "programs": prog,
+                "total": res + delta + blocks + prog}
 
 
 @dataclass
@@ -469,11 +533,13 @@ def _apply_program(cg: CompiledGraph, V):
     return V
 
 
-def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid, V):
+def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid,
+               dsrc, ddst, dvalid, V):
     """One hop: dense relation blocks as MXU matmuls (large batch) or
     bit-packed VPU contractions (small batch), plus residual edges as a
-    gather/segment-max. V is [B, rows, LANE]; returns prop in the flat
-    [B, rows*LANE] view (caller reshapes)."""
+    gather/segment-max, plus the (small) incremental delta segment as a
+    second gather/segment-max. V is [B, rows, LANE]; returns prop in the
+    flat [B, rows*LANE] view (caller reshapes)."""
     B = V.shape[0]
     Mp = V.shape[1] * LANE  # M + trash row
     Vflat = V.reshape(B, Mp)
@@ -484,6 +550,12 @@ def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid, V):
     prop = jax.ops.segment_max(
         gathered, dst, num_segments=Mp, indices_are_sorted=True
     ).T  # [B, Mp]
+    # delta segment: edges appended by incremental updates since the last
+    # full compile (dst-sorted on host at update time)
+    gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T  # [D_pad, B]
+    prop = prop | jax.ops.segment_max(
+        gathered_d, ddst, num_segments=Mp, indices_are_sorted=True
+    ).T
     # B is static under trace, so the representation choice is baked into
     # the compiled program: bit kernel streams 8x less HBM per hop at
     # B<=BIT_B_MAX; the MXU matmul amortizes A across large batches
@@ -526,8 +598,9 @@ def _seed_base(cg: CompiledGraph, seeds):
     return _apply_program(cg, base.reshape(B, rows, LANE))
 
 
-def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel, seeds,
-         q_slots, q_batch, now_rel, *, max_iters: int):
+def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel,
+         dsrc, ddst, dexp, seeds, q_slots, q_batch, now_rel, *,
+         max_iters: int):
     """The jitted fixpoint. V layout: [B, rows, LANE] uint8 — the slot
     space rides the lane axis so a B=1 query streams exactly M bytes per
     elementwise pass instead of a lane-padded 128x that; slot s lives at
@@ -536,10 +609,12 @@ def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel, seeds,
     rows = cg.M // LANE + 1  # + trash row (slots M .. M+LANE-1)
     Mp = rows * LANE
     valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E_res]
+    dvalid = (dexp > now_rel).astype(jnp.uint8)  # [D_pad]
     base = _seed_base(cg, seeds)
 
     def step(V):
-        prop = _propagate(cg, blocks, blocks_bits, src, dst, valid, V)
+        prop = _propagate(cg, blocks, blocks_bits, src, dst, valid,
+                          dsrc, ddst, dvalid, V)
         return _apply_program(
             cg, prop.reshape(B, rows, LANE) | base)
 
@@ -708,6 +783,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     exps.append(exp_rel_all[m])
 
     # arrow term edges
+    arrow_maps: list = []
     for (tname, pname), arrows in arrow_terms.items():
         if not arrows:
             continue
@@ -730,6 +806,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
                     continue
                 if schema.definitions[asub.type].relation_or_permission(a.target):
                     tgt_off[sub_tid] = slot_offset[(asub.type, a.target)]
+            arrow_maps.append((int(tid), int(ts_id), term_off, tgt_off))
             m = (
                 (rt == tid) & (rl == ts_id) & (srl == 0)
                 & (tgt_off[st] >= 0) & (cols.sid != WILDCARD_IDX)
@@ -797,6 +874,17 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     res_idx = (np.sort(np.concatenate(res_parts)) if res_parts
                else np.empty(0, dtype=np.int64))
 
+    # padded host residual views (dst-sorted): uploaded by _dev_locked and
+    # searched by incremental_update to invalidate deleted base edges
+    n_res = len(res_idx)
+    R_pad = _next_bucket(max(n_res, 1))
+    res_src = np.full(R_pad, M, dtype=np.int32)
+    res_dst = np.full(R_pad, M, dtype=np.int32)
+    res_exp = np.full(R_pad, -np.inf, dtype=np.float32)
+    res_src[:n_res] = src_p[res_idx]
+    res_dst[:n_res] = dst_p[res_idx]
+    res_exp[:n_res] = exp_p[res_idx]
+
     # ---- elementwise programs ----
     programs: list[_PermProgram] = []
     for tname in sorted(schema.definitions):
@@ -844,4 +932,261 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         programs=programs,
         blocks=blocks,
         res_idx=res_idx,
+        res_src=res_src,
+        res_dst=res_dst,
+        res_exp=res_exp,
+        range_offs=offs,
+        block_index={(b.dst_off, b.src_off): i
+                     for i, b in enumerate(blocks)},
+        self_off=self_off,
+        rel_off=rel_off,
+        relperm_off=relperm_off,
+        arrow_maps=arrow_maps,
     )
+
+
+# ---------------------------------------------------------------------------
+# Incremental updates: (CompiledGraph, write delta) -> CompiledGraph
+# ---------------------------------------------------------------------------
+
+
+def _edges_for_tuple(cg: CompiledGraph, store, rel):
+    """Slot-space (src, dst) edges for one relationship, mirroring the
+    vectorized extraction in compile_graph (direct / userset / arrow).
+    Returns None when the tuple cannot be mapped onto the existing slot
+    layout (new type/relation id beyond the compile-time tables, or an
+    object interned past its type's padded bucket) — the caller falls back
+    to a full recompile."""
+    tid = store.types.lookup(rel.resource_type)
+    stid = store.types.lookup(rel.subject_type)
+    rl = store.relations.lookup(rel.relation)
+    srl = store.relations.lookup(rel.subject_relation or "")
+    if None in (tid, stid, rl, srl):
+        return None
+    # the lookup tables carry a defensive +1 slack row, so the covered id
+    # range is [0, len-1): an id interned AFTER compile lands on the slack
+    # row's -1 and must force a recompile, not read as "no edge"
+    n_types = len(cg.self_off) - 1
+    n_rels = cg.rel_off.shape[1] - 1
+    if tid >= n_types or stid >= n_types or rl >= n_rels or srl >= n_rels:
+        return None  # interned after compile: tables don't cover it
+    r_objs = store.objects.get(tid)
+    s_objs = store.objects.get(stid)
+    rid = r_objs.lookup(rel.resource_id) if r_objs else None
+    sid = s_objs.lookup(rel.subject_id) if s_objs else None
+    if rid is None or sid is None:
+        return None
+    if rid >= cg.type_sizes.get(rel.resource_type, 0) \
+            or sid >= cg.type_sizes.get(rel.subject_type, 0):
+        return None  # object bucket overflow: slot layout must grow
+    dst_off = int(cg.rel_off[tid, rl])
+    if dst_off < 0:
+        return []  # not a writable relation slot (compile drops these too)
+    dst = dst_off + rid
+    edges: list[tuple[int, int]] = []
+    if srl == 0:
+        so = int(cg.self_off[stid])
+        if so >= 0:  # wildcard subjects included (index 1)
+            edges.append((so + sid, dst))
+    elif sid != WILDCARD_IDX:
+        uo = int(cg.relperm_off[stid, srl])
+        if uo >= 0:
+            edges.append((uo + sid, dst))
+    if srl == 0 and sid != WILDCARD_IDX:
+        for a_tid, ts_id, term_off, tgt_off in cg.arrow_maps:
+            if a_tid == tid and ts_id == rl and int(tgt_off[stid]) >= 0:
+                edges.append((int(tgt_off[stid]) + sid, term_off + rid))
+    return edges
+
+
+def _pair_block(cg: CompiledGraph, src: int, dst: int):
+    """Dense-block index covering a (src, dst) slot pair, or None."""
+    if not cg.block_index:
+        return None
+    offs = cg.range_offs
+    d_rid = int(np.searchsorted(offs, dst, side="right")) - 1
+    s_rid = int(np.searchsorted(offs, src, side="right")) - 1
+    return cg.block_index.get((int(offs[d_rid]), int(offs[s_rid])))
+
+
+def _res_positions(cg: CompiledGraph, src: int, dst: int) -> list[int]:
+    """Base-residual positions holding the (src, dst) edge (dst-sorted
+    arrays; the per-dst run is scanned for the src match)."""
+    lo = int(np.searchsorted(cg.res_dst, dst, side="left"))
+    hi = int(np.searchsorted(cg.res_dst, dst, side="right"))
+    if lo == hi:
+        return []
+    return (lo + np.flatnonzero(cg.res_src[lo:hi] == src)).tolist()
+
+
+def incremental_update(cg: CompiledGraph, records, new_revision: int,
+                       store) -> Optional[CompiledGraph]:
+    """Apply a write delta — ``records`` is an ordered list of
+    ``(is_delete, Relationship)`` derived from the store watch log since
+    cg.revision — to a compiled graph without recompiling: deleted/
+    re-touched base edges are invalidated in place (expiration forced to
+    -inf on device; dense-block cells cleared functionally), new edges
+    land in the small dst-sorted delta segment. Returns a new
+    CompiledGraph sharing all static state (in-flight queries keep the old
+    immutable one), or None when the delta cannot be expressed against the
+    existing slot layout — the caller then runs compile_graph from a fresh
+    snapshot.
+
+    Keeps the fully-consistent-read contract (reference
+    pkg/authz/check.go:42-44) at O(delta) instead of O(graph) per write.
+    """
+    if len(records) > MAX_DELTA_RECORDS or cg.res_src is None \
+            or cg.self_off is None:
+        return None
+
+    # current delta segment content -> last-state dict keyed by (src, dst)
+    delta_state: dict[tuple[int, int], float] = {}
+    if cg.delta_src is not None:
+        for i in range(cg.n_delta):
+            delta_state[(int(cg.delta_src[i]), int(cg.delta_dst[i]))] = \
+                float(cg.delta_exp[i])
+
+    res_inval: set[int] = set()
+    block_cells: dict[int, dict[tuple[int, int], int]] = {}
+    dead: list[tuple[int, int]] = []
+
+    for is_delete, relationship in records:
+        edges = _edges_for_tuple(cg, store, relationship)
+        if edges is None:
+            return None
+        for src, dst in edges:
+            # invalidate everywhere the BASE edge may live (idempotent):
+            # dense-block cell cleared, residual expiration forced stale,
+            # and the pair recorded so ShardedGraph can replay the kill
+            # against the full host edge arrays
+            b = _pair_block(cg, src, dst)
+            if b is not None:
+                bm = cg.blocks[b]
+                block_cells.setdefault(b, {})[
+                    (dst - bm.dst_off, src - bm.src_off)] = 0
+            for p in _res_positions(cg, src, dst):
+                res_inval.add(p)
+            delta_state.pop((src, dst), None)
+            dead.append((src, dst))
+            if is_delete:
+                continue
+            # adds (including re-touches of block-covered pairs) always
+            # land in the delta segment — one ledger for both the
+            # single-chip and sharded consumers; blocks are only cleared
+            exp_rel = (np.inf if relationship.expiration is None
+                       else relationship.expiration - cg.base_time)
+            delta_state[(src, dst)] = float(exp_rel)
+
+    n_delta = len(delta_state)
+    if n_delta > DELTA_MAX_EDGES:
+        return None
+
+    # rebuild the delta segment, dst-sorted (indices_are_sorted in the
+    # delta segment pass relies on this), padded to its bucket
+    pad = max(_next_bucket(max(n_delta, 1), DELTA_PAD_MIN), cg._delta_pad())
+    d_src = np.full(pad, cg.M, dtype=np.int32)
+    d_dst = np.full(pad, cg.M, dtype=np.int32)
+    d_exp = np.full(pad, -np.inf, dtype=np.float32)
+    if n_delta:
+        pairs = np.array(list(delta_state.keys()), dtype=np.int64)
+        exps = np.array(list(delta_state.values()), dtype=np.float32)
+        order = np.argsort(pairs[:, 1], kind="stable")
+        d_src[:n_delta] = pairs[order, 0]
+        d_dst[:n_delta] = pairs[order, 1]
+        d_exp[:n_delta] = exps[order]
+
+    # update host residual expirations (next incremental builds on them)
+    res_exp = cg.res_exp
+    if res_inval:
+        res_exp = res_exp.copy()
+        res_exp[list(res_inval)] = -np.inf
+
+    dead_pairs = np.array(dead, dtype=np.int64).reshape(-1, 2)
+    if cg.dead_pairs is not None and len(cg.dead_pairs):
+        dead_pairs = np.concatenate([cg.dead_pairs, dead_pairs])
+    if len(dead_pairs) > DELTA_MAX_EDGES:
+        return None
+
+    new = CompiledGraph(
+        schema=cg.schema,
+        revision=new_revision,
+        base_time=cg.base_time,
+        M=cg.M,
+        slot_offset=cg.slot_offset,
+        type_sizes=cg.type_sizes,
+        src=cg.src,
+        dst=cg.dst,
+        exp_rel=cg.exp_rel,
+        n_edges=cg.n_edges,
+        programs=cg.programs,
+        blocks=cg.blocks,
+        res_idx=cg.res_idx,
+        delta_src=d_src,
+        delta_dst=d_dst,
+        delta_exp=d_exp,
+        n_delta=n_delta,
+        dead_pairs=dead_pairs,
+        res_src=cg.res_src,
+        res_dst=cg.res_dst,
+        res_exp=res_exp,
+        range_offs=cg.range_offs,
+        block_index=cg.block_index,
+        self_off=cg.self_off,
+        rel_off=cg.rel_off,
+        relperm_off=cg.relperm_off,
+        arrow_maps=cg.arrow_maps,
+    )
+
+    # device state: functional updates against the old graph's arrays —
+    # published into the NEW graph only, so concurrent queries against the
+    # old graph keep a consistent view
+    old = cg._dev()
+    d = dict(old)
+    if res_inval:
+        d["exp"] = old["exp"].at[np.fromiter(
+            res_inval, dtype=np.int64)].set(-np.inf)
+    if block_cells:
+        blocks_dev = list(old["blocks"])
+        bits_dev = list(old["blocks_bits"])
+        for b, cells in block_cells.items():
+            dl = np.fromiter((c[0] for c in cells), dtype=np.int32,
+                             count=len(cells))
+            sl = np.fromiter((c[1] for c in cells), dtype=np.int32,
+                             count=len(cells))
+            vals = np.fromiter(cells.values(), dtype=np.int8,
+                               count=len(cells))
+            blocks_dev[b] = blocks_dev[b].at[dl, sl].set(vals)
+            bits = bits_dev[b]
+            if bits is not None:
+                # group per (row, word): multiple cells can share a packed
+                # word, and a gather-modify-scatter with duplicate indices
+                # would drop updates
+                agg: dict[tuple[int, int], tuple[int, int]] = {}
+                for (dli, sli), v in cells.items():
+                    k = (dli, sli // 32)
+                    setm, clrm = agg.get(k, (0, 0))
+                    bit = 1 << (sli % 32)
+                    if v:
+                        setm |= bit
+                    else:
+                        clrm |= bit
+                    agg[k] = (setm, clrm)
+                rows = np.array([k[0] for k in agg], dtype=np.int32)
+                words = np.array([k[1] for k in agg], dtype=np.int32)
+                sets = np.array([v[0] for v in agg.values()],
+                                dtype=np.uint32)
+                clrs = np.array([v[1] for v in agg.values()],
+                                dtype=np.uint32)
+                cur = bits[rows, words]
+                bits_dev[b] = bits.at[rows, words].set(
+                    (cur & jnp.asarray(~clrs)) | jnp.asarray(sets))
+        d["blocks"] = tuple(blocks_dev)
+        d["blocks_bits"] = tuple(bits_dev)
+    d["dsrc"] = jnp.asarray(d_src)
+    d["ddst"] = jnp.asarray(d_dst)
+    d["dexp"] = jnp.asarray(d_exp)
+    if new.signature() != cg.signature():
+        # delta bucket grew: re-specialize (cached per signature)
+        d["run"] = _jit_run_for(new)
+    new._device = d
+    return new

@@ -105,16 +105,38 @@ class ShardedGraph:
         self.nd = mesh.shape["data"]
         self.ng = mesh.shape["graph"]
 
-        E_pad = len(cg.src)
+        # fold incremental-update state into the base edge set: dead base
+        # edges are invalidated (expiration -> -inf; the query-time mask
+        # drops them, row order untouched), delta edges are merged in and
+        # the whole set re-sorted by dst (each contiguous chunk must stay
+        # sorted for the per-shard segment_max)
+        b_src = cg.src[: cg.n_edges].astype(np.int32, copy=False)
+        b_dst = cg.dst[: cg.n_edges].astype(np.int32, copy=False)
+        b_exp = cg.exp_rel[: cg.n_edges].astype(np.float32, copy=True)
+        if cg.dead_pairs is not None and len(cg.dead_pairs):
+            for s, t in cg.dead_pairs.tolist():
+                lo = int(np.searchsorted(b_dst, t, side="left"))
+                hi = int(np.searchsorted(b_dst, t, side="right"))
+                if lo < hi:
+                    hit = lo + np.flatnonzero(b_src[lo:hi] == s)
+                    b_exp[hit] = -np.inf
+        if cg.n_delta:
+            b_src = np.concatenate([b_src, cg.delta_src[: cg.n_delta]])
+            b_dst = np.concatenate([b_dst, cg.delta_dst[: cg.n_delta]])
+            b_exp = np.concatenate([b_exp, cg.delta_exp[: cg.n_delta]])
+            order = np.argsort(b_dst, kind="stable")
+            b_src, b_dst, b_exp = b_src[order], b_dst[order], b_exp[order]
+
+        E_pad = max(len(cg.src), len(b_src))
         if E_pad % self.ng:
             # re-pad with trash edges so the graph axis divides evenly
             E_pad = ((E_pad + self.ng - 1) // self.ng) * self.ng
         src = np.full(E_pad, cg.M, dtype=np.int32)
         dst = np.full(E_pad, cg.M, dtype=np.int32)
         exp = np.full(E_pad, -np.inf, dtype=np.float32)
-        src[: len(cg.src)] = cg.src
-        dst[: len(cg.dst)] = cg.dst
-        exp[: len(cg.exp_rel)] = cg.exp_rel
+        src[: len(b_src)] = b_src
+        dst[: len(b_dst)] = b_dst
+        exp[: len(b_exp)] = b_exp
 
         edge_sh = NamedSharding(mesh, P("graph"))
         self._src = jax.device_put(src, edge_sh)

@@ -161,3 +161,44 @@ def test_sharded_expiration_mask():
                    dtype=np.int32)
     got = sg.query_grid(seeds, q, now=now)
     assert got.tolist() == [[True, False]]
+
+
+def test_sharded_sees_incremental_updates():
+    """A ShardedGraph built from an incrementally-updated CompiledGraph
+    folds the delta segment and dead-pair kills into its edge shards."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import RelationshipFilter
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    e, users = build_engine(seed=23)
+    e.compiled()
+    c0 = metrics.counter("engine_graph_compiles_total").value
+
+    # revoke one existing reader tuple and grant a new one — both must be
+    # applied incrementally (no full recompile)
+    existing = sorted(
+        e.read_relationships(RelationshipFilter(
+            resource_type="doc", relation="reader", subject_type="user")),
+        key=str)[0]
+    e.write_relationships([
+        WriteOp("delete", existing),
+        WriteOp("touch", parse_relationship("doc:d1#reader@user:u7")),
+        WriteOp("touch", parse_relationship("group:g0#member@user:u6")),
+    ])
+    cg = e.compiled()
+    assert metrics.counter("engine_graph_compiles_total").value == c0
+    assert cg.n_delta >= 2 and len(cg.dead_pairs) >= 1
+
+    objs = e._objects_by_name()
+    sg = ShardedGraph(cg, make_mesh(8, data=2, graph=4))
+    subjects = [("user", u) for u in users]
+    seeds, q, _ = grid_for_lookup(cg, objs, subjects, "doc", "read")
+    got = sg.query_grid(seeds, q)
+    interner = objs["doc"]
+    for b, (_, u) in enumerate(subjects):
+        want = set(e.lookup_resources("doc", "read", "user", u))
+        got_ids = {
+            interner.string(i)
+            for i in np.flatnonzero(got[b]).tolist()
+            if i >= 2 and i < len(interner)
+        }
+        assert got_ids == want, f"subject {u}: {got_ids} != {want}"

@@ -147,7 +147,7 @@ class LookupBatcher:
             metas.append((fut, interner, n))
         t0 = time.perf_counter()
         if seeds:
-            qfut = cg.query_async(
+            qfut = e._backend(cg).query_async(
                 np.asarray(seeds, dtype=np.int32),
                 np.concatenate(q_parts), np.concatenate(qb_parts))
         else:

@@ -101,12 +101,18 @@ _DEV_INIT_LOCK = threading.RLock()
 def _jit_run_for(cg: "CompiledGraph"):
     """The jitted fixpoint for cg's signature, shared across revisions.
     Cache mutation is serialized on _DEV_INIT_LOCK — _dev_locked and
-    incremental_update would otherwise race the get/evict/insert."""
+    incremental_update would otherwise race the get/evict/insert.
+
+    The closure captures a slim static-metadata view, NOT the graph: a
+    captured CompiledGraph would pin its host edge arrays and _device HBM
+    buffers for as long as the cache entry lives — a dead-revision memory
+    leak proportional to graph size x cached signatures."""
     sig = (cg.signature(), bitprop.kernel_enabled())
     with _DEV_INIT_LOCK:
         run = _JIT_CACHE.get(sig)
         if run is None:
-            run = jax.jit(partial(_run, cg), static_argnames=("max_iters",))
+            run = jax.jit(partial(_run, cg.run_meta()),
+                          static_argnames=("max_iters",))
             if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
                 _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
             _JIT_CACHE[sig] = run
@@ -148,9 +154,14 @@ class _BlockMeta:
     n_dst: int
     src_off: int
     n_src: int
-    # host-side local edge coordinates used to materialize A on device
-    dst_local: np.ndarray
-    src_local: np.ndarray
+    # host-side local edge coordinates used to materialize A on device;
+    # None in the slim run_meta() view (the traced code reads offsets only)
+    dst_local: Optional[np.ndarray]
+    src_local: Optional[np.ndarray]
+
+    def slim(self) -> "_BlockMeta":
+        return _BlockMeta(self.dst_off, self.n_dst, self.src_off,
+                          self.n_src, None, None)
 
 
 # dense-block eligibility: a block must carry enough edges to beat the
@@ -173,6 +184,17 @@ class _PermProgram:
     expr: Expr
     # leaf name -> slot offset (RelationRef name or Arrow term id)
     leaf_off: dict
+
+
+@dataclass(frozen=True)
+class RunMeta:
+    """What the traced fixpoint reads from the graph: slot count,
+    permission programs, dense-block offsets. Captured by jit closures in
+    place of the full CompiledGraph (see _jit_run_for)."""
+
+    M: int
+    programs: tuple
+    blocks: tuple
 
 
 @dataclass
@@ -317,6 +339,16 @@ class CompiledGraph:
             return len(self.delta_src)
         return _next_bucket(max(self.n_delta, 1), DELTA_PAD_MIN)
 
+    def run_meta(self) -> "RunMeta":
+        """Slim static-metadata view for jit closures: everything the
+        traced fixpoint reads from the graph object, nothing that holds
+        host edge arrays or device buffers alive."""
+        return RunMeta(
+            M=self.M,
+            programs=tuple(self.programs),
+            blocks=tuple(b.slim() for b in self.blocks),
+        )
+
     def _dev(self):
         # concurrent first queries (asyncio.to_thread workers) race to
         # initialize; build into a local dict and publish atomically
@@ -354,29 +386,55 @@ class CompiledGraph:
             d["dsrc"], d["ddst"], d["dexp"] = (
                 jnp.asarray(a) for a in self._delta_host())
 
-            d["blocks"] = tuple(
-                jnp.zeros((b.n_dst, b.n_src), dtype=jnp.int8)
-                .at[jnp.asarray(b.dst_local), jnp.asarray(b.src_local)]
-                .set(1)
-                for b in self.blocks
-            )
-            # bit-packed duals of the dense blocks for the small-batch
-            # latency path (ops/bitprop.py); None = block stays matmul-only.
-            # Packing + device residency is skipped entirely when the bit
-            # kernel cannot run (the toggle is part of the jit-cache key,
-            # so no trace reads the bits in that case).
+            # dense blocks from host meta, minus any cells killed by
+            # incremental updates since the last full compile (host meta is
+            # not rewritten by incremental_update; dead_pairs is the ledger)
+            blocks_dev = []
             bits_on = bitprop.kernel_enabled()
-            d["blocks_bits"] = tuple(
-                jnp.asarray(bitprop.pack_block_host(
-                    b.dst_local, b.src_local, b.n_dst, b.n_src))
-                if bits_on and bitprop.eligible(b.n_dst, b.n_src) else None
-                for b in self.blocks
-            )
+            bits_dev = []
+            for b in self.blocks:
+                dl_dead, sl_dead = self._dead_cells(b)
+                A = jnp.zeros((b.n_dst, b.n_src), dtype=jnp.int8) \
+                    .at[jnp.asarray(b.dst_local),
+                        jnp.asarray(b.src_local)].set(1)
+                if len(dl_dead):
+                    A = A.at[jnp.asarray(dl_dead),
+                             jnp.asarray(sl_dead)].set(0)
+                blocks_dev.append(A)
+                # bit-packed dual for the small-batch latency path
+                # (ops/bitprop.py); None = block stays matmul-only. Packing
+                # + device residency is skipped entirely when the bit
+                # kernel cannot run (the toggle is part of the jit-cache
+                # key, so no trace reads the bits in that case).
+                if bits_on and bitprop.eligible(b.n_dst, b.n_src):
+                    bits = bitprop.pack_block_host(
+                        b.dst_local, b.src_local, b.n_dst, b.n_src)
+                    if len(dl_dead):
+                        np.bitwise_and.at(
+                            bits, (dl_dead, sl_dead // 32),
+                            ~(np.uint32(1) << (sl_dead % 32).astype(
+                                np.uint32)))
+                    bits_dev.append(jnp.asarray(bits))
+                else:
+                    bits_dev.append(None)
+            d["blocks"] = tuple(blocks_dev)
+            d["blocks_bits"] = tuple(bits_dev)
             # the bit-kernel toggle is baked into traces, so it is part of
             # the shared-function cache key
             d["run"] = _jit_run_for(self)
             self._device = d
         return self._device
+
+    def _dead_cells(self, bm: _BlockMeta) -> tuple[np.ndarray, np.ndarray]:
+        """Local (dst, src) coordinates of dead_pairs falling inside a
+        dense block's ranges."""
+        if self.dead_pairs is None or not len(self.dead_pairs):
+            z = np.empty(0, dtype=np.int64)
+            return z, z
+        s, t = self.dead_pairs[:, 0], self.dead_pairs[:, 1]
+        m = ((t >= bm.dst_off) & (t < bm.dst_off + bm.n_dst)
+             & (s >= bm.src_off) & (s < bm.src_off + bm.n_src))
+        return t[m] - bm.dst_off, s[m] - bm.src_off
 
     def _delta_host(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Host delta segment (padded, dst-sorted); empty = all trash."""
@@ -1104,6 +1162,11 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     dead_pairs = np.array(dead, dtype=np.int64).reshape(-1, 2)
     if cg.dead_pairs is not None and len(cg.dead_pairs):
         dead_pairs = np.concatenate([cg.dead_pairs, dead_pairs])
+    if len(dead_pairs):
+        # dedup: a hot tuple retouched N times must not grow the kill list
+        # N entries deep (it would eventually force spurious recompiles and
+        # slow every ShardedGraph replay)
+        dead_pairs = np.unique(dead_pairs, axis=0)
     if len(dead_pairs) > DELTA_MAX_EDGES:
         return None
 
@@ -1139,8 +1202,13 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
 
     # device state: functional updates against the old graph's arrays —
     # published into the NEW graph only, so concurrent queries against the
-    # old graph keep a consistent view
-    old = cg._dev()
+    # old graph keep a consistent view. If the old graph never initialized
+    # single-chip device state (mesh engines query through ShardedGraph
+    # instead), don't force it here: a later lazy _dev_locked builds
+    # correctly from the updated host arrays + dead pairs.
+    old = cg._device
+    if not old:
+        return new
     d = dict(old)
     if res_inval:
         d["exp"] = old["exp"].at[np.fromiter(

@@ -3,20 +3,34 @@
 Wraps a :class:`~spicedb_kubeapi_proxy_tpu.ops.reachability.CompiledGraph`
 and runs the same fixpoint over a ``("data", "graph")`` mesh:
 
-- the (dst-sorted) edge arrays are split into contiguous chunks along the
-  ``graph`` axis; every chip gathers/segment-maxes over its chunk and the
-  partial propagations are joined with ``lax.pmax`` over ICI — the sparse
-  analog of tensor-parallel partial-sum matmuls;
-- the query batch (rows of the state tensor ``V[M+1, B]``) is sharded along
-  the ``data`` axis — concurrent requests, the reference's goroutine fan-out
+- the (dst-sorted) residual edge arrays are split into contiguous chunks
+  along the ``graph`` axis; every chip gathers/segment-maxes over its chunk
+  and the partial propagations are joined with ``lax.pmax`` over ICI — the
+  sparse analog of tensor-parallel partial-sum matmuls;
+- dense relation blocks ride the MXU *inside* the shard_map body: each
+  block's ``A[n_dst, n_src]`` int8 matrix is sharded along the src axis
+  (``P(None, "graph")``), every chip contracts its frontier column chunk
+  against its A chunk, and the same pmax join ORs the partial products —
+  textbook tensor parallelism with (AND, OR) in place of (*, +);
+- the query batch (rows of the state tensor) is sharded along the ``data``
+  axis — concurrent requests, the reference's goroutine fan-out
   (pkg/authz/check.go:77-93), each chip answering its own requests;
 - the convergence test is a collective OR over both axes so every chip runs
   the same number of fixpoint steps.
 
-The query surface is a *grid*: ``B`` subjects × ``Q`` result slots per
-subject, which covers both bulk checks (Q = checks per subject) and
-concurrent list prefilters (Q = the resource type's object space, one row
-per request) — BASELINE config 5's shape.
+The query surface is both a *grid* (``B`` subjects x ``Q`` result slots
+per subject — bulk checks and concurrent list prefilters, BASELINE config
+5's shape) and the engine's flat ``query_async(seeds, q_slots, q_batch)``
+form, so :class:`~spicedb_kubeapi_proxy_tpu.engine.engine.Engine` can
+route every check/lookup through the mesh unchanged (``Engine(mesh=...)``
+/ ``--engine-mesh``).
+
+Incremental updates are O(delta) here too: :meth:`ShardedGraph.updated`
+reuses the jitted shard_map and the resident base edge shards, applying
+only the new dead-pair kills (functional expiration/block-cell updates)
+and re-uploading the small sharded delta segment — mirroring the
+single-chip incremental path instead of rebuilding and re-placing the
+whole graph per write.
 """
 
 from __future__ import annotations
@@ -47,18 +61,24 @@ from ..ops.reachability import (
 )
 
 
-def _run_sharded(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots,
-                 now_rel, *, max_iters: int):
+def _run_sharded(meta, block_meta, ng: int, blocks, src, dst, exp_rel,
+                 dsrc, ddst, dexp, seeds, q_slots, now_rel, *,
+                 max_iters: int):
     """Per-device body (inside shard_map). Shapes are the LOCAL shards:
-    src/dst/exp_rel [E/ng]; seeds [B/nd, 2]; q_slots [B/nd, Q]. State
-    layout matches the single-chip fixpoint: [B, rows, LANE] with the
-    slot space on the lane axis."""
+    blocks[i] [n_dst, n_src/ng]; src/dst/exp_rel [E/ng]; dsrc/ddst/dexp
+    [D/ng] (the incremental delta segment); seeds [B/nd, 2]; q_slots
+    [B/nd, Q]. ``meta`` is a slim RunMeta (not the CompiledGraph — the
+    closure must not pin host/device graph state). State layout matches
+    the single-chip fixpoint: [B, rows, LANE], slot space on the lane
+    axis."""
     B = seeds.shape[0]
-    rows = cg.M // LANE + 1  # + trash row
+    rows = meta.M // LANE + 1  # + trash row
     Mp = rows * LANE
     valid = (exp_rel > now_rel).astype(jnp.uint8)
+    dvalid = (dexp > now_rel).astype(jnp.uint8)
     brange = jnp.arange(B, dtype=jnp.int32)
-    base = _seed_base(cg, seeds)
+    base = _seed_base(meta, seeds)
+    g_idx = jax.lax.axis_index("graph")
 
     def step(V):
         Vflat = V.reshape(B, Mp)
@@ -66,9 +86,29 @@ def _run_sharded(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots,
         # edges are dst-sorted globally, so each contiguous chunk is sorted
         prop = jax.ops.segment_max(
             gathered, dst, num_segments=Mp, indices_are_sorted=True
-        ).T  # [B, Mp]
-        prop = jax.lax.pmax(prop, "graph")  # join edge shards over ICI
-        return _apply_program(cg, prop.reshape(B, rows, LANE) | base)
+        ).T  # [B, Mp] — this chip's partial
+        # incremental delta segment: same gather/segment form, tiny
+        gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T
+        prop = prop | jax.ops.segment_max(
+            gathered_d, ddst, num_segments=Mp, indices_are_sorted=True
+        ).T
+        # dense blocks: this chip contracts its src-axis chunk of A against
+        # the matching frontier columns; pmax below ORs the partials
+        for bm, A in zip(block_meta, blocks):
+            chunk = bm.n_src // ng
+            frontier = jax.lax.dynamic_slice(
+                Vflat, (0, bm.src_off + g_idx * chunk), (B, chunk))
+            contrib = (
+                jax.lax.dot_general(
+                    frontier.astype(jnp.int8), A,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32) > 0
+            ).astype(jnp.uint8)  # [B, n_dst]
+            cur = jax.lax.dynamic_slice(prop, (0, bm.dst_off), (B, bm.n_dst))
+            prop = jax.lax.dynamic_update_slice(
+                prop, cur | contrib, (0, bm.dst_off))
+        prop = jax.lax.pmax(prop, "graph")  # join partials over ICI
+        return _apply_program(meta, prop.reshape(B, rows, LANE) | base)
 
     def cond(state):
         _, prev_changed, it = state
@@ -82,19 +122,56 @@ def _run_sharded(cg: CompiledGraph, src, dst, exp_rel, seeds, q_slots,
         changed = jax.lax.pmax(changed, ("data", "graph"))
         return V2, changed, it + 1
 
-    V, still_changing, _ = jax.lax.while_loop(
+    V, still_changing, iters = jax.lax.while_loop(
         cond, body, (base, jnp.int32(1), 0)
     )
     out = V.reshape(B, Mp)[brange[:, None], q_slots].astype(jnp.bool_)
-    return out, (still_changing == 0)
+    return out, (still_changing == 0), iters
+
+
+class ShardedQueryFuture:
+    """A dispatched sharded query (grid or flat form). ``result()`` blocks
+    and validates convergence; ``iterations()`` mirrors the single-chip
+    QueryFuture so the engine's metrics finalizers work unchanged."""
+
+    __slots__ = ("_out", "_converged", "_iters", "_sel", "_max_iters")
+
+    def __init__(self, out, converged, iters, sel, max_iters):
+        self._out = out
+        self._converged = converged
+        self._iters = iters
+        self._sel = sel  # None (grid) | (rows, cols) flat re-mapping
+        self._max_iters = max_iters
+
+    def result(self) -> np.ndarray:
+        if not bool(self._converged):
+            raise ConvergenceError(
+                f"sharded reachability did not converge within "
+                f"{self._max_iters} iterations"
+            )
+        out = np.asarray(self._out)
+        if self._sel is None:
+            return out
+        rows, cols = self._sel
+        return out[rows, cols]
+
+    def iterations(self) -> int:
+        return int(self._iters)
+
+
+def _pair_keys(pairs: Optional[np.ndarray]) -> np.ndarray:
+    if pairs is None or not len(pairs):
+        return np.empty(0, dtype=np.int64)
+    return pairs[:, 0].astype(np.int64) * (1 << 32) + pairs[:, 1]
 
 
 class ShardedGraph:
     """A CompiledGraph pinned across a device mesh.
 
-    Edge tensors are placed once with a ``P("graph")`` sharding and stay
-    device-resident across queries; only seeds/queries move host→device
-    per call.
+    Edge tensors and dense-block matrices are placed once with ``graph``-
+    axis shardings and stay device-resident across queries; only
+    seeds/queries (and, after incremental writes, the small delta segment)
+    move host->device.
     """
 
     def __init__(self, cg: CompiledGraph, mesh: Mesh,
@@ -104,30 +181,11 @@ class ShardedGraph:
         self.max_iters = max_iters
         self.nd = mesh.shape["data"]
         self.ng = mesh.shape["graph"]
+        self._edge_sh = NamedSharding(mesh, P("graph"))
+        self._block_sh = NamedSharding(mesh, P(None, "graph"))
 
-        # fold incremental-update state into the base edge set: dead base
-        # edges are invalidated (expiration -> -inf; the query-time mask
-        # drops them, row order untouched), delta edges are merged in and
-        # the whole set re-sorted by dst (each contiguous chunk must stay
-        # sorted for the per-shard segment_max)
-        b_src = cg.src[: cg.n_edges].astype(np.int32, copy=False)
-        b_dst = cg.dst[: cg.n_edges].astype(np.int32, copy=False)
-        b_exp = cg.exp_rel[: cg.n_edges].astype(np.float32, copy=True)
-        if cg.dead_pairs is not None and len(cg.dead_pairs):
-            for s, t in cg.dead_pairs.tolist():
-                lo = int(np.searchsorted(b_dst, t, side="left"))
-                hi = int(np.searchsorted(b_dst, t, side="right"))
-                if lo < hi:
-                    hit = lo + np.flatnonzero(b_src[lo:hi] == s)
-                    b_exp[hit] = -np.inf
-        if cg.n_delta:
-            b_src = np.concatenate([b_src, cg.delta_src[: cg.n_delta]])
-            b_dst = np.concatenate([b_dst, cg.delta_dst[: cg.n_delta]])
-            b_exp = np.concatenate([b_exp, cg.delta_exp[: cg.n_delta]])
-            order = np.argsort(b_dst, kind="stable")
-            b_src, b_dst, b_exp = b_src[order], b_dst[order], b_exp[order]
-
-        E_pad = max(len(cg.src), len(b_src))
+        b_src, b_dst, b_exp, kept = self._host_base_split()
+        E_pad = _next_bucket(max(len(b_src), 1))
         if E_pad % self.ng:
             # re-pad with trash edges so the graph axis divides evenly
             E_pad = ((E_pad + self.ng - 1) // self.ng) * self.ng
@@ -137,23 +195,200 @@ class ShardedGraph:
         src[: len(b_src)] = b_src
         dst[: len(b_dst)] = b_dst
         exp[: len(b_exp)] = b_exp
+        # host copies for the incremental dead-pair search (dst-sorted)
+        self._h_src = src
+        self._h_dst = dst
+        self._src = jax.device_put(src, self._edge_sh)
+        self._dst = jax.device_put(dst, self._edge_sh)
+        self._exp = jax.device_put(exp, self._edge_sh)
+        self._block_meta = tuple(kept)
+        self._blocks = tuple(
+            jax.device_put(self._block_matrix(bm), self._block_sh)
+            for bm in kept
+        )
+        self._dsrc, self._ddst, self._dexp = self._delta_device(cg)
+        # dead pairs already folded into this build (updated() applies
+        # only the new tail)
+        self._applied_dead = _pair_keys(cg.dead_pairs)
 
-        edge_sh = NamedSharding(mesh, P("graph"))
-        self._src = jax.device_put(src, edge_sh)
-        self._dst = jax.device_put(dst, edge_sh)
-        self._exp = jax.device_put(exp, edge_sh)
-
-        fn = partial(_run_sharded, cg, max_iters=max_iters)
+        fn = partial(_run_sharded, cg.run_meta(), self._block_meta, self.ng,
+                     max_iters=max_iters)
         self._run = jax.jit(
             shard_map(
                 fn,
                 mesh=mesh,
-                in_specs=(P("graph"), P("graph"), P("graph"),
-                          P("data", None), P("data", None), P()),
-                out_specs=(P("data", None), P()),
+                in_specs=(
+                    tuple(P(None, "graph") for _ in kept),
+                    P("graph"), P("graph"), P("graph"),
+                    P("graph"), P("graph"), P("graph"),
+                    P("data", None), P("data", None), P(),
+                ),
+                out_specs=(P("data", None), P(), P()),
                 check_vma=False,
             )
         )
+
+    # -- host-side construction ---------------------------------------------
+
+    def _dead_set(self):
+        if self.cg.dead_pairs is None or not len(self.cg.dead_pairs):
+            return None
+        d = self.cg.dead_pairs
+        return set(zip(d[:, 0].tolist(), d[:, 1].tolist()))
+
+    def _host_base_split(self):
+        """(src, dst, exp, kept_blocks): the base edge set this mesh will
+        gather over (base residual + folded-back blocks, dst-sorted; the
+        delta segment stays separate) and the dense blocks that stay on
+        the MXU path (src axis divisible by the graph-axis size)."""
+        cg = self.cg
+        dead = self._dead_set()
+        if cg.res_idx is None or cg.res_src is None:
+            # no dense split computed: whole edge set on the segment path,
+            # with dead pairs killed in place
+            b_src = cg.src[: cg.n_edges].astype(np.int32, copy=False)
+            b_dst = cg.dst[: cg.n_edges].astype(np.int32, copy=False)
+            b_exp = cg.exp_rel[: cg.n_edges].astype(np.float32, copy=True)
+            if dead:
+                for s, t in dead:
+                    lo = int(np.searchsorted(b_dst, t, side="left"))
+                    hi = int(np.searchsorted(b_dst, t, side="right"))
+                    if lo < hi:
+                        hit = lo + np.flatnonzero(b_src[lo:hi] == s)
+                        b_exp[hit] = -np.inf
+            return b_src, b_dst, b_exp, []
+        # base residual host arrays already carry incremental
+        # invalidations (res_exp -> -inf), so they fold in as-is
+        parts = [(cg.res_src, cg.res_dst, cg.res_exp)]
+        kept, folded = [], []
+        for bm in cg.blocks:
+            if bm.n_src % self.ng == 0:
+                kept.append(bm)
+            else:
+                folded.append(bm)
+        for bm in folded:
+            e_src = (bm.src_off + bm.src_local).astype(np.int32)
+            e_dst = (bm.dst_off + bm.dst_local).astype(np.int32)
+            keep = self._not_dead_mask(e_src, e_dst, dead)
+            parts.append((
+                e_src[keep], e_dst[keep],
+                np.full(int(keep.sum()), np.inf, dtype=np.float32)))
+        src = np.concatenate([p[0] for p in parts])
+        dst = np.concatenate([p[1] for p in parts])
+        exp = np.concatenate([p[2] for p in parts])
+        if len(parts) > 1:
+            order = np.argsort(dst, kind="stable")
+            src, dst, exp = src[order], dst[order], exp[order]
+        return src, dst, exp, kept
+
+    @staticmethod
+    def _not_dead_mask(e_src, e_dst, dead):
+        if not dead:
+            return np.ones(len(e_src), dtype=bool)
+        return np.fromiter(
+            ((s, t) not in dead for s, t in zip(e_src.tolist(),
+                                                e_dst.tolist())),
+            dtype=bool, count=len(e_src))
+
+    def _block_matrix(self, bm) -> np.ndarray:
+        A = np.zeros((bm.n_dst, bm.n_src), dtype=np.int8)
+        A[bm.dst_local, bm.src_local] = 1
+        dl, sl = self.cg._dead_cells(bm)
+        if len(dl):
+            A[dl, sl] = 0
+        return A
+
+    def _delta_device(self, cg: CompiledGraph):
+        """Upload the delta segment, padded so the graph axis divides."""
+        d_src, d_dst, d_exp = cg._delta_host()
+        pad = len(d_src)
+        if pad % self.ng:
+            pad2 = ((pad + self.ng - 1) // self.ng) * self.ng
+            d_src = np.concatenate(
+                [d_src, np.full(pad2 - pad, cg.M, dtype=np.int32)])
+            d_dst = np.concatenate(
+                [d_dst, np.full(pad2 - pad, cg.M, dtype=np.int32)])
+            d_exp = np.concatenate(
+                [d_exp, np.full(pad2 - pad, -np.inf, dtype=np.float32)])
+        return (jax.device_put(d_src, self._edge_sh),
+                jax.device_put(d_dst, self._edge_sh),
+                jax.device_put(d_exp, self._edge_sh))
+
+    # -- incremental updates -------------------------------------------------
+
+    def updated(self, cg: CompiledGraph) -> "ShardedGraph":
+        """A ShardedGraph for an incrementally-updated revision of the same
+        compiled graph, reusing the jitted shard_map and resident base
+        shards; falls back to a full rebuild when the shape changed (delta
+        bucket growth, different blocks, full recompile)."""
+        old = self.cg
+        if cg is old:
+            return self
+        if cg.signature() != old.signature() or cg.blocks is not old.blocks:
+            return ShardedGraph(cg, self.mesh, self.max_iters)
+        new = object.__new__(ShardedGraph)
+        new.__dict__.update(self.__dict__)
+        new.cg = cg
+        # kill base edges for dead pairs not yet applied to these shards
+        keys = _pair_keys(cg.dead_pairs)
+        fresh = keys[~np.isin(keys, self._applied_dead)]
+        if len(fresh):
+            pairs = np.stack([fresh >> 32, fresh & ((1 << 32) - 1)], axis=1)
+            pos: list[int] = []
+            block_cells: dict[int, list] = {}
+            for s, t in pairs.tolist():
+                lo = int(np.searchsorted(self._h_dst, t, side="left"))
+                hi = int(np.searchsorted(self._h_dst, t, side="right"))
+                if lo < hi:
+                    pos.extend(
+                        (lo + np.flatnonzero(
+                            self._h_src[lo:hi] == s)).tolist())
+                for i, bm in enumerate(self._block_meta):
+                    if (bm.dst_off <= t < bm.dst_off + bm.n_dst
+                            and bm.src_off <= s < bm.src_off + bm.n_src):
+                        block_cells.setdefault(i, []).append(
+                            (t - bm.dst_off, s - bm.src_off))
+            if pos:
+                new._exp = jax.device_put(
+                    self._exp.at[np.asarray(pos, dtype=np.int64)]
+                    .set(-np.inf), self._edge_sh)
+            if block_cells:
+                blocks = list(self._blocks)
+                for i, cells in block_cells.items():
+                    dl = np.asarray([c[0] for c in cells], dtype=np.int32)
+                    sl = np.asarray([c[1] for c in cells], dtype=np.int32)
+                    blocks[i] = jax.device_put(
+                        blocks[i].at[dl, sl].set(0), self._block_sh)
+                new._blocks = tuple(blocks)
+        new._applied_dead = keys
+        new._dsrc, new._ddst, new._dexp = new._delta_device(cg)
+        return new
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, seeds_pad: np.ndarray, grid: np.ndarray,
+                  now: Optional[float]):
+        now_rel = np.float32(
+            (time.time() if now is None else now) - self.cg.base_time
+        )
+        out, converged, iters = self._run(
+            self._blocks, self._src, self._dst, self._exp,
+            self._dsrc, self._ddst, self._dexp,
+            jnp.asarray(seeds_pad), jnp.asarray(grid), now_rel,
+        )
+        try:
+            out.copy_to_host_async()
+            converged.copy_to_host_async()
+            iters.copy_to_host_async()
+        except AttributeError:  # non-jax backends in tests
+            pass
+        return out, converged, iters
+
+    def _pad_rows(self, B: int) -> int:
+        B_pad = max(_next_bucket(B, 1), self.nd)
+        if B_pad % self.nd:
+            B_pad = ((B_pad + self.nd - 1) // self.nd) * self.nd
+        return B_pad
 
     def query_grid(
         self,
@@ -164,25 +399,55 @@ class ShardedGraph:
         """Run the sharded fixpoint; returns bool [B, Q]."""
         cg = self.cg
         B, Q = q_slots.shape
-        # B must split evenly over the data axis; Q is bucket-padded
-        B_pad = max(_next_bucket(B, 1), self.nd)
-        if B_pad % self.nd:
-            B_pad = ((B_pad + self.nd - 1) // self.nd) * self.nd
+        B_pad = self._pad_rows(B)
         Q_pad = _next_bucket(Q, 8)
         seeds = np.full((B_pad, 2), cg.M, dtype=np.int32)
         seeds[:B] = seed_slots
         qs = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
         qs[:B, :Q] = q_slots
-        now_rel = np.float32(
-            (time.time() if now is None else now) - cg.base_time
-        )
-        out, converged = self._run(
-            self._src, self._dst, self._exp,
-            jnp.asarray(seeds), jnp.asarray(qs), now_rel,
-        )
-        if not bool(converged):
-            raise ConvergenceError(
-                f"sharded reachability did not converge within "
-                f"{self.max_iters} iterations"
-            )
-        return np.asarray(out)[:B, :Q]
+        out, converged, iters = self._dispatch(seeds, qs, now)
+        fut = ShardedQueryFuture(out, converged, iters, None, self.max_iters)
+        return fut.result()[:B, :Q]
+
+    def query_async(
+        self,
+        seed_slots: np.ndarray,  # int32 [B, 2]
+        q_slots: np.ndarray,  # int32 [Q] flat result slots
+        q_batch: np.ndarray,  # int32 [Q] batch row per query
+        now: Optional[float] = None,
+    ) -> ShardedQueryFuture:
+        """Engine-compatible flat form (CompiledGraph.query_async surface):
+        the flat (q_slots, q_batch) queries are packed into a [B, Qmax]
+        grid (rank within row computed vectorized), dispatched, and the
+        future re-maps the grid output back to flat [Q] order. The
+        iteration budget is the construction-time ``max_iters`` (baked
+        into the jitted shard_map)."""
+        cg = self.cg
+        B = seed_slots.shape[0]
+        q_slots = np.asarray(q_slots, dtype=np.int32)
+        q_batch = np.asarray(q_batch, dtype=np.int32)
+        Q = len(q_slots)
+        # rank of each query within its batch row (stable)
+        order = np.argsort(q_batch, kind="stable")
+        sorted_qb = q_batch[order]
+        if Q:
+            starts = np.flatnonzero(
+                np.r_[True, np.diff(sorted_qb) != 0])
+            run_len = np.diff(np.r_[starts, Q])
+            grp_start = np.repeat(starts, run_len)
+            rank_sorted = np.arange(Q) - grp_start
+            cols = np.empty(Q, dtype=np.int64)
+            cols[order] = rank_sorted
+            Qmax = int(rank_sorted.max()) + 1
+        else:
+            cols = np.empty(0, dtype=np.int64)
+            Qmax = 1
+        B_pad = self._pad_rows(B)
+        Q_pad = _next_bucket(Qmax, 8)
+        seeds = np.full((B_pad, 2), cg.M, dtype=np.int32)
+        seeds[:B] = seed_slots
+        grid = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
+        grid[q_batch, cols] = q_slots
+        out, converged, iters = self._dispatch(seeds, grid, now)
+        return ShardedQueryFuture(out, converged, iters, (q_batch, cols),
+                                  max_iters=self.max_iters)

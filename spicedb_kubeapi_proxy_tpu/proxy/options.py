@@ -33,6 +33,23 @@ class OptionsError(ValueError):
     pass
 
 
+def _parse_mesh_spec(spec: str) -> dict:
+    """"auto" -> {} (all devices, derived axes); "data=D,graph=G" ->
+    explicit axis sizes (either may be omitted). Raises OptionsError."""
+    if spec == "auto":
+        return {}
+    out: dict = {}
+    for part in spec.split(","):
+        k, sep, v = part.partition("=")
+        if not sep or k.strip() not in ("data", "graph") \
+                or not v.strip().isdigit() or int(v) < 1:
+            raise OptionsError(
+                f"invalid engine mesh {spec!r} "
+                "(expected 'auto' or 'data=D,graph=G')")
+        out[k.strip()] = int(v)
+    return out
+
+
 @dataclass
 class Options:
     # engine backend: embedded:// | tpu:// (both in-process; tpu:// is the
@@ -69,6 +86,10 @@ class Options:
     # /debug/config stays 404 unless explicitly enabled — even a sanitized
     # topology dump is opt-in, not default-on
     enable_debug_config: bool = False
+    # multi-chip: "auto" (all local devices, graph-majority axes) or
+    # "data=D,graph=G"; None/"" = single device. In-process engines only —
+    # a tcp:// engine host owns its own mesh.
+    engine_mesh: Optional[str] = None
 
     def _parse_remote(self) -> Optional[tuple[str, int]]:
         """(host, port) for tcp:// endpoints, None otherwise; raises on a
@@ -106,6 +127,12 @@ class Options:
             raise OptionsError(
                 "lookup-batch-window applies to in-process engines; batch "
                 "on the tcp:// engine host instead")
+        if remote and self.engine_mesh:
+            raise OptionsError(
+                "engine-mesh applies to in-process engines; configure the "
+                "mesh on the tcp:// engine host instead")
+        if self.engine_mesh:
+            _parse_mesh_spec(self.engine_mesh)  # raises OptionsError
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
         if not (self.rule_files or self.rule_content):
@@ -128,7 +155,12 @@ class Options:
             bootstrap = "\n---\n".join(
                 [open(f).read() for f in self.bootstrap_files]
                 + ([self.bootstrap_content] if self.bootstrap_content else []))
-            engine = Engine(bootstrap=bootstrap or None)
+            mesh = None
+            if self.engine_mesh:
+                from ..parallel import make_mesh
+
+                mesh = make_mesh(**_parse_mesh_spec(self.engine_mesh))
+            engine = Engine(bootstrap=bootstrap or None, mesh=mesh)
             engine.load_snapshot_if_exists(self.snapshot_path)
             if self.lookup_batch_window > 0:
                 engine.enable_lookup_batching(self.lookup_batch_window)
@@ -157,8 +189,8 @@ class Options:
     # credential-bearing Options field fails safe (omitted) instead of
     # leaking until someone extends a denylist
     _DUMP_FIELDS = (
-        "engine_endpoint", "bootstrap_files", "rule_files", "upstream_url",
-        "upstream_insecure", "bind_host", "bind_port",
+        "engine_endpoint", "engine_mesh", "bootstrap_files", "rule_files",
+        "upstream_url", "upstream_insecure", "bind_host", "bind_port",
         "workflow_database_path", "lock_mode", "snapshot_path",
     )
 
@@ -218,6 +250,9 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--enable-debug-config", action="store_true",
                         help="serve the sanitized options dump on "
                              "/debug/config (off by default)")
+    parser.add_argument("--engine-mesh",
+                        help="multi-chip device mesh for the in-process "
+                             "engine: 'auto' or 'data=D,graph=G'")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -239,4 +274,5 @@ def options_from_args(args: argparse.Namespace) -> Options:
         snapshot_path=args.snapshot_path,
         lookup_batch_window=args.lookup_batch_window,
         enable_debug_config=args.enable_debug_config,
+        engine_mesh=args.engine_mesh,
     )

@@ -1,11 +1,15 @@
-"""Authentication: embedded-mode header authenticator.
+"""Authentication: header and TLS client-certificate authenticators.
 
-Mirrors the reference's embedded-mode authenticator
-(/root/reference/pkg/proxy/authn.go:78-119): the caller's identity arrives
-in ``X-Remote-User`` / ``X-Remote-Group`` / ``X-Remote-Extra-*`` headers.
-(The reference's other mode wires kube's built-in client-cert/OIDC/token
-authenticators; TLS client-cert authn is a proxy-server concern layered on
-top of this interface in a later milestone.)
+Mirrors the reference's two modes (/root/reference/pkg/proxy/authn.go):
+
+- embedded-mode header authenticator (``authn.go:78-119``): the caller's
+  identity arrives in ``X-Remote-User`` / ``X-Remote-Group`` /
+  ``X-Remote-Extra-*`` headers;
+- built-in client-cert authentication (``authn.go:40-47``, kube's x509
+  CommonName user conversion): a TLS peer certificate verified against
+  the configured client CA maps CommonName -> user and Organization
+  values -> groups — the identity shape the reference's e2e harness
+  stamps per user (``e2e/e2e_test.go:215-318``).
 """
 
 from __future__ import annotations
@@ -40,3 +44,24 @@ class HeaderAuthenticator:
         if not name:
             raise AuthenticationError(f"no {USER_HEADER} header present")
         return UserInfo(name=name, groups=groups, extra=extra)
+
+
+class ClientCertAuthenticator:
+    """Maps a verified TLS peer certificate to a user identity the way
+    kube's x509 authenticator does: CommonName is the user name, each
+    Organization value is a group. The ssl module has already verified
+    the chain against the configured client CA before this runs."""
+
+    def authenticate_peer(self, peercert: dict) -> UserInfo:
+        name = None
+        groups: list[str] = []
+        for rdn in peercert.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName" and name is None:
+                    name = value
+                elif key == "organizationName":
+                    groups.append(value)
+        if not name:
+            raise AuthenticationError(
+                "client certificate has no CommonName")
+        return UserInfo(name=name, groups=groups, extra={})

@@ -74,6 +74,16 @@ class Options:
     # serving
     bind_host: str = "127.0.0.1"
     bind_port: int = 8443
+    # TLS serving (reference secure-serving, server.go:164-202): cert+key
+    # enable HTTPS; a client CA additionally enables client-certificate
+    # authentication (CN -> user, O -> groups, authn.go:40-47) and makes
+    # X-Remote-* identity headers trusted ONLY from cert-bearing peers
+    tls_cert_file: Optional[str] = None
+    tls_key_file: Optional[str] = None
+    tls_client_ca_file: Optional[str] = None
+    # CNs of cert-authenticated FRONT PROXIES allowed to assert end-user
+    # identity via X-Remote-* headers (kube --requestheader-allowed-names)
+    tls_requestheader_allowed_names: list = field(default_factory=list)
     # dual-write
     workflow_database_path: str = DEFAULT_WORKFLOW_DB
     lock_mode: str = LOCK_MODE_PESSIMISTIC
@@ -135,6 +145,17 @@ class Options:
             _parse_mesh_spec(self.engine_mesh)  # raises OptionsError
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
+        if bool(self.tls_cert_file) != bool(self.tls_key_file):
+            raise OptionsError(
+                "tls-cert-file and tls-key-file must be set together")
+        if self.tls_client_ca_file and not self.tls_cert_file:
+            raise OptionsError(
+                "tls-client-ca-file requires tls-cert-file/tls-key-file")
+        if self.tls_requestheader_allowed_names and \
+                not self.tls_client_ca_file:
+            raise OptionsError(
+                "tls-requestheader-allowed-names requires "
+                "tls-client-ca-file")
         if not (self.rule_files or self.rule_content):
             raise OptionsError("at least one rule file is required")
         if self.upstream is None and not self.upstream_url:
@@ -179,10 +200,27 @@ class Options:
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
         )
+        ssl_context = None
+        if self.tls_cert_file:
+            import ssl
+
+            ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_context.load_cert_chain(self.tls_cert_file,
+                                        self.tls_key_file)
+            if self.tls_client_ca_file:
+                ssl_context.load_verify_locations(self.tls_client_ca_file)
+                # OPTIONAL, not REQUIRED: cert-less clients still reach
+                # health endpoints and get clean 401s on resources
+                # (kube-apiserver semantics) instead of handshake failures
+                ssl_context.verify_mode = ssl.CERT_OPTIONAL
         server = Server(deps, HeaderAuthenticator(),
                         host=self.bind_host, port=self.bind_port,
                         config_dump=(self.debug_dump()
-                                     if self.enable_debug_config else None))
+                                     if self.enable_debug_config else None),
+                        ssl_context=ssl_context,
+                        client_ca_configured=bool(self.tls_client_ca_file),
+                        requestheader_allowed_names=tuple(
+                            self.tls_requestheader_allowed_names))
         return CompletedConfig(self, engine, workflow, deps, server)
 
     # fields safe to expose on /debug/config — an ALLOWLIST so a future
@@ -237,6 +275,19 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--upstream-insecure", action="store_true")
     parser.add_argument("--bind-host", default="127.0.0.1")
     parser.add_argument("--bind-port", type=int, default=8443)
+    parser.add_argument("--tls-cert-file",
+                        help="serving certificate (enables HTTPS)")
+    parser.add_argument("--tls-key-file",
+                        help="serving certificate private key")
+    parser.add_argument("--tls-client-ca-file",
+                        help="CA bundle for client-certificate "
+                             "authentication (CN -> user, O -> groups)")
+    parser.add_argument("--tls-requestheader-allowed-name",
+                        action="append", default=[],
+                        dest="tls_requestheader_allowed_names",
+                        help="cert CN allowed to assert user identity via "
+                             "X-Remote-* headers (repeatable; front "
+                             "proxies)")
     parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
     parser.add_argument("--snapshot-path",
                         help="relationship-store snapshot file: loaded at "
@@ -269,6 +320,10 @@ def options_from_args(args: argparse.Namespace) -> Options:
         upstream_insecure=args.upstream_insecure,
         bind_host=args.bind_host,
         bind_port=args.bind_port,
+        tls_cert_file=args.tls_cert_file,
+        tls_key_file=args.tls_key_file,
+        tls_client_ca_file=args.tls_client_ca_file,
+        tls_requestheader_allowed_names=args.tls_requestheader_allowed_names,
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,

@@ -22,7 +22,11 @@ from typing import Optional
 from urllib.parse import parse_qs, unquote, urlsplit
 
 from ..authz import AuthzDeps, authorize
-from ..proxy.authn import AuthenticationError, HeaderAuthenticator
+from ..proxy.authn import (
+    AuthenticationError,
+    ClientCertAuthenticator,
+    HeaderAuthenticator,
+)
 from ..proxy.requestinfo import parse_request_info
 from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
 from ..utils.metrics import metrics
@@ -39,14 +43,29 @@ class Server:
     def __init__(self, deps: AuthzDeps,
                  authenticator: Optional[HeaderAuthenticator] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 config_dump: Optional[dict] = None):
+                 config_dump: Optional[dict] = None,
+                 ssl_context=None,
+                 client_ca_configured: bool = False,
+                 requestheader_allowed_names: tuple = ()):
         self.deps = deps
         self.authenticator = authenticator or HeaderAuthenticator()
+        self.cert_authenticator = ClientCertAuthenticator()
         self.host = host
         self.port = port
         # sanitized options for /debug/config (the reference's debugmap
         # struct tags produce the same kind of secret-free dump)
         self.config_dump = config_dump
+        # TLS serving (reference serves TLS with kube's secure-serving
+        # stack, server.go:164-202). With a client CA configured, a peer's
+        # verified cert IS its identity (CN -> user, O -> groups) — except
+        # peers whose CN is in requestheader_allowed_names, which are
+        # trusted FRONT PROXIES allowed to assert end-user identity via
+        # X-Remote-* headers (kube's --requestheader-allowed-names
+        # contract, authn.go:40-47). Cert-less connections never get
+        # header identity when a client CA is configured.
+        self.ssl_context = ssl_context
+        self.client_ca_configured = client_ca_configured
+        self.requestheader_allowed_names = set(requestheader_allowed_names)
         self._server: Optional[asyncio.AbstractServer] = None
 
     # -- handler chain -------------------------------------------------------
@@ -103,9 +122,11 @@ class Server:
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._serve_connection, self.host, self.port)
+            self._serve_connection, self.host, self.port,
+            ssl=self.ssl_context)
         self.port = self._server.sockets[0].getsockname()[1]
-        log.info("proxy listening on %s:%d", self.host, self.port)
+        log.info("proxy listening on %s:%d (%s)", self.host, self.port,
+                 "https" if self.ssl_context else "http")
         return self.port
 
     async def stop(self) -> None:
@@ -116,11 +137,40 @@ class Server:
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
                                 writer: asyncio.StreamWriter) -> None:
+        # cert identity is per-connection: resolve once, stamp each request
+        peer_user = None
+        peer_error: Optional[str] = None
+        if self.ssl_context is not None:
+            peercert = writer.get_extra_info("peercert")
+            if peercert:
+                try:
+                    peer_user = self.cert_authenticator.authenticate_peer(
+                        peercert)
+                except AuthenticationError as e:
+                    peer_error = str(e)
         try:
             while True:
                 req = await _read_request(reader)
                 if req is None:
                     return
+                if peer_user is not None and \
+                        peer_user.name in self.requestheader_allowed_names:
+                    # trusted front proxy: its X-Remote-* headers carry the
+                    # end-user identity (header authn path runs as usual)
+                    pass
+                elif peer_user is not None:
+                    # verified client cert IS the identity; headers from
+                    # ordinary cert users must not escalate
+                    req.user = peer_user
+                elif peer_error is not None or (
+                        self.ssl_context is not None
+                        and self.client_ca_configured):
+                    # a client CA is configured: identity headers are only
+                    # trusted from allowed cert-bearing front proxies
+                    # (anyone can send headers; only proxies hold certs)
+                    req.headers = {
+                        k: v for k, v in req.headers.items()
+                        if not k.lower().startswith("x-remote-")}
                 resp = await self.handle(req)
                 conn_hdr = next((v for k, v in req.headers.items()
                                  if k.lower() == "connection"), "")

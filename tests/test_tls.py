@@ -1,0 +1,281 @@
+"""TLS serving + client-certificate authentication end-to-end.
+
+Mirrors the reference's e2e harness, which stamps per-user client certs
+(CommonName = username, Organization = groups) from a self-made CA and
+talks to the proxy over TLS (/root/reference/e2e/e2e_test.go:215-318;
+client-cert authn mode authn.go:40-47)."""
+
+import asyncio
+import datetime
+import ipaddress
+import json
+import ssl
+
+import pytest
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from spicedb_kubeapi_proxy_tpu.proxy.options import Options, OptionsError
+
+from fake_kube import FakeKube
+
+RULES = open(__import__("os").path.join(
+    __import__("os").path.dirname(__file__), "..", "deploy",
+    "rules.yaml")).read()
+BOOT = open(__import__("os").path.join(
+    __import__("os").path.dirname(__file__), "..", "deploy",
+    "bootstrap.yaml")).read()
+
+
+def _key():
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _name(cn, orgs=()):
+    rdns = [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+    rdns += [x509.NameAttribute(NameOID.ORGANIZATION_NAME, o) for o in orgs]
+    return x509.Name(rdns)
+
+
+def _cert(subject, issuer, pub, signer, *, ca=False, san=None):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (x509.CertificateBuilder()
+         .subject_name(subject)
+         .issuer_name(issuer)
+         .public_key(pub)
+         .serial_number(x509.random_serial_number())
+         .not_valid_before(now - datetime.timedelta(minutes=5))
+         .not_valid_after(now + datetime.timedelta(days=1))
+         .add_extension(x509.BasicConstraints(ca=ca, path_length=None),
+                        critical=True))
+    if san:
+        b = b.add_extension(x509.SubjectAlternativeName(san), critical=False)
+    return b.sign(signer, hashes.SHA256())
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """CA + server cert + per-user client certs, PEM files on disk."""
+    d = tmp_path_factory.mktemp("pki")
+    ca_key = _key()
+    ca_name = _name("test-ca")
+    ca_cert = _cert(ca_name, ca_name, ca_key.public_key(), ca_key, ca=True)
+
+    def write(path, *objs):
+        data = b"".join(
+            o.private_bytes(serialization.Encoding.PEM,
+                            serialization.PrivateFormat.PKCS8,
+                            serialization.NoEncryption())
+            if hasattr(o, "private_bytes")
+            else o.public_bytes(serialization.Encoding.PEM)
+            for o in objs)
+        p = d / path
+        p.write_bytes(data)
+        return str(p)
+
+    files = {"ca": write("ca.pem", ca_cert)}
+    srv_key = _key()
+    srv_cert = _cert(
+        _name("proxy"), ca_name, srv_key.public_key(), ca_key,
+        san=[x509.DNSName("localhost"),
+             x509.IPAddress(ipaddress.ip_address("127.0.0.1"))])
+    files["server_cert"] = write("server.pem", srv_cert)
+    files["server_key"] = write("server-key.pem", srv_key)
+    for user, orgs in (("alice", ["team-alpha"]), ("bob", []),
+                       ("front-proxy", [])):
+        k = _key()
+        c = _cert(_name(user, orgs), ca_name, k.public_key(), ca_key)
+        files[user] = write(f"{user}.pem", c, k)
+    return files
+
+
+class TlsClient:
+    """Minimal HTTP/1.1 client over TLS with an optional client cert."""
+
+    def __init__(self, port, ca, cert=None):
+        self.port = port
+        self.ctx = ssl.create_default_context(cafile=ca)
+        if cert:
+            self.ctx.load_cert_chain(cert)
+
+    async def request(self, method, target, body=None, headers=()):
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", self.port, ssl=self.ctx,
+            server_hostname="localhost")
+        data = json.dumps(body).encode() if body is not None else b""
+        lines = [f"{method} {target} HTTP/1.1", "Host: localhost",
+                 f"Content-Length: {len(data)}",
+                 "Content-Type: application/json", "Connection: close"]
+        lines += list(headers)
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + data)
+        await writer.drain()
+        status = int((await reader.readline()).split(b" ")[1])
+        hdrs = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        if "chunked" in hdrs.get("transfer-encoding", ""):
+            chunks = []
+            while True:
+                size = int((await reader.readline()).strip() or b"0", 16)
+                if size == 0:
+                    break
+                chunks.append(await reader.readexactly(size))
+                await reader.readline()
+            out = b"".join(chunks)
+        else:
+            n = int(hdrs.get("content-length", 0))
+            out = await reader.readexactly(n) if n else b""
+        writer.close()
+        return status, out
+
+
+def test_tls_client_cert_end_to_end(pki, tmp_path):
+    """Two cert-authenticated users see disjoint lists over TLS; identity
+    headers are ignored in favor of (and only trusted with) certs."""
+    async def go():
+        cfg = Options(
+            rule_content=RULES,
+            bootstrap_content=BOOT,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            bind_port=0,
+            tls_cert_file=pki["server_cert"],
+            tls_key_file=pki["server_key"],
+            tls_client_ca_file=pki["ca"],
+        ).complete()
+        await cfg.run()
+        port = cfg.server.port
+        alice = TlsClient(port, pki["ca"], pki["alice"])
+        bob = TlsClient(port, pki["ca"], pki["bob"])
+        nocert = TlsClient(port, pki["ca"])
+
+        # health over TLS needs no identity
+        status, body = await nocert.request("GET", "/readyz")
+        assert (status, body) == (200, b"ok")
+
+        # dual-write create as the cert identity
+        status, body = await alice.request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "team-a"}})
+        assert status == 201, body
+
+        # list isolation between the two cert users
+        status, body = await alice.request("GET", "/api/v1/namespaces")
+        assert [o["metadata"]["name"]
+                for o in json.loads(body)["items"]] == ["team-a"]
+        status, body = await bob.request("GET", "/api/v1/namespaces")
+        assert json.loads(body)["items"] == []
+
+        # single-object isolation
+        status, _ = await alice.request("GET", "/api/v1/namespaces/team-a")
+        assert status == 200
+        status, _ = await bob.request("GET", "/api/v1/namespaces/team-a")
+        assert status == 403
+
+        # with a client CA configured, X-Remote-* headers from a CERT-LESS
+        # connection are stripped, not trusted: spoofing alice fails
+        status, _ = await nocert.request(
+            "GET", "/api/v1/namespaces", headers=["X-Remote-User: alice"])
+        assert status == 401
+
+        # ...and a cert-bearing peer's headers cannot override the cert
+        status, body = await bob.request(
+            "GET", "/api/v1/namespaces", headers=["X-Remote-User: alice"])
+        assert json.loads(body)["items"] == []
+
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
+def test_tls_front_proxy_allowed_names(pki, tmp_path):
+    """A cert whose CN is in --tls-requestheader-allowed-name is a trusted
+    front proxy: its X-Remote-* headers carry the end-user identity
+    (kube's requestheader contract). Other cert users' headers do not."""
+    async def go():
+        cfg = Options(
+            rule_content=RULES,
+            bootstrap_content=BOOT,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            bind_port=0,
+            tls_cert_file=pki["server_cert"],
+            tls_key_file=pki["server_key"],
+            tls_client_ca_file=pki["ca"],
+            tls_requestheader_allowed_names=["front-proxy"],
+        ).complete()
+        await cfg.run()
+        port = cfg.server.port
+        front = TlsClient(port, pki["ca"], pki["front-proxy"])
+        bob = TlsClient(port, pki["ca"], pki["bob"])
+
+        # the front proxy creates as carol via headers
+        status, body = await front.request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "carol-ns"}},
+            headers=["X-Remote-User: carol"])
+        assert status == 201, body
+        status, body = await front.request(
+            "GET", "/api/v1/namespaces", headers=["X-Remote-User: carol"])
+        assert [o["metadata"]["name"]
+                for o in json.loads(body)["items"]] == ["carol-ns"]
+        # without identity headers the front proxy has NO identity at all
+        # (it authenticates users, it isn't one): 401
+        status, body = await front.request("GET", "/api/v1/namespaces")
+        assert status == 401
+        # an ordinary cert user still cannot assert headers
+        status, body = await bob.request(
+            "GET", "/api/v1/namespaces", headers=["X-Remote-User: carol"])
+        assert json.loads(body)["items"] == []
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
+def test_tls_without_client_ca_keeps_header_authn(pki, tmp_path):
+    """TLS-only mode (no client CA): headers still authenticate — the
+    embedded/front-proxy deployment shape, now encrypted."""
+    async def go():
+        cfg = Options(
+            rule_content=RULES,
+            bootstrap_content=BOOT,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            bind_port=0,
+            tls_cert_file=pki["server_cert"],
+            tls_key_file=pki["server_key"],
+        ).complete()
+        await cfg.run()
+        c = TlsClient(cfg.server.port, pki["ca"])
+        status, body = await c.request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "hdr-ns"}},
+            headers=["X-Remote-User: carol"])
+        assert status == 201, body
+        status, body = await c.request(
+            "GET", "/api/v1/namespaces", headers=["X-Remote-User: carol"])
+        assert [o["metadata"]["name"]
+                for o in json.loads(body)["items"]] == ["hdr-ns"]
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
+def test_tls_option_validation():
+    base = dict(rule_content="x", upstream_url="http://u")
+    with pytest.raises(OptionsError, match="set together"):
+        Options(tls_cert_file="c.pem", **base).validate()
+    with pytest.raises(OptionsError, match="requires"):
+        Options(tls_client_ca_file="ca.pem", **base).validate()
+    with pytest.raises(OptionsError, match="requires"):
+        Options(tls_requestheader_allowed_names=["fp"], **base).validate()

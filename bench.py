@@ -367,6 +367,11 @@ def probe_backend(args) -> tuple[bool, Optional[str]]:
     last: Optional[str] = None
     for attempt in range(1, args.retries + 1):
         t0 = time.monotonic()
+        # logged BEFORE the (possibly hanging) probe: signal handlers are
+        # already installed, so once this line is visible a SIGTERM test
+        # can kill deterministically instead of sleeping and hoping
+        log(f"probing TPU (attempt {attempt}/{args.retries}, "
+            f"timeout {args.probe_timeout:.0f}s)")
         try:
             p = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=args.probe_timeout)

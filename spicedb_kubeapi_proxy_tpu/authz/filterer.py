@@ -2,12 +2,14 @@
 
 Mirrors /root/reference/pkg/authz/responsefilterer.go:190-415: after the
 upstream responds, list items / table rows / the single object are filtered
-against the allowed set computed by the (concurrent) prefilter. JSON is the
-negotiated content type (the reference additionally handles kube protobuf;
-this proxy requests/serves JSON). Filtering errors surface as 401, an
-excluded single object as 404 (writeResp semantics,
-responsefilterer.go:716-735 — the reference writes 401 for errors and 404
-for a filtered-out single object).
+against the allowed set computed by the (concurrent) prefilter. Content is
+negotiated like the reference (responsefilterer.go:242-313): JSON
+(including Table form and unknown/CRD kinds, which are unstructured dicts
+here by construction) and kube protobuf — list responses via schema-light
+wire surgery on the ``runtime.Unknown`` envelope (proxy/kubeproto.py),
+single objects as byte-identical passthrough keyed on the request path.
+Filtering errors surface as 401, an excluded single object as 404
+(writeResp semantics, responsefilterer.go:716-735).
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ from __future__ import annotations
 import json
 from typing import Optional
 
+from ..proxy import kubeproto
 from ..proxy.types import ProxyResponse, kube_status
 from ..rules.input import ResolveInput
 from .lookups import AllowedSet
@@ -61,6 +64,32 @@ def filter_body(body: bytes, allowed: AllowedSet,
     return 404, b""
 
 
+def filter_body_proto(body: bytes, allowed: AllowedSet,
+                      input: ResolveInput) -> tuple[int, bytes]:
+    """Filter a kube-protobuf response body; returns (status, new_body).
+
+    Lists are filtered by dropping disallowed ``items`` from the inner
+    message (kept bytes are untouched); single objects never need parsing
+    — the request path already names the object, so the decision is the
+    allowed-set test and the body passes through byte-identical."""
+    try:
+        _, kind, raw = kubeproto.decode_unknown(body)
+        if kind == "Table":
+            raise FilterError(
+                "protobuf Table responses are not filterable; request "
+                "JSON Tables (kubectl default)")
+        if kind.endswith("List"):
+            new_raw = kubeproto.filter_list_raw(raw, allowed.allows)
+            return 200, kubeproto.replace_unknown_raw(body, new_raw)
+    except kubeproto.ProtoError as e:
+        raise FilterError(f"malformed kube protobuf response: {e}") \
+            from None
+    # single object: keyed on the request path, body untouched
+    if allowed.allows(input.namespace or "", input.name or ""):
+        return 200, body
+    return 404, b""
+
+
 def apply_filter(resp: ProxyResponse, allowed: AllowedSet,
                  input: ResolveInput) -> ProxyResponse:
     """Filter an upstream response in place (the reference hooks
@@ -68,11 +97,14 @@ def apply_filter(resp: ProxyResponse, allowed: AllowedSet,
     if resp.status != 200:
         return resp  # upstream errors pass through unfiltered
     ctype = resp.content_type
-    if ctype and "json" not in ctype:
-        # the proxy always requests JSON upstream; anything else is a bug
-        return kube_status(401, f"cannot filter content type {ctype!r}")
     try:
-        status, body = filter_body(resp.body, allowed, input)
+        if ctype and "protobuf" in ctype:
+            status, body = filter_body_proto(resp.body, allowed, input)
+        elif ctype and "json" not in ctype:
+            return kube_status(
+                401, f"cannot filter content type {ctype!r}")
+        else:
+            status, body = filter_body(resp.body, allowed, input)
     except FilterError as e:
         return kube_status(401, str(e))
     if status == 404:

@@ -143,6 +143,14 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
     if pf is not None:
         prefilter_task = asyncio.ensure_future(
             run_prefilter(deps.engine, pf[1], input))
+    if post_filters and info.verb == "list":
+        # the postfilter resolves rule expressions over each item's JSON
+        # object — protobuf list bodies can't feed it, so force a JSON
+        # upstream response regardless of the client's Accept (prefilter
+        # paths negotiate protobuf fine, authz/filterer.py)
+        req.headers = {k: v for k, v in req.headers.items()
+                       if k.lower() != "accept"}
+        req.headers["Accept"] = "application/json"
     try:
         resp = await deps.upstream(req)
     except Exception:

@@ -1,0 +1,167 @@
+"""Kubernetes protobuf envelope handling: schema-light wire surgery.
+
+The kube protobuf wire format is a 4-byte magic prefix (``k8s\\x00``)
+followed by a ``runtime.Unknown`` message whose ``raw`` field holds the
+serialized object (reference negotiates this alongside JSON,
+/root/reference/pkg/authz/responsefilterer.go:242-313).
+
+Filtering a *List response only needs three API-stable protobuf field
+numbers — no generated schemas:
+
+- ``runtime.Unknown``: typeMeta=1 (apiVersion=1, kind=2), raw=2,
+  contentEncoding=3, contentType=4
+- every ``XList`` message: metadata(ListMeta)=1, repeated items=2
+- every item's ``metadata(ObjectMeta)``=1, within it name=1, namespace=3
+
+These numbers are frozen by the kube API compatibility contract (all
+generated.proto files), so splitting the repeated ``items`` field and
+peeking each item's ObjectMeta is exact, and every byte we keep is
+byte-identical to what the apiserver sent — the same passthrough property
+the JSON/watch paths maintain (pkg/authz/frames.go:13-68).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+MAGIC = b"k8s\x00"
+CONTENT_TYPE = "application/vnd.kubernetes.protobuf"
+
+
+class ProtoError(ValueError):
+    pass
+
+
+def _read_varint(b: bytes, i: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        if i >= len(b):
+            raise ProtoError("truncated varint")
+        byte = b[i]
+        i += 1
+        out |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return out, i
+        shift += 7
+        if shift > 63:
+            raise ProtoError("varint too long")
+
+
+def _encode_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def fields(b: bytes) -> Iterator[tuple[int, int, bytes, bytes]]:
+    """Yield (field_no, wire_type, full_chunk, payload) over a message.
+    ``full_chunk`` is the exact byte span including the tag, so callers
+    can copy or drop whole fields byte-identically."""
+    i = 0
+    n = len(b)
+    while i < n:
+        start = i
+        tag, i = _read_varint(b, i)
+        field_no, wire_type = tag >> 3, tag & 7
+        if wire_type == 0:  # varint
+            _, i = _read_varint(b, i)
+            payload = b[start:i]
+        elif wire_type == 1:  # fixed64
+            i += 8
+            payload = b[start:i]
+        elif wire_type == 2:  # length-delimited
+            ln, j = _read_varint(b, i)
+            if j + ln > n:
+                raise ProtoError("truncated length-delimited field")
+            payload = b[j:j + ln]
+            i = j + ln
+        elif wire_type == 5:  # fixed32
+            i += 4
+            payload = b[start:i]
+        else:
+            raise ProtoError(f"unsupported wire type {wire_type}")
+        if i > n:
+            raise ProtoError("truncated field")
+        yield field_no, wire_type, b[start:i], payload
+
+
+def _field(b: bytes, field_no: int) -> Optional[bytes]:
+    """Payload of the first length-delimited occurrence of a field."""
+    for fno, wt, _, payload in fields(b):
+        if fno == field_no and wt == 2:
+            return payload
+    return None
+
+
+def _ld_field(field_no: int, payload: bytes) -> bytes:
+    return _encode_varint((field_no << 3) | 2) \
+        + _encode_varint(len(payload)) + payload
+
+
+def decode_unknown(body: bytes) -> tuple[str, str, bytes]:
+    """-> (apiVersion, kind, raw) from a magic-prefixed runtime.Unknown."""
+    if not body.startswith(MAGIC):
+        raise ProtoError("missing k8s protobuf magic prefix")
+    msg = body[len(MAGIC):]
+    api_version, kind, raw = "", "", b""
+    for fno, wt, _, payload in fields(msg):
+        if fno == 1 and wt == 2:  # typeMeta
+            tm_api = _field(payload, 1)
+            tm_kind = _field(payload, 2)
+            api_version = (tm_api or b"").decode("utf-8", "replace")
+            kind = (tm_kind or b"").decode("utf-8", "replace")
+        elif fno == 2 and wt == 2:  # raw
+            raw = payload
+    return api_version, kind, raw
+
+
+def replace_unknown_raw(body: bytes, new_raw: bytes) -> bytes:
+    """Re-emit the envelope with ``raw`` replaced; every other field is
+    copied byte-identically in its original position."""
+    msg = body[len(MAGIC):]
+    out = bytearray(MAGIC)
+    replaced = False
+    for fno, wt, chunk, _ in fields(msg):
+        if fno == 2 and wt == 2 and not replaced:
+            out += _ld_field(2, new_raw)
+            replaced = True
+        elif fno == 2 and wt == 2:
+            continue  # drop duplicate raw fields
+        else:
+            out += chunk
+    if not replaced:
+        out += _ld_field(2, new_raw)
+    return bytes(out)
+
+
+def item_meta(item: bytes) -> tuple[str, str]:
+    """(namespace, name) from an item's ObjectMeta (field 1; name=1,
+    namespace=3)."""
+    meta = _field(item, 1)
+    if meta is None:
+        return "", ""
+    name = _field(meta, 1)
+    namespace = _field(meta, 3)
+    return ((namespace or b"").decode("utf-8", "replace"),
+            (name or b"").decode("utf-8", "replace"))
+
+
+def filter_list_raw(raw: bytes, allows) -> bytes:
+    """Drop ``items`` (repeated field 2) whose ObjectMeta fails
+    ``allows(namespace, name)``; all other fields and kept items are
+    copied byte-identically in order."""
+    out = bytearray()
+    for fno, wt, chunk, payload in fields(raw):
+        if fno == 2 and wt == 2:
+            ns, name = item_meta(payload)
+            if not allows(ns, name):
+                continue
+        out += chunk
+    return bytes(out)

@@ -57,16 +57,26 @@ class HttpUpstream:
                        and not k.lower().startswith("x-remote-")
                        and k.lower() not in ("authorization", "accept")}
             headers["Host"] = f"{self.host}:{self.port}"
-            # the filterer can only parse JSON, so strip every non-JSON
-            # media range from the Accept before forwarding (client-go
-            # defaults to 'application/vnd.kubernetes.protobuf,
-            # application/json' — forwarding that verbatim would let the
-            # apiserver negotiate protobuf); JSON ranges incl. ;as=Table
-            # pass through
+            # Accept rewriting: the filterer parses JSON (incl. Table) and
+            # kube protobuf lists/objects (authz/filterer.py,
+            # proxy/kubeproto.py) but NOT protobuf Tables or protobuf
+            # watch frames — so protobuf ranges pass through except when
+            # they request Table form, and watch requests stay JSON-only
+            # (the watch join decodes frames as JSON). Anything else is
+            # stripped; an emptied Accept falls back to JSON.
             accept = next((v for k, v in req.headers.items()
                            if k.lower() == "accept"), "")
+            watching = _is_watch(req)
+
+            def keep(r: str) -> bool:
+                low = r.lower()
+                if "json" in low:
+                    return True
+                return ("protobuf" in low and not watching
+                        and "as=table" not in low.replace(" ", ""))
+
             accept = ",".join(r for r in accept.split(",")
-                              if "json" in r.lower()) or "application/json"
+                              if keep(r)) or "application/json"
             headers["Accept"] = accept
             headers["Connection"] = "close"
             if self.token:

@@ -42,12 +42,12 @@ class Env:
         )
 
     async def request(self, method: str, path: str, user: str = "alice",
-                      body=None, query=None, groups=()):
+                      body=None, query=None, groups=(), headers=None):
         query = query or {}
         info = parse_request_info(method, path, query)
         req = ProxyRequest(
             method=method, path=path, query=query,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
             body=json.dumps(body).encode() if body is not None else b"",
             user=UserInfo(name=user, groups=list(groups)),
             request_info=info,
@@ -244,6 +244,27 @@ def test_postfilter_bulk_checks():
         names = [o["metadata"]["name"] for o in json.loads(resp.body)["items"]]
         # prefilter (view) allows 'a'; postfilter also only passes 'a'
         assert names == ["a"]
+
+        # postfilter paths must force a JSON upstream response even when
+        # the client negotiates protobuf (the postfilter resolves rule
+        # expressions over item JSON; proxy/upstream.py otherwise forwards
+        # protobuf ranges now that the prefilter path can filter them)
+        seen = {}
+        orig = env.deps.upstream
+
+        async def recording_upstream(req):
+            seen["accept"] = next((v for k, v in req.headers.items()
+                                   if k.lower() == "accept"), None)
+            return await orig(req)
+
+        env.deps.upstream = recording_upstream
+        resp = await env.request(
+            "GET", "/api/v1/namespaces/ns1/pods", user="alice",
+            headers={"Accept":
+                     "application/vnd.kubernetes.protobuf,application/json"})
+        assert seen["accept"] == "application/json"
+        assert [o["metadata"]["name"]
+                for o in json.loads(resp.body)["items"]] == ["a"]
     run(go())
 
 

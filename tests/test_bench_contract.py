@@ -54,8 +54,18 @@ def test_sigterm_flushes_partial_json():
     p = subprocess.Popen(
         [sys.executable, BENCH, "--tiny", "--probe-timeout", "120"],
         env=_env("sleep 300"), stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL, text=True)
-    time.sleep(2.0)  # inside the first (hung) probe attempt
+        stderr=subprocess.PIPE, text=True)
+    # wait for the probe-start line: bench logs it AFTER installing the
+    # signal handlers and BEFORE the (hung) probe, so killing now is
+    # deterministic regardless of machine load
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = p.stderr.readline()
+        if "probing TPU" in line:
+            break
+    else:
+        p.kill()
+        raise AssertionError("bench never reached the TPU probe")
     p.send_signal(signal.SIGTERM)
     stdout, _ = p.communicate(timeout=60)
     out = _parse_only_line(stdout)

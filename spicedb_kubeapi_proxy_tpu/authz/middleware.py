@@ -145,12 +145,17 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
             run_prefilter(deps.engine, pf[1], input))
     if post_filters and info.verb == "list":
         # the postfilter resolves rule expressions over each item's JSON
-        # object — protobuf list bodies can't feed it, so force a JSON
-        # upstream response regardless of the client's Accept (prefilter
-        # paths negotiate protobuf fine, authz/filterer.py)
+        # object — protobuf list bodies can't feed it, so strip non-JSON
+        # ranges from the Accept (keeping JSON ;as=Table form: the
+        # postfilter handles Tables). Prefilter paths negotiate protobuf
+        # fine (authz/filterer.py).
+        accept = next((v for k, v in req.headers.items()
+                       if k.lower() == "accept"), "")
+        accept = ",".join(r for r in accept.split(",")
+                          if "json" in r.lower()) or "application/json"
         req.headers = {k: v for k, v in req.headers.items()
                        if k.lower() != "accept"}
-        req.headers["Accept"] = "application/json"
+        req.headers["Accept"] = accept
     try:
         resp = await deps.upstream(req)
     except Exception:

@@ -6,8 +6,12 @@ CREATE/TOUCH/DELETE semantics and preconditions, ReadRelationships /
 DeleteRelationships by filter, relationship expiration, and a watch log.
 
 Layout is columnar int32 (see :class:`Columns`) so that 10M-relationship
-graphs bulk-load and snapshot without per-row Python objects; a dict index
-over row keys is built lazily only when single-row mutations need it.
+graphs bulk-load and snapshot without per-row Python objects. The row-key
+index the write path needs is hybrid (:class:`StoreIndex`): large chunks
+(bulk loads) get a vectorized lexsorted packed-key index — built in
+O(n log n) numpy, no per-row Python — while small write chunks land in a
+plain dict; liveness is checked at lookup time so tombstoning a row needs
+no index maintenance.
 """
 
 from __future__ import annotations
@@ -19,6 +23,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from .. import native
 from ..models.tuples import Relationship
 from .interning import Interner
 
@@ -125,6 +130,99 @@ class Snapshot:
     objects: dict[int, Interner]  # type id -> per-type object interner
 
 
+# chunks at or above this many rows get the vectorized sorted index; below
+# it a dict is faster to build and query
+INDEX_SMALL_CHUNK = 4096
+
+_MIX1 = np.uint64(0x9E3779B97F4A7C15)
+_MIX2 = np.uint64(0xBF58476D1CE4E5B9)
+_S29 = np.uint64(29)
+_S32 = np.uint64(32)
+
+
+def _hash_key_cols(rt, rid, rl, st, sid, srl) -> np.ndarray:
+    """Vectorized 64-bit mix of the six key columns (splitmix-style).
+    Collisions are verified against the actual columns at lookup, so the
+    hash only needs good dispersion, not perfection. MUST stay arithmetic-
+    identical to mix_key in native/graphcore.cpp — single-key lookups hash
+    here against natively-built sorted arrays."""
+    with np.errstate(over="ignore"):  # uint64 wraparound is the point
+        h = np.asarray(rt).astype(np.uint64)
+        for c in (rid, rl, st, sid, srl):
+            h = (h ^ np.asarray(c).astype(np.uint64)) * _MIX1
+            h = h ^ (h >> _S29)
+        h = h * _MIX2
+        return h ^ (h >> _S32)
+
+
+class _SortedChunkIndex:
+    """Vectorized index over one big chunk: row-key hashes argsorted once
+    (O(n log n) numpy, no per-row Python), lookups by binary search with
+    collision verification against the chunk columns."""
+
+    __slots__ = ("hashes", "order", "cols")
+
+    def __init__(self, cols: Columns):
+        built = native.index_build(cols.rt, cols.rid, cols.rl,
+                                   cols.st, cols.sid, cols.srl)
+        if built is not None:  # multithreaded C++ hash + radix sort
+            self.hashes, self.order = built
+        else:
+            h = _hash_key_cols(cols.rt, cols.rid, cols.rl,
+                               cols.st, cols.sid, cols.srl)
+            self.order = np.argsort(h)
+            self.hashes = h[self.order]
+        self.cols = cols
+
+    def find(self, key: tuple) -> Optional[int]:
+        h0 = _hash_key_cols(*key)
+        lo = int(np.searchsorted(self.hashes, h0, side="left"))
+        hi = int(np.searchsorted(self.hashes, h0, side="right"))
+        c = self.cols
+        rt, rid, rl, st, sid, srl = key
+        for j in range(lo, hi):
+            ri = int(self.order[j])
+            if (c.rt[ri] == rt and c.rid[ri] == rid and c.rl[ri] == rl
+                    and c.st[ri] == st and c.sid[ri] == sid
+                    and c.srl[ri] == srl):
+                return ri
+        return None
+
+
+class StoreIndex:
+    """Hybrid row-key index. ``get`` returns the (chunk, row) of the LIVE
+    row holding a key, or None — dead rows are filtered at lookup time, so
+    tombstoning needs no index write. At most one live row per key exists
+    (the store kills the old row before appending a replacement)."""
+
+    def __init__(self):
+        self._dict: dict[tuple, tuple[int, int]] = {}
+        self._sorted: list[tuple[int, _SortedChunkIndex]] = []
+        self._built = 0  # chunks indexed so far
+
+    def sync(self, chunks: list[Columns]) -> None:
+        for ci in range(self._built, len(chunks)):
+            cols = chunks[ci]
+            if len(cols) >= INDEX_SMALL_CHUNK:
+                self._sorted.append((ci, _SortedChunkIndex(cols)))
+            else:
+                arr = np.stack([cols.rt, cols.rid, cols.rl, cols.st,
+                                cols.sid, cols.srl], axis=1)
+                for ri, row in enumerate(arr.tolist()):
+                    self._dict[tuple(row)] = (ci, ri)
+        self._built = len(chunks)
+
+    def get(self, key: tuple, alive: list) -> Optional[tuple[int, int]]:
+        pos = self._dict.get(key)
+        if pos is not None and alive[pos[0]][pos[1]]:
+            return pos
+        for ci, idx in self._sorted:
+            ri = idx.find(key)
+            if ri is not None and alive[ci][ri]:
+                return ci, ri
+        return None
+
+
 class Store:
     """Thread-safe mutable relationship store."""
 
@@ -140,7 +238,7 @@ class Store:
         self.objects: dict[int, Interner] = {}
         self._chunks: list[Columns] = []
         self._alive: list[np.ndarray] = []  # bool per chunk
-        self._index: Optional[dict[tuple, tuple[int, int]]] = None
+        self._index = StoreIndex()
         self.revision = 0
         # highest revision whose changes are NOT in the watch log
         # (bulk_load / snapshot restore) — incremental graph updates can
@@ -188,32 +286,14 @@ class Store:
 
     # -- index -------------------------------------------------------------
 
-    def _ensure_index(self) -> dict:
-        if self._index is None:
-            idx: dict[tuple, tuple[int, int]] = {}
-            for ci, (cols, alive) in enumerate(zip(self._chunks, self._alive)):
-                live_rows = np.flatnonzero(alive)
-                keys = np.stack(
-                    [cols.rt, cols.rid, cols.rl, cols.st, cols.sid, cols.srl],
-                    axis=1,
-                )
-                for ri in live_rows.tolist():
-                    idx[tuple(keys[ri].tolist())] = (ci, ri)
-            self._index = idx
+    def _ensure_index(self) -> StoreIndex:
+        self._index.sync(self._chunks)
         return self._index
 
-    def _append_rows(self, cols: Columns, update_index: bool) -> None:
-        ci = len(self._chunks)
+    def _append_rows(self, cols: Columns) -> None:
+        # the index picks the new chunk up at the next sync (lazy)
         self._chunks.append(cols)
         self._alive.append(np.ones(len(cols), dtype=bool))
-        if update_index and self._index is not None:
-            keys = np.stack(
-                [cols.rt, cols.rid, cols.rl, cols.st, cols.sid, cols.srl], axis=1
-            )
-            for ri in range(len(cols)):
-                self._index[tuple(keys[ri].tolist())] = (ci, ri)
-        elif not update_index:
-            self._index = None
 
     # -- filter matching ---------------------------------------------------
 
@@ -298,7 +378,7 @@ class Store:
                         f"duplicate update for relationship in one write: {wop.rel}"
                     )
                 seen.add(key)
-                pos = idx.get(key)
+                pos = idx.get(key, self._alive)
                 live = pos is not None and bool(
                     self._chunks[pos[0]].exp[pos[1]] > now
                 )
@@ -317,10 +397,9 @@ class Store:
             rev = self.revision + 1
             new_rows: list[tuple[tuple, float]] = []
             for code, key, exp in plan:
-                pos = idx.get(key)
-                if pos is not None and self._alive[pos[0]][pos[1]]:
+                pos = idx.get(key, self._alive)
+                if pos is not None:
                     self._alive[pos[0]][pos[1]] = False
-                    del idx[key]
                 if code == OP_DELETE:
                     self._watch_log.append(
                         WatchRecord(rev, OP_DELETE,
@@ -336,7 +415,7 @@ class Store:
                     keys[:, 3].copy(), keys[:, 4].copy(), keys[:, 5].copy(),
                     np.array([e for _, e in new_rows], dtype=np.float64),
                 )
-                self._append_rows(cols, update_index=True)
+                self._append_rows(cols)
             self._trim_watch_log()
             self.revision = rev
             return rev
@@ -376,9 +455,7 @@ class Store:
             exp = (np.asarray(exp_col, dtype=np.float64) if exp_col is not None
                    else np.full(n, NO_EXPIRATION))
             exp = np.where(np.isnan(exp), NO_EXPIRATION, exp)
-            self._append_rows(
-                Columns(rt, rid, rl, st, sid, srl, exp), update_index=False
-            )
+            self._append_rows(Columns(rt, rid, rl, st, sid, srl, exp))
             self.revision += 1
             self.unlogged_revision = self.revision
             return self.revision
@@ -434,8 +511,7 @@ class Store:
                 for ri in rows.tolist():
                     key = (int(cols.rt[ri]), int(cols.rid[ri]), int(cols.rl[ri]),
                            int(cols.st[ri]), int(cols.sid[ri]), int(cols.srl[ri]))
-                    if self._index is not None:
-                        self._index.pop(key, None)
+                    # the index needs no touch-up: lookups check aliveness
                     self._watch_log.append(
                         WatchRecord(rev, OP_DELETE,
                                     self._extern_rel(key, NO_EXPIRATION)))
@@ -542,7 +618,7 @@ class Store:
                 self.objects[int(tid)] = it
             self._chunks = [cols]
             self._alive = [np.ones(len(cols), dtype=bool)]
-            self._index = None
+            self._index = StoreIndex()
             self.revision = int(meta["revision"])
             self.unlogged_revision = self.revision
             self._watch_log = []

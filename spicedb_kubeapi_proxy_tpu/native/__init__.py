@@ -33,7 +33,8 @@ def _build() -> bool:
     # concurrent compile must never leave a truncated .so that poisons the
     # mtime-based cache for every later process
     tmp = f"{_LIB}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", _SRC, "-o", tmp]
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
@@ -65,23 +66,53 @@ def _load() -> Optional[ctypes.CDLL]:
                 _load_failed = True
                 return None
         try:
-            lib = ctypes.CDLL(_LIB)
+            lib = _bind(ctypes.CDLL(_LIB))
         except OSError as e:
             log.warning("native load failed (%s); using numpy fallbacks", e)
             _load_failed = True
             return None
-        lib.unique_inverse_fixed.restype = ctypes.c_int64
-        lib.unique_inverse_fixed.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.sort_perm_i64.restype = None
-        lib.sort_perm_i64.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64),
-        ]
+        except AttributeError:
+            # a cached .so from an older source revision can be missing
+            # newer symbols even when mtimes look fresh (archive/rsync -a
+            # deploys preserve old source mtimes): rebuild once, then
+            # degrade to numpy as documented instead of crashing callers
+            log.warning("cached native library is stale; rebuilding")
+            if not _build():
+                _load_failed = True
+                return None
+            try:
+                lib = _bind(ctypes.CDLL(_LIB))
+            except (OSError, AttributeError) as e:
+                log.warning("native reload failed (%s); using numpy "
+                            "fallbacks", e)
+                _load_failed = True
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every entry point's signature; raises AttributeError when
+    the library predates a symbol."""
+    lib.unique_inverse_fixed.restype = ctypes.c_int64
+    lib.unique_inverse_fixed.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.sort_perm_i64.restype = None
+    lib.sort_perm_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.index_build_u64.restype = None
+    lib.index_build_u64.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    return lib
 
 
 def available() -> bool:
@@ -109,6 +140,28 @@ def unique_inverse(arr: np.ndarray):
         uniq_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return uniq_rows[:k], inv
+
+
+def index_build(rt, rid, rl, st, sid, srl):
+    """Row-key index build for the relationship store: hashes the six
+    int32 key columns (same mix as store._hash_key_cols) and returns
+    (sorted_hashes uint64[n], order int64[n]) via a multithreaded radix
+    sort. None when the native path does not apply."""
+    lib = _load()
+    if lib is None:
+        return None
+    cols = [np.ascontiguousarray(c, dtype=np.int32)
+            for c in (rt, rid, rl, st, sid, srl)]
+    n = len(cols[0])
+    hashes = np.empty(n, dtype=np.uint64)
+    order = np.empty(n, dtype=np.int64)
+    p32 = ctypes.POINTER(ctypes.c_int32)
+    lib.index_build_u64(
+        *(c.ctypes.data_as(p32) for c in cols), n,
+        hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return hashes, order
 
 
 def sort_perm(keys: np.ndarray) -> Optional[np.ndarray]:

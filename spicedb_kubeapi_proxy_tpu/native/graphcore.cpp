@@ -17,6 +17,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -110,3 +111,107 @@ void sort_perm_i64(const int64_t* keys, int64_t n, int64_t* out_perm) {
 }
 
 }  // extern "C"
+
+// Row-key index build for the relationship store (engine/store.py
+// StoreIndex): mix the six int32 key columns into 64-bit hashes — the
+// arithmetic MUST match _hash_key_cols in store.py, which hashes single
+// lookup keys against this output — then produce the ascending-hash
+// permutation with a multithreaded LSD radix sort. Stability is
+// irrelevant (collisions are verified against the columns at lookup), but
+// LSD radix is stable anyway.
+namespace {
+
+static inline uint64_t mix_key(int32_t rt, int32_t rid, int32_t rl,
+                               int32_t st, int32_t sid, int32_t srl) {
+  const uint64_t M1 = 0x9E3779B97F4A7C15ull;
+  const uint64_t M2 = 0xBF58476D1CE4E5B9ull;
+  uint64_t h = static_cast<uint64_t>(rt);
+  const int32_t cs[5] = {rid, rl, st, sid, srl};
+  for (int i = 0; i < 5; ++i) {
+    h = (h ^ static_cast<uint64_t>(cs[i])) * M1;
+    h ^= h >> 29;
+  }
+  h *= M2;
+  return h ^ (h >> 32);
+}
+
+static inline int pick_threads(int64_t n) {
+  if (n < (1 << 20)) return 1;
+  unsigned hw = std::thread::hardware_concurrency();
+  int t = hw ? static_cast<int>(hw) : 4;
+  return t > 16 ? 16 : t;
+}
+
+template <typename F>
+static void parallel_ranges(int64_t n, int nt, F f) {
+  if (nt <= 1) {
+    f(0, 0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  const int64_t step = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t lo = t * step;
+    const int64_t hi = lo + step < n ? lo + step : n;
+    if (lo >= hi) break;
+    ts.emplace_back([=] { f(t, lo, hi); });
+  }
+  for (auto& th : ts) th.join();
+}
+
+}  // namespace
+
+extern "C" void index_build_u64(
+    const int32_t* rt, const int32_t* rid, const int32_t* rl,
+    const int32_t* st, const int32_t* sid, const int32_t* srl, int64_t n,
+    uint64_t* hashes_out, int64_t* order_out) {
+  if (n <= 0) return;
+  const int nt = pick_threads(n);
+  std::vector<uint64_t> keys_a(n), keys_b(n);
+  std::vector<int64_t> perm_b(n);
+  parallel_ranges(n, nt, [&](int, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      keys_a[i] = mix_key(rt[i], rid[i], rl[i], st[i], sid[i], srl[i]);
+      order_out[i] = i;
+    }
+  });
+  uint64_t* ksrc = keys_a.data();
+  uint64_t* kdst = keys_b.data();
+  int64_t* psrc = order_out;
+  int64_t* pdst = perm_b.data();
+  // 4 passes of 16-bit digits over the full 64-bit hash
+  for (int shift = 0; shift < 64; shift += 16) {
+    std::vector<std::vector<int64_t>> counts(
+        nt, std::vector<int64_t>(65536, 0));
+    parallel_ranges(n, nt, [&](int t, int64_t lo, int64_t hi) {
+      auto& c = counts[t];
+      for (int64_t i = lo; i < hi; ++i)
+        ++c[(ksrc[i] >> shift) & 0xffff];
+    });
+    // digit-major exclusive prefix across (digit, thread): keeps each
+    // thread's scatter region contiguous per digit (stable)
+    int64_t running = 0;
+    for (int b = 0; b < 65536; ++b) {
+      for (int t = 0; t < nt; ++t) {
+        const int64_t c = counts[t][b];
+        counts[t][b] = running;
+        running += c;
+      }
+    }
+    parallel_ranges(n, nt, [&](int t, int64_t lo, int64_t hi) {
+      auto& pos = counts[t];
+      for (int64_t i = lo; i < hi; ++i) {
+        const int64_t j = pos[(ksrc[i] >> shift) & 0xffff]++;
+        kdst[j] = ksrc[i];
+        pdst[j] = psrc[i];
+      }
+    });
+    std::swap(ksrc, kdst);
+    std::swap(psrc, pdst);
+  }
+  // 4 passes = even number of swaps: results are back in keys_a/order_out
+  std::memcpy(hashes_out, ksrc, n * sizeof(uint64_t));
+  if (psrc != order_out)
+    std::memcpy(order_out, psrc, n * sizeof(int64_t));
+}
+

@@ -57,27 +57,9 @@ class HttpUpstream:
                        and not k.lower().startswith("x-remote-")
                        and k.lower() not in ("authorization", "accept")}
             headers["Host"] = f"{self.host}:{self.port}"
-            # Accept rewriting: the filterer parses JSON (incl. Table) and
-            # kube protobuf lists/objects (authz/filterer.py,
-            # proxy/kubeproto.py) but NOT protobuf Tables or protobuf
-            # watch frames — so protobuf ranges pass through except when
-            # they request Table form, and watch requests stay JSON-only
-            # (the watch join decodes frames as JSON). Anything else is
-            # stripped; an emptied Accept falls back to JSON.
             accept = next((v for k, v in req.headers.items()
                            if k.lower() == "accept"), "")
-            watching = _is_watch(req)
-
-            def keep(r: str) -> bool:
-                low = r.lower()
-                if "json" in low:
-                    return True
-                return ("protobuf" in low and not watching
-                        and "as=table" not in low.replace(" ", ""))
-
-            accept = ",".join(r for r in accept.split(",")
-                              if keep(r)) or "application/json"
-            headers["Accept"] = accept
+            headers["Accept"] = rewrite_accept(accept, _is_watch(req))
             headers["Connection"] = "close"
             if self.token:
                 headers["Authorization"] = f"Bearer {self.token}"
@@ -104,6 +86,26 @@ class HttpUpstream:
         except BaseException:
             writer.close()
             raise
+
+
+def rewrite_accept(accept: str, watching: bool) -> str:
+    """Accept rewriting for upstream requests: the filterer parses JSON
+    (incl. Table) and kube protobuf lists/objects (authz/filterer.py,
+    proxy/kubeproto.py) but NOT protobuf Tables or protobuf watch frames —
+    so protobuf ranges pass through except when they request Table form,
+    and watch requests stay JSON-only (the watch join decodes frames as
+    JSON). Anything else is stripped; an emptied Accept falls back to
+    JSON."""
+
+    def keep(r: str) -> bool:
+        low = r.lower()
+        if "json" in low:
+            return True
+        return ("protobuf" in low and not watching
+                and "as=table" not in low.replace(" ", ""))
+
+    return ",".join(r for r in accept.split(",")
+                    if keep(r)) or "application/json"
 
 
 def _is_watch(req: ProxyRequest) -> bool:

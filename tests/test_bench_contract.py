@@ -57,13 +57,22 @@ def test_sigterm_flushes_partial_json():
         stderr=subprocess.PIPE, text=True)
     # wait for the probe-start line: bench logs it AFTER installing the
     # signal handlers and BEFORE the (hung) probe, so killing now is
-    # deterministic regardless of machine load
-    deadline = time.time() + 60
-    while time.time() < deadline:
-        line = p.stderr.readline()
-        if "probing TPU" in line:
-            break
-    else:
+    # deterministic regardless of machine load. The read runs in a helper
+    # thread so a bench that wedges before logging (or exits instantly)
+    # cannot block or busy-spin this test past its deadline.
+    import threading
+
+    probed = threading.Event()
+
+    def watch_stderr():
+        for line in p.stderr:
+            if "probing TPU" in line:
+                probed.set()
+                return
+
+    t = threading.Thread(target=watch_stderr, daemon=True)
+    t.start()
+    if not probed.wait(timeout=60):
         p.kill()
         raise AssertionError("bench never reached the TPU probe")
     p.send_signal(signal.SIGTERM)

@@ -138,42 +138,29 @@ def test_apply_filter_negotiates_proto():
 
 
 def test_upstream_accept_negotiation():
-    from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest
-
-    def rewritten(accept, query=None):
-        # mirror HttpUpstream's keep() logic through a tiny fake request
-        req = ProxyRequest(method="GET", path="/api/v1/pods",
-                           query=query or {}, headers={"Accept": accept},
-                           body=b"")
-        from spicedb_kubeapi_proxy_tpu.proxy.upstream import _is_watch
-        watching = _is_watch(req)
-
-        def keep(r):
-            low = r.lower()
-            if "json" in low:
-                return True
-            return ("protobuf" in low and not watching
-                    and "as=table" not in low.replace(" ", ""))
-        return ",".join(r for r in accept.split(",")
-                        if keep(r)) or "application/json"
+    from spicedb_kubeapi_proxy_tpu.proxy.upstream import rewrite_accept
 
     # client-go protobuf default: proto range now forwarded
-    assert rewritten(
-        "application/vnd.kubernetes.protobuf,application/json"
+    assert rewrite_accept(
+        "application/vnd.kubernetes.protobuf,application/json", False
     ) == "application/vnd.kubernetes.protobuf,application/json"
     # protobuf Tables are not filterable: range stripped, JSON remains
-    assert rewritten(
+    assert rewrite_accept(
         "application/vnd.kubernetes.protobuf;as=Table;v=v1;g=meta.k8s.io,"
-        "application/json"
+        "application/json", False
     ) == "application/json"
+    # JSON Tables pass through untouched
+    assert rewrite_accept(
+        "application/json;as=Table;v=v1;g=meta.k8s.io,application/json",
+        False
+    ) == "application/json;as=Table;v=v1;g=meta.k8s.io,application/json"
     # watch requests stay JSON-only
-    assert rewritten(
-        "application/vnd.kubernetes.protobuf,application/json",
-        query={"watch": ["true"]}
+    assert rewrite_accept(
+        "application/vnd.kubernetes.protobuf,application/json", True
     ) == "application/json"
     # pure-proto accept on a watch falls back to JSON rather than empty
-    assert rewritten("application/vnd.kubernetes.protobuf",
-                     query={"watch": ["true"]}) == "application/json"
+    assert rewrite_accept(
+        "application/vnd.kubernetes.protobuf", True) == "application/json"
 
 
 def test_json_path_unchanged():

@@ -450,11 +450,28 @@ def _measure(args, result: dict) -> None:
     mask, _ = e.lookup_resources_mask("pod", "view", "user", subjects[0])
     log(f"warmup (jit compile + run): {time.perf_counter() - t0:.1f}s; "
         f"visible={int(mask.sum())}/{n_pods}")
+    profiling = False
+    if args.profile_dir:
+        # device timeline for the measured queries (the fixpoint dispatch
+        # is annotated "sdbkp:fixpoint", ops/reachability.py); view with
+        # tensorboard or xprof
+        import jax
+
+        try:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
+            log(f"jax profiler trace -> {args.profile_dir}")
+        except Exception as ex:  # noqa: BLE001 - profiling is best-effort
+            log(f"profiler start failed (non-fatal): {ex}")
     lat = []
     for u in subjects:
         t0 = time.perf_counter()
         mask, _ = e.lookup_resources_mask("pod", "view", "user", u)
         lat.append((time.perf_counter() - t0) * 1e3)
+    if profiling:
+        import jax
+
+        jax.profiler.stop_trace()
     p50_wall = float(np.percentile(lat, 50))
     p99_wall = float(np.percentile(lat, 99))
     log(f"list-filter latency over {len(lat)} trials: "
@@ -577,6 +594,9 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float, default=120.0,
                     help="hard per-attempt timeout for the subprocess "
                          "TPU probe")
+    ap.add_argument("--profile-dir",
+                    help="write a jax profiler trace of the latency loop "
+                         "here (tensorboard/xprof format)")
     ap.add_argument("--deadline", type=float,
                     default=float(os.environ.get("BENCH_DEADLINE", 1200)),
                     help="overall wall-clock budget; the watchdog emits "

@@ -148,14 +148,15 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
         # object — protobuf list bodies can't feed it, so strip non-JSON
         # ranges from the Accept (keeping JSON ;as=Table form: the
         # postfilter handles Tables). Prefilter paths negotiate protobuf
-        # fine (authz/filterer.py).
+        # fine (authz/filterer.py). watching=True gives exactly the
+        # JSON-only rewrite.
+        from ..proxy.upstream import rewrite_accept
+
         accept = next((v for k, v in req.headers.items()
                        if k.lower() == "accept"), "")
-        accept = ",".join(r for r in accept.split(",")
-                          if "json" in r.lower()) or "application/json"
         req.headers = {k: v for k, v in req.headers.items()
                        if k.lower() != "accept"}
-        req.headers["Accept"] = accept
+        req.headers["Accept"] = rewrite_accept(accept, watching=True)
     try:
         resp = await deps.upstream(req)
     except Exception:

@@ -108,7 +108,7 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                     frame = frame_q.get_nowait()
                     if frame is None:
                         return
-                    key = _frame_object_key(frame, input)
+                    key = _frame_object_key(frame, pf)
                     if key is None or allowed.allows(*key):
                         yield frame  # byte-identical passthrough
                     else:
@@ -122,7 +122,7 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                                                    timeout=poll_interval)
                     if frame is None:
                         return
-                    key = _frame_object_key(frame, input)
+                    key = _frame_object_key(frame, pf)
                     if key is None or allowed.allows(*key):
                         yield frame
                     else:
@@ -136,10 +136,17 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                          stream=frames())
 
 
-def _frame_object_key(frame: bytes, input: ResolveInput) -> Optional[tuple]:
+def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
     """Extract (namespace, name) from a watch frame WITHOUT altering the
     frame bytes (the reference keeps raw bytes via a frame-capturing
-    reader, pkg/authz/frames.go:13-68)."""
+    reader, pkg/authz/frames.go:13-68).
+
+    The key space is defined by the PREFILTER's expressions: the grant
+    side maps object ids through ``name_expr``/``namespace_expr``
+    (map_id above), so the frame side must key identically — a prefilter
+    with no namespace expression produces cluster-scoped ("", name) keys,
+    and the frame's metadata.namespace must then be ignored rather than
+    guessed from the resource name."""
     try:
         ev = json.loads(frame)
         obj = ev.get("object") or {}
@@ -152,9 +159,7 @@ def _frame_object_key(frame: bytes, input: ResolveInput) -> Optional[tuple]:
                 return None
         else:
             meta = obj.get("metadata") or {}
-        ns = meta.get("namespace") or ""
-        if input.request.resource == "namespaces":
-            ns = ""
+        ns = (meta.get("namespace") or "") if pf.namespace_expr else ""
         return (ns, meta.get("name") or "")
     except ValueError:
         return None

@@ -473,12 +473,16 @@ class CompiledGraph:
         qb = np.zeros(Q_pad, dtype=np.int32)
         qb[:Q] = q_batch
         now_rel = np.float32((time.time() if now is None else now) - self.base_time)
-        out, converged, iters = d["run"](
-            d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
-            d["dsrc"], d["ddst"], d["dexp"],
-            jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
-            now_rel, max_iters=max_iters,
-        )
+        # named span in jax.profiler traces (bench --profile-dir / any
+        # caller-managed jax.profiler.trace): lets a device timeline
+        # attribute time to the reachability dispatch specifically
+        with jax.profiler.TraceAnnotation("sdbkp:fixpoint"):
+            out, converged, iters = d["run"](
+                d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
+                d["dsrc"], d["ddst"], d["dexp"],
+                jnp.asarray(seeds), jnp.asarray(qs), jnp.asarray(qb),
+                now_rel, max_iters=max_iters,
+            )
         try:
             out.copy_to_host_async()
             converged.copy_to_host_async()

@@ -62,13 +62,18 @@ class Options:
     bootstrap_content: Optional[str] = None  # yaml text
     rule_files: list = field(default_factory=list)
     rule_content: Optional[str] = None
-    # upstream kube-apiserver
+    # upstream kube-apiserver — three resolution modes, first match wins
+    # (reference RestConfigFunc, options.go:223-263): explicit URL flags,
+    # a kubeconfig file (honoring current-context / --kubeconfig-context),
+    # or the in-cluster service-account environment
     upstream_url: Optional[str] = None
     upstream_token: Optional[str] = None
     upstream_ca_file: Optional[str] = None
     upstream_client_cert: Optional[str] = None
     upstream_client_key: Optional[str] = None
     upstream_insecure: bool = False
+    kubeconfig: Optional[str] = None
+    kubeconfig_context: Optional[str] = None
     # an injected upstream callable overrides the URL (embedding/tests)
     upstream: Optional[object] = None
     # serving
@@ -158,8 +163,27 @@ class Options:
                 "tls-client-ca-file")
         if not (self.rule_files or self.rule_content):
             raise OptionsError("at least one rule file is required")
-        if self.upstream is None and not self.upstream_url:
-            raise OptionsError("an upstream kube-apiserver URL is required")
+        if self.upstream_url and self.kubeconfig:
+            raise OptionsError(
+                "upstream-url and kubeconfig are mutually exclusive")
+        if self.kubeconfig_context and not self.kubeconfig:
+            raise OptionsError("kubeconfig-context requires kubeconfig")
+        if not self.upstream_url and any((
+                self.upstream_token, self.upstream_ca_file,
+                self.upstream_client_cert, self.upstream_client_key,
+                self.upstream_insecure)):
+            raise OptionsError(
+                "upstream-token/ca-file/client-cert/client-key/insecure "
+                "only apply with upstream-url; kubeconfig and in-cluster "
+                "modes carry their own credentials")
+        if self.upstream is None and not self.upstream_url \
+                and not self.kubeconfig:
+            from .kubeconfig import in_cluster_available
+
+            if not in_cluster_available():
+                raise OptionsError(
+                    "an upstream kube-apiserver is required: pass "
+                    "--upstream-url or --kubeconfig, or run in-cluster")
 
     def complete(self) -> "CompletedConfig":
         self.validate()
@@ -185,14 +209,36 @@ class Options:
             engine.load_snapshot_if_exists(self.snapshot_path)
             if self.lookup_batch_window > 0:
                 engine.enable_lookup_batching(self.lookup_batch_window)
-        upstream = self.upstream or HttpUpstream(
-            self.upstream_url,
-            token=self.upstream_token,
-            ca_file=self.upstream_ca_file,
-            client_cert=self.upstream_client_cert,
-            client_key=self.upstream_client_key,
-            insecure_skip_verify=self.upstream_insecure,
-        )
+        upstream = self.upstream
+        if upstream is None:
+            from .kubeconfig import UpstreamConfig
+
+            if self.upstream_url:
+                uc = UpstreamConfig(
+                    url=self.upstream_url,
+                    token=self.upstream_token,
+                    ca_file=self.upstream_ca_file,
+                    client_cert=self.upstream_client_cert,
+                    client_key=self.upstream_client_key,
+                    insecure_skip_verify=self.upstream_insecure,
+                )
+            elif self.kubeconfig:
+                from .kubeconfig import load_kubeconfig
+
+                uc = load_kubeconfig(self.kubeconfig,
+                                     self.kubeconfig_context)
+            else:
+                from .kubeconfig import in_cluster_config
+
+                uc = in_cluster_config()
+            upstream = HttpUpstream(
+                uc.url,
+                token=uc.token,
+                ca_file=uc.ca_file,
+                client_cert=uc.client_cert,
+                client_key=uc.client_key,
+                insecure_skip_verify=uc.insecure_skip_verify,
+            )
         workflow = WorkflowEngine(db_path=self.workflow_database_path)
         register_workflows(workflow)
         ActivityHandler(engine, upstream).register(workflow)
@@ -228,7 +274,8 @@ class Options:
     # leaking until someone extends a denylist
     _DUMP_FIELDS = (
         "engine_endpoint", "engine_mesh", "bootstrap_files", "rule_files",
-        "upstream_url", "upstream_insecure", "bind_host", "bind_port",
+        "upstream_url", "upstream_insecure", "kubeconfig",
+        "kubeconfig_context", "bind_host", "bind_port",
         "workflow_database_path", "lock_mode", "snapshot_path",
     )
 
@@ -268,6 +315,12 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--rule-file", action="append", default=[],
                         help="ProxyRule YAML file (repeatable)")
     parser.add_argument("--upstream-url", help="upstream kube-apiserver URL")
+    parser.add_argument("--kubeconfig",
+                        help="kubeconfig file for the upstream connection "
+                             "(alternative to --upstream-url; in-cluster "
+                             "config is used when neither is given)")
+    parser.add_argument("--kubeconfig-context",
+                        help="kubeconfig context (default: current-context)")
     parser.add_argument("--upstream-token", help="bearer token for upstream")
     parser.add_argument("--upstream-ca-file")
     parser.add_argument("--upstream-client-cert")
@@ -313,6 +366,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         bootstrap_files=args.bootstrap,
         rule_files=args.rule_file,
         upstream_url=args.upstream_url,
+        kubeconfig=args.kubeconfig,
+        kubeconfig_context=args.kubeconfig_context,
         upstream_token=args.upstream_token,
         upstream_ca_file=args.upstream_ca_file,
         upstream_client_cert=args.upstream_client_cert,

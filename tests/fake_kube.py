@@ -26,6 +26,33 @@ def _kind_for(resource: str) -> str:
     return "".join(p.capitalize() for p in singular.split("-"))
 
 
+async def serve_upstream(fake):
+    """Expose an upstream callable (usually a FakeKube) over real HTTP on
+    loopback; returns (asyncio server, port)."""
+    from spicedb_kubeapi_proxy_tpu.proxy.server import (
+        _read_request,
+        _write_response,
+    )
+
+    async def conn(reader, writer):
+        try:
+            while True:
+                req = await _read_request(reader)
+                if req is None:
+                    return
+                resp = await fake(req)
+                await _write_response(writer, resp)
+                if resp.stream is not None:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(conn, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1]
+
+
 class FakeKube:
     def __init__(self):
         # (resource, namespace, name) -> object dict

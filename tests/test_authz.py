@@ -384,6 +384,42 @@ def test_watch_allows_object_after_grant():
     run(go())
 
 
+def test_watch_namespaced_resource_keys_frames_by_prefilter():
+    """Pods watch: the prefilter carries a namespace expression
+    (split_namespace over 'ns/name' object ids), so frames key on
+    (metadata.namespace, metadata.name) — buffer for the wrong user,
+    flush on grant, keyed exactly as the grant side maps object ids
+    (authz/watch.py _frame_object_key)."""
+    async def go():
+        env = Env()
+        await env.create_ns("wns", user="bob")
+        await env.create_pod("wns", "api", user="bob")
+        resp = await env.request("GET", "/api/v1/pods", user="alice",
+                                 query={"watch": ["true"]})
+        assert resp.status == 200 and resp.stream is not None
+        frames = []
+
+        async def consume():
+            async for f in resp.stream:
+                frames.append(json.loads(f))
+                return
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.05)
+        assert not frames  # buffered: alice can't view bob's namespace
+        # grant alice view on the pod's namespace -> pod#view via arrow ->
+        # the buffered ADDED frame for (wns, api) must flush
+        from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+        from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+        env.engine.write_relationships([WriteOp("touch", parse_relationship(
+            "pod:wns/api#viewer@user:alice"))])
+        await asyncio.wait_for(task, timeout=5)
+        meta = frames[0]["object"]["metadata"]
+        assert (meta["namespace"], meta["name"]) == ("wns", "api")
+        env.kube.stop_watches()
+    run(go())
+
+
 def test_multiple_update_rules_rejected():
     async def go():
         dup = RULES + "\n---\n" + RULES.split("---")[0]  # duplicate create rule

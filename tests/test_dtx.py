@@ -325,3 +325,101 @@ def test_workflow_determinism_replay_guard(tmp_path):
         with pytest.raises(ActivityError, match="non-deterministic"):
             await w.runner.get_result(iid, timeout=5)
     asyncio.run(run())
+
+
+@pytest.mark.parametrize("mode", [LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+def test_ownership_stealing_prevented_when_crashing(tmp_path, mode):
+    """paul creates chani's namespace but crashes before reading the kube
+    response; the resumed workflow completes PAUL's ownership, and chani's
+    later create conflicts instead of stealing it (reference
+    proxy_test.go:734-747)."""
+    async def run():
+        db = str(tmp_path / f"steal-{mode}.sqlite")
+        w = World(db_path=db)
+        failpoints.enable("panicKubeReadResp", 1)
+        iid = await w.runner.create_instance(
+            mode, ns_create_input(name="chani-ns", user="paul").to_dict())
+        with pytest.raises(asyncio.TimeoutError):
+            await w.runner.get_result(iid, timeout=0.5)
+        # "restart": paul's dual-write resumes and completes
+        w.runner = w.new_runner()
+        await w.runner.resume_pending()
+        out = await w.runner.get_result(iid, timeout=15)
+        assert out["status"] in (201, 409)
+        assert w.has_rel("namespace:chani-ns#creator@user:paul")
+
+        # chani attempts to create "her" namespace: conflict, not theft
+        iid2 = await w.runner.create_instance(
+            mode, ns_create_input(name="chani-ns", user="chani").to_dict())
+        out2 = await w.runner.get_result(iid2, timeout=15)
+        assert out2["status"] == 409
+        assert w.has_rel("namespace:chani-ns#creator@user:paul")
+        assert not w.has_rel("namespace:chani-ns#creator@user:chani")
+        # chani can't view it — paul owns it and hasn't shared
+        assert not w.engine.check(CheckItem("namespace", "chani-ns", "view",
+                                            "user", "chani"))
+        assert w.engine.check(CheckItem("namespace", "chani-ns", "view",
+                                        "user", "paul"))
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("mode", [LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+def test_ownership_stealing_prevented_when_retrying(tmp_path, mode):
+    """paul owns the namespace; chani's create crashes before reading the
+    kube response. The resumed retry must surface the conflict and roll
+    chani's relationships back — not grant her ownership (reference
+    proxy_test.go:748-760)."""
+    async def run():
+        db = str(tmp_path / f"steal2-{mode}.sqlite")
+        w = World(db_path=db)
+        iid = await w.runner.create_instance(
+            mode, ns_create_input(name="chani-ns", user="paul").to_dict())
+        out = await w.runner.get_result(iid, timeout=15)
+        assert out["status"] == 201
+
+        # the failpoint is armed but chani's create conflicts at the
+        # SpiceDB precondition before any kube write — exactly like the
+        # reference run of this scenario, where the rule preconditions are
+        # the ownership guard (its kube-409-on-create path deliberately
+        # KEEPS relationships, for crash-resume of one's own landed write)
+        failpoints.enable("panicKubeReadResp", 1)
+        iid2 = await w.runner.create_instance(
+            mode, ns_create_input(name="chani-ns", user="chani").to_dict())
+        out2 = await w.runner.get_result(iid2, timeout=15)
+        assert out2["status"] == 409
+        assert w.has_rel("namespace:chani-ns#creator@user:paul")
+        assert not w.has_rel("namespace:chani-ns#creator@user:chani")
+        assert not w.engine.check(CheckItem("namespace", "chani-ns", "view",
+                                            "user", "chani"))
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("mode", [LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
+@pytest.mark.parametrize("rep", range(5))
+def test_single_writer_per_object(mode, rep):
+    """Two users race to create the same namespace: exactly one wins,
+    the loser gets 409 (pessimistic lock conflict / optimistic
+    already-exists), run 5x per lock mode — the reference runs this under
+    MustPassRepeatedly(5) (proxy_test.go:866-904)."""
+    async def run():
+        w = World()
+        i1, i2 = await asyncio.gather(
+            w.runner.create_instance(
+                mode, ns_create_input(name="race-ns", user="paul").to_dict()),
+            w.runner.create_instance(
+                mode, ns_create_input(name="race-ns", user="chani").to_dict()),
+        )
+        o1, o2 = await asyncio.gather(
+            w.runner.get_result(i1, timeout=20),
+            w.runner.get_result(i2, timeout=20),
+        )
+        statuses = sorted([o1["status"], o2["status"]])
+        assert statuses == [201, 409], statuses
+        winner = "paul" if o1["status"] == 201 else "chani"
+        loser = "chani" if winner == "paul" else "paul"
+        assert w.has_rel(f"namespace:race-ns#creator@user:{winner}")
+        assert not w.has_rel(f"namespace:race-ns#creator@user:{loser}")
+        assert w.no_leftover_locks()
+    asyncio.run(run())

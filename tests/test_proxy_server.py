@@ -12,38 +12,11 @@ import json
 import pytest
 
 from spicedb_kubeapi_proxy_tpu.proxy.options import Options
-from spicedb_kubeapi_proxy_tpu.proxy.server import (
-    Server,
-    _read_request,
-    _write_response,
-)
 from spicedb_kubeapi_proxy_tpu.proxy.inmemory import InMemoryClient
 
-from fake_kube import FakeKube
+from fake_kube import FakeKube, serve_upstream
 
 RULES = open("/root/reference/deploy/rules.yaml").read()
-
-
-async def serve_upstream(fake: FakeKube):
-    """Expose FakeKube over real HTTP (loopback)."""
-
-    async def conn(reader, writer):
-        try:
-            while True:
-                req = await _read_request(reader)
-                if req is None:
-                    return
-                resp = await fake(req)
-                await _write_response(writer, resp)
-                if resp.stream is not None:
-                    return
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass
-        finally:
-            writer.close()
-
-    server = await asyncio.start_server(conn, "127.0.0.1", 0)
-    return server, server.sockets[0].getsockname()[1]
 
 
 class HttpClient:

@@ -55,6 +55,10 @@ class AuthzDeps:
     workflow: Optional[WorkflowEngine] = None
     default_lock_mode: str = LOCK_MODE_PESSIMISTIC
     watch_poll_interval: float = 0.05
+    # TTL/disk cache for the always-allowed discovery paths (reference
+    # disk-cached discovery RESTMapper, server.go:228-243); None = every
+    # discovery request hits the upstream
+    discovery_cache: Optional[object] = None
 
 
 def _always_allowed(req: ProxyRequest) -> bool:
@@ -77,6 +81,8 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
         return kube_status(401, "no user info")
 
     if _always_allowed(req):
+        if deps.discovery_cache is not None:
+            return await deps.discovery_cache.serve(req, deps.upstream)
         return await deps.upstream(req)
 
     input = ResolveInput.create(info, user, body=req.body or None,

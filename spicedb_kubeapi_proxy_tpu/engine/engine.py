@@ -228,6 +228,10 @@ class Engine:
             return self._compiled
 
     def _try_incremental(self, cur: CompiledGraph) -> Optional[CompiledGraph]:
+        from ..utils.features import features
+
+        if not features.enabled("IncrementalGraphUpdates"):
+            return None
         st = self.store
         with st._lock:
             if cur.revision < st.unlogged_revision:
@@ -385,7 +389,13 @@ class Engine:
         q_slots = off + np.arange(n, dtype=np.int32)
         q_batch = np.zeros(n, dtype=np.int32)
         t0 = time.perf_counter()
-        fut = self._backend(cg).query_async(seeds, q_slots, q_batch, now=now)
+        # the query arrays are a pure function of (type, permission) slot
+        # layout: cache their device copies across queries (the ~0.5MB
+        # upload per 100k-object lookup otherwise dominates wall latency
+        # on remotely-attached chips)
+        fut = self._backend(cg).query_async(
+            seeds, q_slots, q_batch, now=now,
+            q_cache_key=("lookup", off, n))
         metrics.counter("engine_lookups_total").inc()
 
         def fin(out):

@@ -79,7 +79,12 @@ def eligible(n_dst: int, n_src: int) -> bool:
 
 def kernel_enabled() -> bool:
     """Bit kernel runs on TPU; tests force the interpreter with
-    SDBKP_BITPROP=interpret (CPU default stays on the matmul path)."""
+    SDBKP_BITPROP=interpret (CPU default stays on the matmul path). The
+    BitKernel feature gate turns it off wholesale."""
+    from ..utils.features import features
+
+    if not features.enabled("BitKernel"):
+        return False
     mode = os.environ.get("SDBKP_BITPROP", "auto")
     if mode == "0":
         return False

@@ -210,6 +210,9 @@ class ShardedGraph:
         # dead pairs already folded into this build (updated() applies
         # only the new tail)
         self._applied_dead = _pair_keys(cg.dead_pairs)
+        # device query-grid cache for layout-pure queries (shared across
+        # updated() generations: the slot layout is incremental-invariant)
+        self._qgrid: dict = {}
 
         fn = partial(_run_sharded, cg.run_meta(), self._block_meta, self.ng,
                      max_iters=max_iters)
@@ -415,6 +418,7 @@ class ShardedGraph:
         q_slots: np.ndarray,  # int32 [Q] flat result slots
         q_batch: np.ndarray,  # int32 [Q] batch row per query
         now: Optional[float] = None,
+        q_cache_key: Optional[tuple] = None,
     ) -> ShardedQueryFuture:
         """Engine-compatible flat form (CompiledGraph.query_async surface):
         the flat (q_slots, q_batch) queries are packed into a [B, Qmax]
@@ -446,8 +450,14 @@ class ShardedGraph:
         Q_pad = _next_bucket(Qmax, 8)
         seeds = np.full((B_pad, 2), cg.M, dtype=np.int32)
         seeds[:B] = seed_slots
-        grid = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
-        grid[q_batch, cols] = q_slots
+        grid = self._qgrid.get((q_cache_key, B_pad)) \
+            if q_cache_key else None
+        if grid is None:
+            grid_np = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
+            grid_np[q_batch, cols] = q_slots
+            grid = jnp.asarray(grid_np)
+            if q_cache_key:
+                self._qgrid[(q_cache_key, B_pad)] = grid
         out, converged, iters = self._dispatch(seeds, grid, now)
         return ShardedQueryFuture(out, converged, iters, (q_batch, cols),
                                   max_iters=self.max_iters)

@@ -105,6 +105,13 @@ class Options:
     # "data=D,graph=G"; None/"" = single device. In-process engines only —
     # a tcp:// engine host owns its own mesh.
     engine_mesh: Optional[str] = None
+    # "Name=true,Other=false" over utils/features.py gates
+    feature_gates: Optional[str] = None
+    # API discovery caching (reference disk-cached RESTMapper discovery,
+    # server.go:228-243): TTL in seconds; a directory makes it survive
+    # restarts. 0 disables caching.
+    discovery_cache_ttl: float = 600.0
+    discovery_cache_dir: Optional[str] = None
 
     def _parse_remote(self) -> Optional[tuple[str, int]]:
         """(host, port) for tcp:// endpoints, None otherwise; raises on a
@@ -148,6 +155,13 @@ class Options:
                 "mesh on the tcp:// engine host instead")
         if self.engine_mesh:
             _parse_mesh_spec(self.engine_mesh)  # raises OptionsError
+        if self.feature_gates:
+            from ..utils.features import FeatureGateError, features
+
+            try:
+                features.validate_spec(self.feature_gates)
+            except FeatureGateError as e:
+                raise OptionsError(str(e)) from None
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
         if bool(self.tls_cert_file) != bool(self.tls_key_file):
@@ -187,6 +201,10 @@ class Options:
 
     def complete(self) -> "CompletedConfig":
         self.validate()
+        if self.feature_gates:
+            from ..utils.features import features
+
+            features.apply_spec(self.feature_gates)
         rule_text = "\n---\n".join(
             [open(f).read() for f in self.rule_files]
             + ([self.rule_content] if self.rule_content else []))
@@ -242,9 +260,17 @@ class Options:
         workflow = WorkflowEngine(db_path=self.workflow_database_path)
         register_workflows(workflow)
         ActivityHandler(engine, upstream).register(workflow)
+        discovery_cache = None
+        if self.discovery_cache_ttl > 0:
+            from ..utils.discovery import DiscoveryCache
+
+            discovery_cache = DiscoveryCache(
+                ttl=self.discovery_cache_ttl,
+                cache_dir=self.discovery_cache_dir)
         deps = AuthzDeps(
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
+            discovery_cache=discovery_cache,
         )
         ssl_context = None
         if self.tls_cert_file:
@@ -357,6 +383,14 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--engine-mesh",
                         help="multi-chip device mesh for the in-process "
                              "engine: 'auto' or 'data=D,graph=G'")
+    parser.add_argument("--feature-gates",
+                        help="comma-separated Name=true|false overrides "
+                             "(see utils/features.py for known gates)")
+    parser.add_argument("--discovery-cache-ttl", type=float, default=600.0,
+                        help="API discovery cache TTL seconds (0 disables)")
+    parser.add_argument("--discovery-cache-dir",
+                        help="persist the discovery cache here so it "
+                             "survives restarts")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -385,4 +419,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         lookup_batch_window=args.lookup_batch_window,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,
+        feature_gates=args.feature_gates,
+        discovery_cache_ttl=args.discovery_cache_ttl,
+        discovery_cache_dir=args.discovery_cache_dir,
     )

@@ -97,11 +97,15 @@ def rewrite_accept(accept: str, watching: bool) -> str:
     JSON). Anything else is stripped; an emptied Accept falls back to
     JSON."""
 
+    from ..utils.features import features
+
+    proto_ok = features.enabled("ProtobufNegotiation")
+
     def keep(r: str) -> bool:
         low = r.lower()
         if "json" in low:
             return True
-        return ("protobuf" in low and not watching
+        return (proto_ok and "protobuf" in low and not watching
                 and "as=table" not in low.replace(" ", ""))
 
     return ",".join(r for r in accept.split(",")

@@ -1,0 +1,132 @@
+"""Discovery cache: TTL hits, disk persistence across restarts,
+stale-on-error (reference disk-cached discovery, server.go:228-243)."""
+
+import asyncio
+import json
+
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
+from spicedb_kubeapi_proxy_tpu.proxy.types import (
+    ProxyRequest,
+    json_response,
+)
+from spicedb_kubeapi_proxy_tpu.utils.discovery import DiscoveryCache
+
+
+def _req(path="/api", accept=""):
+    headers = {"Accept": accept} if accept else {}
+    return ProxyRequest(method="GET", path=path, query={}, headers=headers,
+                        body=b"", request_info=parse_request_info(
+                            "GET", path, {}))
+
+
+class CountingUpstream:
+    def __init__(self, fail=False):
+        self.calls = 0
+        self.fail = fail
+
+    async def __call__(self, req):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionError("upstream down")
+        return json_response(200, {"kind": "APIVersions",
+                                   "versions": ["v1"], "n": self.calls})
+
+
+def test_cache_hits_within_ttl(tmp_path):
+    async def go():
+        up = CountingUpstream()
+        c = DiscoveryCache(ttl=60)
+        r1 = await c.serve(_req(), up)
+        r2 = await c.serve(_req(), up)
+        assert up.calls == 1
+        assert r1.body == r2.body
+        # distinct paths and Accept values cache separately
+        await c.serve(_req("/apis"), up)
+        await c.serve(_req(accept="application/json;g=apidiscovery.k8s.io"),
+                      up)
+        assert up.calls == 3
+    asyncio.run(go())
+
+
+def test_disk_persistence_across_restart(tmp_path):
+    async def go():
+        up = CountingUpstream()
+        c1 = DiscoveryCache(ttl=60, cache_dir=str(tmp_path))
+        await c1.serve(_req(), up)
+        assert up.calls == 1
+        # a "restarted" proxy (fresh cache object) serves from disk
+        c2 = DiscoveryCache(ttl=60, cache_dir=str(tmp_path))
+        r = await c2.serve(_req(), up)
+        assert up.calls == 1
+        assert json.loads(r.body)["n"] == 1
+    asyncio.run(go())
+
+
+def test_stale_served_on_upstream_failure():
+    async def go():
+        up = CountingUpstream()
+        c = DiscoveryCache(ttl=0.01)
+        r1 = await c.serve(_req(), up)
+        await asyncio.sleep(0.05)  # expire
+        up.fail = True
+        r2 = await c.serve(_req(), up)  # upstream raises -> stale served
+        assert r2.body == r1.body
+    asyncio.run(go())
+
+
+def test_authorize_uses_discovery_cache():
+    from spicedb_kubeapi_proxy_tpu.authz import AuthzDeps, authorize
+    from spicedb_kubeapi_proxy_tpu.engine import Engine
+    from spicedb_kubeapi_proxy_tpu.rules.matcher import MapMatcher
+    from spicedb_kubeapi_proxy_tpu.rules.input import UserInfo
+
+    RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata:
+  name: r
+match:
+- apiVersion: v1
+  resource: namespaces
+  verbs: ["get"]
+check:
+- tpl: "namespace:{{name}}#view@user:{{user.name}}"
+"""
+
+    async def go():
+        up = CountingUpstream()
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(RULES),
+                         engine=Engine(), upstream=up,
+                         discovery_cache=DiscoveryCache(ttl=60))
+        req = _req("/apis")
+        req.user = UserInfo(name="alice", groups=[], extra={})
+        await authorize(req, deps)
+        await authorize(req, deps)
+        assert up.calls == 1
+    asyncio.run(go())
+
+
+def test_cache_bounded_and_identity_encoding(tmp_path):
+    async def go():
+        seen_enc = []
+
+        async def up(req):
+            seen_enc.append(next((v for k, v in req.headers.items()
+                                  if k.lower() == "accept-encoding"), None))
+            return json_response(200, {"ok": True})
+
+        c = DiscoveryCache(ttl=60, cache_dir=str(tmp_path), max_entries=3)
+        # Accept-Encoding is stripped before the upstream call so cached
+        # bodies are never compressed
+        req = _req()
+        req.headers["Accept-Encoding"] = "gzip"
+        await c.serve(req, up)
+        assert seen_enc == [None]
+        # client-controlled key cardinality cannot grow the cache
+        # unboundedly: memory and disk stay at max_entries
+        for i in range(10):
+            await c.serve(_req(accept=f"application/json;x={i}"), up)
+        assert len(c._mem) <= 3
+        import os
+        assert len(os.listdir(tmp_path)) <= 3
+    asyncio.run(go())

@@ -1,0 +1,45 @@
+# Dev tooling (the reference uses mage targets, magefiles/*.go; this is
+# the same surface as plain make).
+
+PY ?= python
+
+.PHONY: test test-quick bench bench-quick serve-dev native lint clean
+
+# full suite on the virtual 8-device CPU mesh (tests/conftest.py)
+test:
+	$(PY) -m pytest tests/ -q
+
+# fast smoke: engine parity + rules + authz only
+test-quick:
+	$(PY) -m pytest tests/test_engine.py tests/test_rules.py \
+	  tests/test_authz.py -q
+
+# the headline benchmark (real TPU if reachable, CPU-degraded otherwise)
+bench:
+	$(PY) bench.py
+
+bench-quick:
+	$(PY) bench.py --quick
+
+# run a local dev proxy with the in-repo rule set against YOUR apiserver
+# (reference `mage dev:run` runs against a kind cluster; set UPSTREAM_URL
+# — e.g. a kind/minikube endpoint — or swap in --kubeconfig)
+serve-dev:
+	$(PY) -m spicedb_kubeapi_proxy_tpu.proxy.cli \
+	  --rule-file deploy/rules.yaml \
+	  --bootstrap deploy/bootstrap.yaml \
+	  --upstream-url $${UPSTREAM_URL:?set UPSTREAM_URL} \
+	  --bind-port 8443 --enable-debug-config
+
+# (re)build the native graph-builder core explicitly
+native:
+	g++ -O3 -std=c++17 -fPIC -shared -pthread \
+	  spicedb_kubeapi_proxy_tpu/native/graphcore.cpp \
+	  -o spicedb_kubeapi_proxy_tpu/native/libgraphcore.so
+
+lint:
+	$(PY) -m compileall -q spicedb_kubeapi_proxy_tpu tests bench.py
+
+clean:
+	rm -f spicedb_kubeapi_proxy_tpu/native/libgraphcore.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

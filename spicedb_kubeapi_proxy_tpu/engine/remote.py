@@ -429,11 +429,25 @@ def main(argv=None) -> int:
     ap.add_argument("--snapshot-path",
                     help="relationship-store snapshot: loaded at boot if "
                          "present, saved on graceful shutdown")
+    ap.add_argument("--engine-mesh",
+                    help="device mesh for this host's chips: 'auto' or "
+                         "'data=D,graph=G' (the engine host owns the mesh; "
+                         "proxies connect with tcp://)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
+    mesh = None
+    if args.engine_mesh:
+        from ..parallel import make_mesh
+        from ..parallel.mesh import parse_mesh_spec
+
+        try:
+            mesh = make_mesh(**parse_mesh_spec(args.engine_mesh))
+        except ValueError as e:  # MeshSpecError or axis/device mismatch
+            ap.error(str(e))
+        log.info("engine mesh: %s", dict(mesh.shape))
     bootstrap = "\n---\n".join(open(f).read() for f in args.bootstrap) or None
-    engine = Engine(bootstrap=bootstrap)
+    engine = Engine(bootstrap=bootstrap, mesh=mesh)
     if engine.load_snapshot_if_exists(args.snapshot_path):
         log.info("loaded snapshot %s (revision %d)", args.snapshot_path,
                  engine.revision)

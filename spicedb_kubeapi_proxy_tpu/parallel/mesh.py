@@ -22,6 +22,27 @@ import jax
 from jax.sharding import Mesh
 
 
+class MeshSpecError(ValueError):
+    pass
+
+
+def parse_mesh_spec(spec: str) -> dict:
+    """"auto" -> {} (all devices, derived axes); "data=D,graph=G" ->
+    explicit axis sizes (either may be omitted). Raises MeshSpecError."""
+    if spec == "auto":
+        return {}
+    out: dict = {}
+    for part in spec.split(","):
+        k, sep, v = part.partition("=")
+        if not sep or k.strip() not in ("data", "graph") \
+                or not v.strip().isdigit() or int(v) < 1:
+            raise MeshSpecError(
+                f"invalid engine mesh {spec!r} "
+                "(expected 'auto' or 'data=D,graph=G')")
+        out[k.strip()] = int(v)
+    return out
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     data: Optional[int] = None,

@@ -34,20 +34,13 @@ class OptionsError(ValueError):
 
 
 def _parse_mesh_spec(spec: str) -> dict:
-    """"auto" -> {} (all devices, derived axes); "data=D,graph=G" ->
-    explicit axis sizes (either may be omitted). Raises OptionsError."""
-    if spec == "auto":
-        return {}
-    out: dict = {}
-    for part in spec.split(","):
-        k, sep, v = part.partition("=")
-        if not sep or k.strip() not in ("data", "graph") \
-                or not v.strip().isdigit() or int(v) < 1:
-            raise OptionsError(
-                f"invalid engine mesh {spec!r} "
-                "(expected 'auto' or 'data=D,graph=G')")
-        out[k.strip()] = int(v)
-    return out
+    """Mesh spec parsing (parallel/mesh.py), re-raised as OptionsError."""
+    from ..parallel.mesh import MeshSpecError, parse_mesh_spec
+
+    try:
+        return parse_mesh_spec(spec)
+    except MeshSpecError as e:
+        raise OptionsError(str(e)) from None
 
 
 @dataclass

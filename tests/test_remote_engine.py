@@ -178,12 +178,23 @@ def _repo_rules() -> str:
                              "rules.yaml")).read()
 
 
-def test_proxy_against_remote_engine(tmp_path):
-    """Full proxy (rules, dual-write, list filtering) on a tcp:// engine."""
+@pytest.mark.parametrize("mesh_spec", [None, "data=2,graph=4"])
+def test_proxy_against_remote_engine(tmp_path, mesh_spec):
+    """Full proxy (rules, dual-write, list filtering) on a tcp:// engine —
+    single-device and with the engine host owning a device mesh (the
+    remote CLI's --engine-mesh deployment shape)."""
     RULES = _repo_rules()
 
     async def go():
-        engine = Engine()
+        mesh = None
+        if mesh_spec:
+            from spicedb_kubeapi_proxy_tpu.parallel import make_mesh
+            from spicedb_kubeapi_proxy_tpu.parallel.mesh import (
+                parse_mesh_spec,
+            )
+
+            mesh = make_mesh(**parse_mesh_spec(mesh_spec))
+        engine = Engine(mesh=mesh)
         server = EngineServer(engine)
         port = await server.start()
         fake = FakeKube()

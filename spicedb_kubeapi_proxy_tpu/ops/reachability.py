@@ -158,10 +158,13 @@ class _BlockMeta:
     # None in the slim run_meta() view (the traced code reads offsets only)
     dst_local: Optional[np.ndarray]
     src_local: Optional[np.ndarray]
+    # stratification level of the dst range (0 = iterated core; k>=1 =
+    # applied once at phase k — see _stratify)
+    level: int = 0
 
     def slim(self) -> "_BlockMeta":
         return _BlockMeta(self.dst_off, self.n_dst, self.src_off,
-                          self.n_src, None, None)
+                          self.n_src, None, None, self.level)
 
 
 # dense-block eligibility: a block must carry enough edges to beat the
@@ -184,17 +187,75 @@ class _PermProgram:
     expr: Expr
     # leaf name -> slot offset (RelationRef name or Arrow term id)
     leaf_off: dict
+    # stratification level of the permission range (see _stratify)
+    level: int = 0
+
+
+def _range_id(offs: np.ndarray, slot) -> int:
+    """Range id owning a slot: offs is ascending range offsets."""
+    return int(np.searchsorted(offs, slot, side="right")) - 1
+
+
+def _stratify(offs: np.ndarray, src: np.ndarray, dst: np.ndarray,
+              programs: list) -> tuple[dict, int]:
+    """Range-level stratification of the dependency graph.
+
+    Build the range-granularity dependency graph (edges: src range feeds
+    dst range; programs: every leaf range feeds the permission range) and
+    iteratively peel ranges NOTHING still depends on. What cannot be
+    peeled — cycles (recursive groups/orgs) and their ancestors — is the
+    **core** (level 0), the only part the fixpoint must iterate. Peeled
+    ranges get levels 1..L in reverse peel order, so every level-k
+    range's inputs sit strictly below k and one application per level
+    suffices.
+
+    Why it matters: in kube-shaped graphs the overwhelmingly largest
+    ranges (per-pod relations) are acyclic sinks — iterating them with
+    the core multiplies the dominant per-hop HBM traffic by the graph
+    diameter for nothing. Returns ({range_id: level}, n_levels).
+    """
+    n_ranges = len(offs)
+    consumers: list[set] = [set() for _ in range(n_ranges)]
+    if len(src):
+        src_rid = np.searchsorted(offs, src, side="right") - 1
+        dst_rid = np.searchsorted(offs, dst, side="right") - 1
+        for s, d in set(zip(src_rid.tolist(), dst_rid.tolist())):
+            consumers[s].add(d)
+    for p in programs:
+        p_rid = _range_id(offs, p.dst_off)
+        for off in set(p.leaf_off.values()):
+            consumers[_range_id(offs, off)].add(p_rid)
+    remaining = set(range(n_ranges))
+    peel: list[list[int]] = []
+    while True:
+        removable = [r for r in remaining if not (consumers[r] & remaining)]
+        if not removable:
+            break
+        peel.append(removable)
+        remaining -= set(removable)
+    n_levels = len(peel)
+    level = {r: 0 for r in remaining}  # cyclic core + its ancestors
+    for i, grp in enumerate(peel):  # peeled first -> evaluated last
+        for r in grp:
+            level[r] = n_levels - i
+    return level, n_levels
 
 
 @dataclass(frozen=True)
 class RunMeta:
     """What the traced fixpoint reads from the graph: slot count,
-    permission programs, dense-block offsets. Captured by jit closures in
+    permission programs, dense-block offsets, stratification (residual
+    level bounds + per-level edge-dst masks). Captured by jit closures in
     place of the full CompiledGraph (see _jit_run_for)."""
 
     M: int
     programs: tuple
     blocks: tuple
+    res_level_bounds: tuple  # len n_levels+2: slice bounds into residual
+    n_levels: int
+    # per level 1..L: tuple of (offset, size) slot ranges finalized at
+    # that level (merged via per-range slice writes — no dense masks)
+    level_ranges: tuple
 
 
 @dataclass
@@ -231,10 +292,16 @@ class CompiledGraph:
     delta_exp: Optional[np.ndarray] = None  # float32 rel to base_time
     n_delta: int = 0
     dead_pairs: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst)
-    # host residual views (padded, dst-sorted) for incremental search
+    # host residual views (padded; ordered by (level, dst) — see
+    # _stratify/res_level_bounds) for device upload + incremental search
     res_src: Optional[np.ndarray] = None
     res_dst: Optional[np.ndarray] = None
     res_exp: Optional[np.ndarray] = None
+    # stratification: residual slice bounds per level (len n_levels+2)
+    # and the level of every slot range (range_offs-aligned)
+    res_level_bounds: Optional[tuple] = None
+    n_levels: int = 0
+    range_levels: Optional[np.ndarray] = None
     # compile-time lookup tables reused by the incremental path
     range_offs: Optional[np.ndarray] = None  # ascending slot-range offsets
     block_index: dict = field(default_factory=dict)  # (dst_off,src_off)->i
@@ -321,17 +388,27 @@ class CompiledGraph:
 
         return (
             self.M,
-            tuple((p.dst_off, p.size, expr_sig(p.expr, p.leaf_off))
+            tuple((p.dst_off, p.size, p.level,
+                   expr_sig(p.expr, p.leaf_off))
                   for p in self.programs),
-            tuple((b.dst_off, b.n_dst, b.src_off, b.n_src)
+            tuple((b.dst_off, b.n_dst, b.src_off, b.n_src, b.level)
                   for b in self.blocks),
-            # padded residual length: the only residual property that is
-            # baked into traced shapes (edge values are runtime args)
-            -1 if self.res_idx is None
-            else _next_bucket(max(len(self.res_idx), 1)),
             # padded delta-segment length (grows by buckets under
-            # incremental updates; each growth re-specializes once)
+            # incremental updates; each growth re-specializes once). The
+            # residual's traced shape is fully determined by
+            # res_level_bounds below (per-level buckets).
             self._delta_pad(),
+            # stratification: the traced program slices the residual at
+            # these bounds and bakes per-level merge ranges, so two graphs
+            # may share a jit ONLY with identical stratification. The
+            # unstratified fallback (hand-built graphs) discriminates on
+            # its full padded residual length instead.
+            self.n_levels,
+            self.res_level_bounds if self.res_level_bounds is not None
+            else ("unstratified", len(self.res_src)
+                  if self.res_src is not None else len(self.src)),
+            None if self.range_levels is None
+            else tuple(self.range_levels.tolist()),
         )
 
     def _delta_pad(self) -> int:
@@ -343,10 +420,27 @@ class CompiledGraph:
         """Slim static-metadata view for jit closures: everything the
         traced fixpoint reads from the graph object, nothing that holds
         host edge arrays or device buffers alive."""
+        bounds = self.res_level_bounds
+        if bounds is None:
+            n_res = (len(self.res_src) if self.res_src is not None
+                     else len(self.src))
+            bounds = (0, n_res)  # unstratified: everything is core
+        level_ranges = []
+        if self.n_levels and self.range_levels is not None:
+            offs = self.range_offs
+            ends = np.append(offs[1:], self.M)
+            for k in range(1, self.n_levels + 1):
+                level_ranges.append(tuple(
+                    (int(offs[rid]), int(ends[rid]) - int(offs[rid]))
+                    for rid in np.flatnonzero(
+                        self.range_levels == k).tolist()))
         return RunMeta(
             M=self.M,
             programs=tuple(self.programs),
             blocks=tuple(b.slim() for b in self.blocks),
+            res_level_bounds=tuple(bounds),
+            n_levels=self.n_levels,
+            level_ranges=tuple(level_ranges),
         )
 
     def _dev(self):
@@ -524,32 +618,49 @@ class CompiledGraph:
         ).result()
 
     def hop_bytes(self, batch: int = 1) -> dict:
-        """Estimated HBM traffic per fixpoint hop (bytes) for roofline
-        reporting: residual gather/segment streams, dense-block operand
-        streams (bit-packed or int8 A), and the elementwise program passes.
-        An estimate of bytes *touched* — XLA fusion can only reduce it, so
-        effective-bandwidth numbers derived from it are conservative."""
+        """Estimated HBM traffic (bytes) for roofline reporting, split by
+        the stratified schedule: ``total`` is the per-ITERATION cost of
+        the cyclic core (what multiplies by the fixpoint iteration count);
+        ``tail_once`` is the one-shot cost of all acyclic levels. Streams
+        counted: residual gather/segment, dense-block operands (bit-packed
+        or int8 A), elementwise program passes. An estimate of bytes
+        *touched* — XLA fusion can only reduce it."""
         rows = self.M // LANE + 1
         Mp = rows * LANE
-        E_res = len(self.res_idx) if self.res_idx is not None \
-            else self.n_edges
-        E_pad = _next_bucket(max(E_res, 1))
-        # per edge: src+dst int32 + valid uint8 + B gathered bytes; plus
-        # the propagated state write
-        res = E_pad * (4 + 4 + 1 + batch) + batch * Mp
-        blocks = 0
-        use_bits = batch <= bitprop.BIT_B_MAX and bitprop.kernel_enabled()
-        for b in self.blocks:
+
+        def res_bytes(n):  # src+dst int32 + valid uint8 + B gathered
+            return n * (4 + 4 + 1 + batch) + batch * Mp
+
+        def block_bytes(b):
+            use_bits = (batch <= bitprop.BIT_B_MAX
+                        and bitprop.kernel_enabled())
             if use_bits and bitprop.eligible(b.n_dst, b.n_src):
                 k0 = (b.n_src + 31) // 32
                 k_pad = -(-k0 // bitprop.LANES) * bitprop.LANES
-                blocks += b.n_dst * k_pad * 4
-            else:
-                blocks += b.n_dst * b.n_src
-        prog = sum(2 * p.size * batch for p in self.programs)
+                return b.n_dst * k_pad * 4
+            return b.n_dst * b.n_src
+
+        bounds = self.res_level_bounds
+        if bounds is None:
+            n_core = (len(self.res_idx) if self.res_idx is not None
+                      else self.n_edges)
+            tail_res = 0
+        else:
+            n_core = bounds[1] - bounds[0]
+            tail_res = bounds[-1] - bounds[1]
         delta = self._delta_pad() * (4 + 4 + 1 + batch)
-        return {"residual": res + delta, "blocks": blocks, "programs": prog,
-                "total": res + delta + blocks + prog}
+        core_res = res_bytes(n_core) + delta
+        core_blocks = sum(block_bytes(b) for b in self.blocks
+                          if b.level == 0)
+        core_prog = sum(2 * p.size * batch for p in self.programs
+                        if p.level == 0)
+        tail = (res_bytes(tail_res) if tail_res else 0) \
+            + sum(block_bytes(b) for b in self.blocks if b.level > 0) \
+            + sum(2 * p.size * batch for p in self.programs if p.level > 0) \
+            + self.n_levels * (delta + 2 * batch * Mp)  # merges + delta
+        return {"residual": core_res, "blocks": core_blocks,
+                "programs": core_prog, "tail_once": tail,
+                "total": core_res + core_blocks + core_prog}
 
 
 @dataclass
@@ -577,10 +688,11 @@ class QueryFuture:
         return int(self._iters)
 
 
-def _apply_program(cg: CompiledGraph, V):
-    """Recompute every permission slot range from its expression. V is
-    [B, rows, LANE]; every range offset/size is a multiple of LANE, so a
-    range is a row-aligned static slice along axis 1."""
+def _apply_program(cg: CompiledGraph, V, programs=None):
+    """Recompute permission slot ranges from their expressions (all of
+    cg's programs, or an explicit subset). V is [B, rows, LANE]; every
+    range offset/size is a multiple of LANE, so a range is a row-aligned
+    static slice along axis 1."""
 
     def ev(expr: Expr, p: _PermProgram):
         if isinstance(expr, Nil):
@@ -604,31 +716,39 @@ def _apply_program(cg: CompiledGraph, V):
             return ev(expr.base, p) & (ev(expr.subtract, p) ^ 1)
         raise TypeError(f"unknown expr {expr!r}")
 
-    for p in cg.programs:
+    for p in (cg.programs if programs is None else programs):
         V = jax.lax.dynamic_update_slice_in_dim(
             V, ev(p.expr, p), p.dst_off // LANE, axis=1)
     return V
 
 
-def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid,
-               dsrc, ddst, dvalid, V):
-    """One hop: dense relation blocks as MXU matmuls (large batch) or
-    bit-packed VPU contractions (small batch), plus residual edges as a
+def _propagate(cg, blocks, blocks_bits, src, dst, valid,
+               dsrc, ddst, dvalid, V, level: Optional[int] = None):
+    """One hop restricted to one stratification level (None = all): dense
+    relation blocks as MXU matmuls (large batch) or bit-packed VPU
+    contractions (small batch), plus residual edges as a
     gather/segment-max, plus the (small) incremental delta segment as a
-    second gather/segment-max. V is [B, rows, LANE]; returns prop in the
-    flat [B, rows*LANE] view (caller reshapes)."""
+    second gather/segment-max. The residual args must already be the
+    level's slice; blocks are filtered here by their level. V is
+    [B, rows, LANE]; returns prop in the flat [B, rows*LANE] view (caller
+    reshapes)."""
     B = V.shape[0]
     Mp = V.shape[1] * LANE  # M + trash row
     Vflat = V.reshape(B, Mp)
     # residual (expiring / sparse / tiny) edges: gather + segment-max over
     # the slot axis (edge arrays index flat slots; trash padding lands in
     # the trash row)
-    gathered = (Vflat[:, src] & valid[None, :]).T  # [E_res, B]
-    prop = jax.ops.segment_max(
-        gathered, dst, num_segments=Mp, indices_are_sorted=True
-    ).T  # [B, Mp]
+    if src.shape[0]:
+        gathered = (Vflat[:, src] & valid[None, :]).T  # [E_slice, B]
+        prop = jax.ops.segment_max(
+            gathered, dst, num_segments=Mp, indices_are_sorted=True
+        ).T  # [B, Mp]
+    else:
+        prop = jnp.zeros((B, Mp), dtype=jnp.uint8)
     # delta segment: edges appended by incremental updates since the last
-    # full compile (dst-sorted on host at update time)
+    # full compile (dst-sorted on host at update time). Applied at EVERY
+    # level — contributions outside the level's ranges are masked off by
+    # the caller's merge, so correctness holds at O(delta) cost per phase.
     gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T  # [D_pad, B]
     prop = prop | jax.ops.segment_max(
         gathered_d, ddst, num_segments=Mp, indices_are_sorted=True
@@ -638,6 +758,8 @@ def _propagate(cg: CompiledGraph, blocks, blocks_bits, src, dst, valid,
     # B<=BIT_B_MAX; the MXU matmul amortizes A across large batches
     use_bits = B <= bitprop.BIT_B_MAX and bitprop.kernel_enabled()
     for bm, A, Abits in zip(cg.blocks, blocks, blocks_bits):
+        if level is not None and bm.level != level:
+            continue
         frontier = jax.lax.dynamic_slice(
             Vflat, (0, bm.src_off), (B, bm.n_src)
         )  # [B, n_src]
@@ -675,25 +797,39 @@ def _seed_base(cg: CompiledGraph, seeds):
     return _apply_program(cg, base.reshape(B, rows, LANE))
 
 
-def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel,
+def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel,
          dsrc, ddst, dexp, seeds, q_slots, q_batch, now_rel, *,
          max_iters: int):
-    """The jitted fixpoint. V layout: [B, rows, LANE] uint8 — the slot
-    space rides the lane axis so a B=1 query streams exactly M bytes per
-    elementwise pass instead of a lane-padded 128x that; slot s lives at
-    (s // LANE, s % LANE) and every range is row-aligned."""
+    """The jitted stratified fixpoint. V layout: [B, rows, LANE] uint8 —
+    the slot space rides the lane axis so a B=1 query streams exactly M
+    bytes per elementwise pass instead of a lane-padded 128x that; slot s
+    lives at (s // LANE, s % LANE) and every range is row-aligned.
+
+    Schedule (see _stratify): only the cyclic CORE (level 0) iterates in
+    the while_loop; each acyclic level k=1..n_levels is then applied
+    exactly once — its ranges' in-edges all live at level k and their
+    sources are already final. In kube-shaped graphs this keeps the
+    dominant per-pod blocks out of the loop entirely."""
     B = seeds.shape[0]
     rows = cg.M // LANE + 1  # + trash row (slots M .. M+LANE-1)
     Mp = rows * LANE
     valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E_res]
     dvalid = (dexp > now_rel).astype(jnp.uint8)  # [D_pad]
     base = _seed_base(cg, seeds)
+    baseflat = base.reshape(B, Mp)
+    bounds = cg.res_level_bounds
+    core_progs = [p for p in cg.programs if p.level == 0]
+
+    def level_slice(k):
+        lo, hi = bounds[k], bounds[k + 1]
+        return src[lo:hi], dst[lo:hi], valid[lo:hi]
 
     def step(V):
-        prop = _propagate(cg, blocks, blocks_bits, src, dst, valid,
-                          dsrc, ddst, dvalid, V)
+        s, d, v = level_slice(0)
+        prop = _propagate(cg, blocks, blocks_bits, s, d, v,
+                          dsrc, ddst, dvalid, V, level=0)
         return _apply_program(
-            cg, prop.reshape(B, rows, LANE) | base)
+            cg, prop.reshape(B, rows, LANE) | base, core_progs)
 
     def cond(state):
         V, prev_changed, it = state
@@ -704,9 +840,25 @@ def _run(cg: CompiledGraph, blocks, blocks_bits, src, dst, exp_rel,
         V2 = step(V)
         return V2, jnp.any(V2 != V), it + 1
 
-    V0 = base
     V, still_changing, iters = jax.lax.while_loop(
-        cond, body, (V0, jnp.bool_(True), 0))
+        cond, body, (base, jnp.bool_(True), 0))
+    # acyclic levels: one application each. No phase may be skipped —
+    # incremental delta edges can target any level and only this phase's
+    # re-application establishes their values. The merge writes only the
+    # level's (row-aligned) slot ranges, so finalized lower levels are
+    # untouched and no dense masks exist anywhere.
+    for k in range(1, cg.n_levels + 1):
+        progs_k = [p for p in cg.programs if p.level == k]
+        s, d, v = level_slice(k)
+        prop = _propagate(cg, blocks, blocks_bits, s, d, v,
+                          dsrc, ddst, dvalid, V, level=k)
+        propb = prop | baseflat
+        Vflat = V.reshape(B, Mp)
+        for off, size in cg.level_ranges[k - 1]:
+            Vflat = jax.lax.dynamic_update_slice(
+                Vflat, jax.lax.dynamic_slice(propb, (0, off), (B, size)),
+                (0, off))
+        V = _apply_program(cg, Vflat.reshape(B, rows, LANE), progs_k)
     # still_changing at loop exit means we hit max_iters before convergence;
     # surface it so the host can raise instead of silently denying
     out = V.reshape(B, Mp)[q_batch, q_slots].astype(jnp.bool_)
@@ -910,58 +1062,6 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     dst_p[:n_edges] = dst
     exp_p[:n_edges] = exp
 
-    # ---- dense/residual split (single-chip MXU path) ----
-    # ranges: every (type, rel) slot range, ascending; edges map to a
-    # (dst range, src range) pair by binary search
-    range_items = sorted(slot_offset.items(), key=lambda kv: kv[1])
-    offs = np.asarray([o for _, o in range_items], dtype=np.int64)
-    sizes = np.asarray(
-        [type_sizes[t] for (t, _), _ in range_items], dtype=np.int64
-    )
-    blocks: list[_BlockMeta] = []
-    res_parts: list[np.ndarray] = []
-    if n_edges:
-        never_expires = exp == np.inf
-        dst_rid = np.searchsorted(offs, dst, side="right") - 1
-        src_rid = np.searchsorted(offs, src, side="right") - 1
-        key = dst_rid * len(offs) + src_rid
-        # expiring edges always ride the residual path (query-time clock)
-        key = np.where(never_expires, key, -1)
-        uniq, inv, counts = np.unique(key, return_inverse=True,
-                                      return_counts=True)
-        for ui, (k, cnt) in enumerate(zip(uniq.tolist(), counts.tolist())):
-            sel = np.flatnonzero(inv == ui)
-            if k < 0:
-                res_parts.append(sel)
-                continue
-            d_rid, s_rid = divmod(k, len(offs))
-            n_dst, n_src = int(sizes[d_rid]), int(sizes[s_rid])
-            cells = n_dst * n_src
-            if (cnt < DENSE_MIN_EDGES or cells > DENSE_MAX_CELLS
-                    or (cells > DENSE_MIN_CELLS
-                        and cnt / cells < DENSE_MIN_DENSITY)):
-                res_parts.append(sel)
-                continue
-            blocks.append(_BlockMeta(
-                dst_off=int(offs[d_rid]), n_dst=n_dst,
-                src_off=int(offs[s_rid]), n_src=n_src,
-                dst_local=(dst[sel] - offs[d_rid]).astype(np.int32),
-                src_local=(src[sel] - offs[s_rid]).astype(np.int32),
-            ))
-    res_idx = (np.sort(np.concatenate(res_parts)) if res_parts
-               else np.empty(0, dtype=np.int64))
-
-    # padded host residual views (dst-sorted): uploaded by _dev_locked and
-    # searched by incremental_update to invalidate deleted base edges
-    n_res = len(res_idx)
-    R_pad = _next_bucket(max(n_res, 1))
-    res_src = np.full(R_pad, M, dtype=np.int32)
-    res_dst = np.full(R_pad, M, dtype=np.int32)
-    res_exp = np.full(R_pad, -np.inf, dtype=np.float32)
-    res_src[:n_res] = src_p[res_idx]
-    res_dst[:n_res] = dst_p[res_idx]
-    res_exp[:n_res] = exp_p[res_idx]
-
     # ---- elementwise programs ----
     programs: list[_PermProgram] = []
     for tname in sorted(schema.definitions):
@@ -995,6 +1095,85 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
                 _PermProgram(slot_offset[(tname, pname)], n, expr, leaf_off)
             )
 
+    # ---- stratification + dense/residual split (single-chip path) ----
+    # ranges: every (type, rel) slot range, ascending; edges map to a
+    # (dst range, src range) pair by binary search
+    range_items = sorted(slot_offset.items(), key=lambda kv: kv[1])
+    offs = np.asarray([o for _, o in range_items], dtype=np.int64)
+    sizes = np.asarray(
+        [type_sizes[t] for (t, _), _ in range_items], dtype=np.int64
+    )
+    level_map, n_levels = _stratify(offs, src, dst, programs)
+    range_levels = np.asarray(
+        [level_map[r] for r in range(len(offs))], dtype=np.int32)
+    for p in programs:
+        p.level = int(range_levels[_range_id(offs, p.dst_off)])
+
+    blocks: list[_BlockMeta] = []
+    res_parts: list[np.ndarray] = []
+    if n_edges:
+        never_expires = exp == np.inf
+        dst_rid = np.searchsorted(offs, dst, side="right") - 1
+        src_rid = np.searchsorted(offs, src, side="right") - 1
+        edge_level = range_levels[dst_rid]
+        key = dst_rid * len(offs) + src_rid
+        # expiring edges always ride the residual path (query-time clock)
+        key = np.where(never_expires, key, -1)
+        uniq, inv, counts = np.unique(key, return_inverse=True,
+                                      return_counts=True)
+        for ui, (k, cnt) in enumerate(zip(uniq.tolist(), counts.tolist())):
+            sel = np.flatnonzero(inv == ui)
+            if k < 0:
+                res_parts.append(sel)
+                continue
+            d_rid, s_rid = divmod(k, len(offs))
+            n_dst, n_src = int(sizes[d_rid]), int(sizes[s_rid])
+            cells = n_dst * n_src
+            if (cnt < DENSE_MIN_EDGES or cells > DENSE_MAX_CELLS
+                    or (cells > DENSE_MIN_CELLS
+                        and cnt / cells < DENSE_MIN_DENSITY)):
+                res_parts.append(sel)
+                continue
+            blocks.append(_BlockMeta(
+                dst_off=int(offs[d_rid]), n_dst=n_dst,
+                src_off=int(offs[s_rid]), n_src=n_src,
+                dst_local=(dst[sel] - offs[d_rid]).astype(np.int32),
+                src_local=(src[sel] - offs[s_rid]).astype(np.int32),
+                level=int(range_levels[d_rid]),
+            ))
+    res_idx = (np.sort(np.concatenate(res_parts)) if res_parts
+               else np.empty(0, dtype=np.int64))
+
+    # padded host residual views ordered by (level, dst) — the traced
+    # program slices the residual per level (res_level_bounds), each slice
+    # dst-sorted for segment_max's indices_are_sorted and padded to its
+    # own power-of-two bucket so the bounds (part of the jit signature)
+    # stay stable as edge counts drift between recompiles
+    n_res = len(res_idx)
+    if n_res:
+        res_lvl = edge_level[res_idx]
+        order = np.lexsort((dst[res_idx], res_lvl))
+        res_idx = res_idx[order]
+        res_lvl = res_lvl[order]
+        counts_per_level = np.bincount(res_lvl, minlength=n_levels + 1)
+    else:
+        counts_per_level = np.zeros(n_levels + 1, dtype=np.int64)
+    pads = [_next_bucket(max(int(c), 1)) for c in counts_per_level]
+    res_level_bounds = tuple(int(x) for x in np.concatenate(
+        [[0], np.cumsum(pads)]))
+    res_src = np.full(res_level_bounds[-1], M, dtype=np.int32)
+    res_dst = np.full(res_level_bounds[-1], M, dtype=np.int32)
+    res_exp = np.full(res_level_bounds[-1], -np.inf, dtype=np.float32)
+    pos = 0
+    for k in range(n_levels + 1):
+        n_k = int(counts_per_level[k])
+        lo = res_level_bounds[k]
+        sel = res_idx[pos:pos + n_k]
+        res_src[lo:lo + n_k] = src_p[sel]
+        res_dst[lo:lo + n_k] = dst_p[sel]
+        res_exp[lo:lo + n_k] = exp_p[sel]
+        pos += n_k
+
     return CompiledGraph(
         schema=schema,
         revision=snapshot.revision,
@@ -1012,6 +1191,9 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         res_src=res_src,
         res_dst=res_dst,
         res_exp=res_exp,
+        res_level_bounds=res_level_bounds,
+        n_levels=n_levels,
+        range_levels=range_levels,
         range_offs=offs,
         block_index={(b.dst_off, b.src_off): i
                      for i, b in enumerate(blocks)},
@@ -1076,6 +1258,20 @@ def _edges_for_tuple(cg: CompiledGraph, store, rel):
     return edges
 
 
+def _level_order_ok(cg: CompiledGraph, src: int, dst: int) -> bool:
+    """A delta edge is compatible with the frozen stratification iff its
+    source finalizes before (or iterates with) its destination: both in
+    the iterated core, or level(src) < level(dst). Violations — a
+    first-ever dependency direction between two ranges — need a
+    re-stratifying full recompile."""
+    if cg.range_levels is None:
+        return True  # unstratified graph: single full fixpoint
+    offs = cg.range_offs
+    ls = int(cg.range_levels[_range_id(offs, src)])
+    ld = int(cg.range_levels[_range_id(offs, dst)])
+    return (ls == 0 and ld == 0) or ls < ld
+
+
 def _pair_block(cg: CompiledGraph, src: int, dst: int):
     """Dense-block index covering a (src, dst) slot pair, or None."""
     if not cg.block_index:
@@ -1087,13 +1283,21 @@ def _pair_block(cg: CompiledGraph, src: int, dst: int):
 
 
 def _res_positions(cg: CompiledGraph, src: int, dst: int) -> list[int]:
-    """Base-residual positions holding the (src, dst) edge (dst-sorted
-    arrays; the per-dst run is scanned for the src match)."""
-    lo = int(np.searchsorted(cg.res_dst, dst, side="left"))
-    hi = int(np.searchsorted(cg.res_dst, dst, side="right"))
-    if lo == hi:
-        return []
-    return (lo + np.flatnonzero(cg.res_src[lo:hi] == src)).tolist()
+    """Base-residual positions holding the (src, dst) edge. The residual
+    is ordered by (level, dst), so each level slice is binary-searched
+    and its per-dst run scanned for the src match."""
+    bounds = cg.res_level_bounds or (0, len(cg.res_dst))
+    out: list[int] = []
+    for k in range(len(bounds) - 1):
+        b0, b1 = bounds[k], bounds[k + 1]
+        if b0 == b1:
+            continue
+        lo = b0 + int(np.searchsorted(cg.res_dst[b0:b1], dst, side="left"))
+        hi = b0 + int(np.searchsorted(cg.res_dst[b0:b1], dst, side="right"))
+        if lo < hi:
+            out.extend(
+                (lo + np.flatnonzero(cg.res_src[lo:hi] == src)).tolist())
+    return out
 
 
 def incremental_update(cg: CompiledGraph, records, new_revision: int,
@@ -1131,6 +1335,12 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
         edges = _edges_for_tuple(cg, store, relationship)
         if edges is None:
             return None
+        for src, dst in edges:
+            if not is_delete and not _level_order_ok(cg, src, dst):
+                # the new edge would invert the frozen stratification
+                # (e.g. a first-ever dependency creating a cycle across
+                # levels): re-stratify via a full recompile
+                return None
         for src, dst in edges:
             # invalidate everywhere the BASE edge may live (idempotent):
             # dense-block cell cleared, residual expiration forced stale,
@@ -1211,6 +1421,9 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
         res_src=cg.res_src,
         res_dst=cg.res_dst,
         res_exp=res_exp,
+        res_level_bounds=cg.res_level_bounds,
+        n_levels=cg.n_levels,
+        range_levels=cg.range_levels,
         range_offs=cg.range_offs,
         block_index=cg.block_index,
         self_off=cg.self_off,

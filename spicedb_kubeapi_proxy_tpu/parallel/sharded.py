@@ -279,10 +279,11 @@ class ShardedGraph:
         src = np.concatenate([p[0] for p in parts])
         dst = np.concatenate([p[1] for p in parts])
         exp = np.concatenate([p[2] for p in parts])
-        if len(parts) > 1:
-            order = np.argsort(dst, kind="stable")
-            src, dst, exp = src[order], dst[order], exp[order]
-        return src, dst, exp, kept
+        # ALWAYS re-sort: cg.res_* is ordered by (level, dst) for the
+        # stratified single-chip schedule, but the sharded fixpoint runs
+        # unstratified and needs each contiguous chunk dst-sorted
+        order = np.argsort(dst, kind="stable")
+        return src[order], dst[order], exp[order], kept
 
     @staticmethod
     def _not_dead_mask(e_src, e_dst, dead):

@@ -196,7 +196,7 @@ def _range_id(offs: np.ndarray, slot) -> int:
     return int(np.searchsorted(offs, slot, side="right")) - 1
 
 
-def _stratify(offs: np.ndarray, src: np.ndarray, dst: np.ndarray,
+def _stratify(offs: np.ndarray, src_rid: np.ndarray, dst_rid: np.ndarray,
               programs: list) -> tuple[dict, int]:
     """Range-level stratification of the dependency graph.
 
@@ -216,10 +216,12 @@ def _stratify(offs: np.ndarray, src: np.ndarray, dst: np.ndarray,
     """
     n_ranges = len(offs)
     consumers: list[set] = [set() for _ in range(n_ranges)]
-    if len(src):
-        src_rid = np.searchsorted(offs, src, side="right") - 1
-        dst_rid = np.searchsorted(offs, dst, side="right") - 1
-        for s, d in set(zip(src_rid.tolist(), dst_rid.tolist())):
+    if len(src_rid):
+        # dedup range pairs vectorized (millions of edges -> dozens of
+        # pairs) before touching Python objects
+        pairs = np.unique(src_rid.astype(np.int64) * n_ranges + dst_rid)
+        for p in pairs.tolist():
+            s, d = divmod(p, n_ranges)
             consumers[s].add(d)
     for p in programs:
         p_rid = _range_id(offs, p.dst_off)
@@ -409,6 +411,11 @@ class CompiledGraph:
                   if self.res_src is not None else len(self.src)),
             None if self.range_levels is None
             else tuple(self.range_levels.tolist()),
+            # the per-level merge windows (RunMeta.level_ranges) derive
+            # from the range offsets; pin them so signature-equal graphs
+            # cannot differ in any baked slice coordinate
+            None if self.range_offs is None
+            else tuple(self.range_offs.tolist()),
         )
 
     def _delta_pad(self) -> int:
@@ -1103,7 +1110,12 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     sizes = np.asarray(
         [type_sizes[t] for (t, _), _ in range_items], dtype=np.int64
     )
-    level_map, n_levels = _stratify(offs, src, dst, programs)
+    if n_edges:
+        dst_rid = np.searchsorted(offs, dst, side="right") - 1
+        src_rid = np.searchsorted(offs, src, side="right") - 1
+    else:
+        dst_rid = src_rid = np.empty(0, dtype=np.int64)
+    level_map, n_levels = _stratify(offs, src_rid, dst_rid, programs)
     range_levels = np.asarray(
         [level_map[r] for r in range(len(offs))], dtype=np.int32)
     for p in programs:
@@ -1113,8 +1125,6 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
     res_parts: list[np.ndarray] = []
     if n_edges:
         never_expires = exp == np.inf
-        dst_rid = np.searchsorted(offs, dst, side="right") - 1
-        src_rid = np.searchsorted(offs, src, side="right") - 1
         edge_level = range_levels[dst_rid]
         key = dst_rid * len(offs) + src_rid
         # expiring edges always ride the residual path (query-time clock)

@@ -14,6 +14,8 @@ Mirrors the reference's two modes (/root/reference/pkg/proxy/authn.go):
 
 from __future__ import annotations
 
+from typing import Optional
+
 from ..rules.input import UserInfo
 
 USER_HEADER = "X-Remote-User"
@@ -44,6 +46,39 @@ class HeaderAuthenticator:
         if not name:
             raise AuthenticationError(f"no {USER_HEADER} header present")
         return UserInfo(name=name, groups=groups, extra=extra)
+
+
+class TokenFileAuthenticator:
+    """kube's static token file (--token-auth-file): CSV rows of
+    ``token,user,uid[,"group1,group2"]`` (authn.go:40-47 wires the same
+    kube authenticator). Comparison is constant-time per row."""
+
+    def __init__(self, path: str):
+        import csv
+        import hmac as _hmac
+
+        self._hmac = _hmac
+        self._rows: list[tuple[str, UserInfo]] = []
+        with open(path, newline="") as f:
+            for row in csv.reader(f):
+                if not row or row[0].lstrip().startswith("#"):
+                    continue
+                if len(row) < 3:
+                    raise ValueError(
+                        f"token file {path!r}: rows need token,user,uid")
+                groups = [g.strip() for g in row[3].split(",")
+                          if g.strip()] if len(row) > 3 else []
+                self._rows.append((
+                    row[0],
+                    UserInfo(name=row[1], groups=groups,
+                             extra={"uid": [row[2]]})))
+
+    def authenticate_token(self, token: str) -> Optional[UserInfo]:
+        found = None
+        for tok, user in self._rows:  # constant-time, no early exit
+            if self._hmac.compare_digest(tok, token):
+                found = user
+        return found
 
 
 class ClientCertAuthenticator:

@@ -82,6 +82,8 @@ class Options:
     # CNs of cert-authenticated FRONT PROXIES allowed to assert end-user
     # identity via X-Remote-* headers (kube --requestheader-allowed-names)
     tls_requestheader_allowed_names: list = field(default_factory=list)
+    # kube static token file (token,user,uid[,groups]) for Bearer authn
+    token_auth_file: Optional[str] = None
     # dual-write
     workflow_database_path: str = DEFAULT_WORKFLOW_DB
     lock_mode: str = LOCK_MODE_PESSIMISTIC
@@ -278,6 +280,12 @@ class Options:
                 # health endpoints and get clean 401s on resources
                 # (kube-apiserver semantics) instead of handshake failures
                 ssl_context.verify_mode = ssl.CERT_OPTIONAL
+        token_authenticator = None
+        if self.token_auth_file:
+            from .authn import TokenFileAuthenticator
+
+            token_authenticator = TokenFileAuthenticator(
+                self.token_auth_file)
         server = Server(deps, HeaderAuthenticator(),
                         host=self.bind_host, port=self.bind_port,
                         config_dump=(self.debug_dump()
@@ -285,7 +293,8 @@ class Options:
                         ssl_context=ssl_context,
                         client_ca_configured=bool(self.tls_client_ca_file),
                         requestheader_allowed_names=tuple(
-                            self.tls_requestheader_allowed_names))
+                            self.tls_requestheader_allowed_names),
+                        token_authenticator=token_authenticator)
         return CompletedConfig(self, engine, workflow, deps, server)
 
     # fields safe to expose on /debug/config — an ALLOWLIST so a future
@@ -360,6 +369,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="cert CN allowed to assert user identity via "
                              "X-Remote-* headers (repeatable; front "
                              "proxies)")
+    parser.add_argument("--token-auth-file",
+                        help="kube static token file "
+                             "(token,user,uid[,\"g1,g2\"]) for Bearer "
+                             "authentication")
     parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
     parser.add_argument("--snapshot-path",
                         help="relationship-store snapshot file: loaded at "
@@ -406,6 +419,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         tls_key_file=args.tls_key_file,
         tls_client_ca_file=args.tls_client_ca_file,
         tls_requestheader_allowed_names=args.tls_requestheader_allowed_names,
+        token_auth_file=args.token_auth_file,
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,

@@ -46,10 +46,13 @@ class Server:
                  config_dump: Optional[dict] = None,
                  ssl_context=None,
                  client_ca_configured: bool = False,
-                 requestheader_allowed_names: tuple = ()):
+                 requestheader_allowed_names: tuple = (),
+                 token_authenticator=None):
         self.deps = deps
         self.authenticator = authenticator or HeaderAuthenticator()
         self.cert_authenticator = ClientCertAuthenticator()
+        # kube static-token-file authn (authn.go:40-47); None = disabled
+        self.token_authenticator = token_authenticator
         self.host = host
         self.port = port
         # sanitized options for /debug/config (the reference's debugmap
@@ -100,6 +103,18 @@ class Server:
         if req.request_info is None:
             req.request_info = parse_request_info(req.method, req.path,
                                                   req.query)
+        if req.user is None and self.token_authenticator is not None:
+            auth = next((v for k, v in req.headers.items()
+                         if k.lower() == "authorization"), "")
+            if auth.lower().startswith("bearer "):
+                user = self.token_authenticator.authenticate_token(
+                    auth[7:].strip())
+                if user is None:
+                    # credentials were presented and are wrong: reject
+                    # rather than falling through to weaker identities
+                    return kube_status(401, "invalid bearer token",
+                                       "Unauthorized")
+                req.user = user
         if req.user is None:
             try:
                 req.user = self.authenticator.authenticate(req.headers)

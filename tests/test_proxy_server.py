@@ -242,3 +242,82 @@ def test_options_validation(env):
     with pytest.raises(OptionsError, match="engine endpoint"):
         Options(rule_content=RULES, upstream_url="http://x",
                 engine_endpoint="grpc://remote:50051").validate()
+
+
+def test_token_file_authentication(env, tmp_path):
+    """kube static-token-file Bearer authn: valid tokens map to
+    user/groups, invalid tokens 401 without falling back to headers
+    (reference wires kube's token-file authenticator, authn.go:40-47)."""
+    tokens = tmp_path / "tokens.csv"
+    tokens.write_text(
+        "# comment line\n"
+        'tok-alice,alice,u1,"team-alpha,devs"\n'
+        "tok-bob,bob,u2\n")
+
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+            token_auth_file=str(tokens),
+        ).complete()
+        await cfg.run()
+
+        class TokenClient(HttpClient):
+            def __init__(self, port, token):
+                super().__init__(port, user="")
+                self.token = token
+
+            async def request(self, method, target, body=None, stream=False):
+                # replace the X-Remote-User header with a Bearer token
+                import json as _json
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", self.port)
+                data = _json.dumps(body).encode() if body is not None else b""
+                headers = [f"{method} {target} HTTP/1.1",
+                           f"Host: 127.0.0.1:{self.port}",
+                           f"Authorization: Bearer {self.token}",
+                           "Content-Type: application/json",
+                           f"Content-Length: {len(data)}",
+                           "Connection: close", "", ""]
+                writer.write("\r\n".join(headers).encode() + data)
+                await writer.drain()
+                status_line = await reader.readline()
+                status = int(status_line.split(b" ")[1])
+                hdrs = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    hdrs[k.strip().lower()] = v.strip()
+                n = int(hdrs.get("content-length", 0))
+                out = await reader.readexactly(n) if n else b""
+                writer.close()
+                return status, hdrs, out
+
+        alice = TokenClient(cfg.server.port, "tok-alice")
+        bob = TokenClient(cfg.server.port, "tok-bob")
+        wrong = TokenClient(cfg.server.port, "nope")
+
+        status, _, body = await alice.request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "tok-ns"}})
+        assert status == 201, body
+        status, _, body = await alice.request("GET", "/api/v1/namespaces")
+        assert [o["metadata"]["name"]
+                for o in json.loads(body)["items"]] == ["tok-ns"]
+        status, _, body = await bob.request("GET", "/api/v1/namespaces")
+        assert json.loads(body)["items"] == []
+        # invalid bearer: 401, not a fall-through to anonymous/headers
+        status, _, _ = await wrong.request("GET", "/api/v1/namespaces")
+        assert status == 401
+
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())

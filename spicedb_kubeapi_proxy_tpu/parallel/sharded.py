@@ -61,40 +61,47 @@ from ..ops.reachability import (
 )
 
 
-def _run_sharded(meta, block_meta, ng: int, blocks, src, dst, exp_rel,
+def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
                  dsrc, ddst, dexp, seeds, q_slots, now_rel, *,
                  max_iters: int):
     """Per-device body (inside shard_map). Shapes are the LOCAL shards:
-    blocks[i] [n_dst, n_src/ng]; src/dst/exp_rel [E/ng]; dsrc/ddst/dexp
+    level_edges[k] = (src, dst, exp) [E_k/ng] (per stratification level,
+    each chunk dst-sorted); blocks[i] [n_dst, n_src/ng]; dsrc/ddst/dexp
     [D/ng] (the incremental delta segment); seeds [B/nd, 2]; q_slots
     [B/nd, Q]. ``meta`` is a slim RunMeta (not the CompiledGraph — the
-    closure must not pin host/device graph state). State layout matches
-    the single-chip fixpoint: [B, rows, LANE], slot space on the lane
-    axis."""
+    closure must not pin host/device graph state).
+
+    Same stratified schedule as the single-chip _run: only the cyclic
+    core (level 0) iterates; each acyclic level is applied once, partial
+    propagations joined with pmax over ICI before the merge."""
     B = seeds.shape[0]
     rows = meta.M // LANE + 1  # + trash row
     Mp = rows * LANE
-    valid = (exp_rel > now_rel).astype(jnp.uint8)
     dvalid = (dexp > now_rel).astype(jnp.uint8)
     brange = jnp.arange(B, dtype=jnp.int32)
     base = _seed_base(meta, seeds)
+    baseflat = base.reshape(B, Mp)
     g_idx = jax.lax.axis_index("graph")
 
-    def step(V):
+    def prop_level(V, k):
         Vflat = V.reshape(B, Mp)
+        src, dst, exp_rel = level_edges[k]
+        valid = (exp_rel > now_rel).astype(jnp.uint8)
         gathered = (Vflat[:, src] & valid[None, :]).T  # [E_local, B]
-        # edges are dst-sorted globally, so each contiguous chunk is sorted
         prop = jax.ops.segment_max(
             gathered, dst, num_segments=Mp, indices_are_sorted=True
         ).T  # [B, Mp] — this chip's partial
-        # incremental delta segment: same gather/segment form, tiny
+        # incremental delta segment: applied at every phase; off-level
+        # contributions are dropped by the caller's range-scoped merge
         gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T
         prop = prop | jax.ops.segment_max(
             gathered_d, ddst, num_segments=Mp, indices_are_sorted=True
         ).T
-        # dense blocks: this chip contracts its src-axis chunk of A against
-        # the matching frontier columns; pmax below ORs the partials
+        # dense blocks of this level: this chip contracts its src-axis
+        # chunk of A against the matching frontier columns
         for bm, A in zip(block_meta, blocks):
+            if bm.level != k:
+                continue
             chunk = bm.n_src // ng
             frontier = jax.lax.dynamic_slice(
                 Vflat, (0, bm.src_off + g_idx * chunk), (B, chunk))
@@ -107,8 +114,14 @@ def _run_sharded(meta, block_meta, ng: int, blocks, src, dst, exp_rel,
             cur = jax.lax.dynamic_slice(prop, (0, bm.dst_off), (B, bm.n_dst))
             prop = jax.lax.dynamic_update_slice(
                 prop, cur | contrib, (0, bm.dst_off))
-        prop = jax.lax.pmax(prop, "graph")  # join partials over ICI
-        return _apply_program(meta, prop.reshape(B, rows, LANE) | base)
+        return jax.lax.pmax(prop, "graph")  # join partials over ICI
+
+    core_progs = [p for p in meta.programs if p.level == 0]
+
+    def step(V):
+        prop = prop_level(V, 0)
+        return _apply_program(
+            meta, prop.reshape(B, rows, LANE) | base, core_progs)
 
     def cond(state):
         _, prev_changed, it = state
@@ -125,6 +138,17 @@ def _run_sharded(meta, block_meta, ng: int, blocks, src, dst, exp_rel,
     V, still_changing, iters = jax.lax.while_loop(
         cond, body, (base, jnp.int32(1), 0)
     )
+    # acyclic levels: one application each (see ops/reachability._run)
+    for k in range(1, meta.n_levels + 1):
+        progs_k = [p for p in meta.programs if p.level == k]
+        prop = prop_level(V, k)
+        propb = prop | baseflat
+        Vflat = V.reshape(B, Mp)
+        for off, size in meta.level_ranges[k - 1]:
+            Vflat = jax.lax.dynamic_update_slice(
+                Vflat, jax.lax.dynamic_slice(propb, (0, off), (B, size)),
+                (0, off))
+        V = _apply_program(meta, Vflat.reshape(B, rows, LANE), progs_k)
     out = V.reshape(B, Mp)[brange[:, None], q_slots].astype(jnp.bool_)
     return out, (still_changing == 0), iters
 
@@ -184,23 +208,14 @@ class ShardedGraph:
         self._edge_sh = NamedSharding(mesh, P("graph"))
         self._block_sh = NamedSharding(mesh, P(None, "graph"))
 
-        b_src, b_dst, b_exp, kept = self._host_base_split()
-        E_pad = _next_bucket(max(len(b_src), 1))
-        if E_pad % self.ng:
-            # re-pad with trash edges so the graph axis divides evenly
-            E_pad = ((E_pad + self.ng - 1) // self.ng) * self.ng
-        src = np.full(E_pad, cg.M, dtype=np.int32)
-        dst = np.full(E_pad, cg.M, dtype=np.int32)
-        exp = np.full(E_pad, -np.inf, dtype=np.float32)
-        src[: len(b_src)] = b_src
-        dst[: len(b_dst)] = b_dst
-        exp[: len(b_exp)] = b_exp
-        # host copies for the incremental dead-pair search (dst-sorted)
-        self._h_src = src
-        self._h_dst = dst
-        self._src = jax.device_put(src, self._edge_sh)
-        self._dst = jax.device_put(dst, self._edge_sh)
-        self._exp = jax.device_put(exp, self._edge_sh)
+        level_arrays, kept = self._host_level_edges()
+        # host copies for the incremental dead-pair search (per level,
+        # each dst-sorted)
+        self._h_levels = level_arrays
+        self._level_edges = tuple(
+            tuple(jax.device_put(a, self._edge_sh) for a in triple)
+            for triple in level_arrays
+        )
         self._block_meta = tuple(kept)
         self._blocks = tuple(
             jax.device_put(self._block_matrix(bm), self._block_sh)
@@ -214,15 +229,19 @@ class ShardedGraph:
         # updated() generations: the slot layout is incremental-invariant)
         self._qgrid: dict = {}
 
-        fn = partial(_run_sharded, cg.run_meta(), self._block_meta, self.ng,
+        meta = cg.run_meta()
+        if meta.n_levels + 1 != len(self._level_edges):
+            raise AssertionError(
+                "level edge arrays out of step with stratification")
+        fn = partial(_run_sharded, meta, self._block_meta, self.ng,
                      max_iters=max_iters)
         self._run = jax.jit(
             shard_map(
                 fn,
                 mesh=mesh,
                 in_specs=(
+                    tuple((P("graph"),) * 3 for _ in self._level_edges),
                     tuple(P(None, "graph") for _ in kept),
-                    P("graph"), P("graph"), P("graph"),
                     P("graph"), P("graph"), P("graph"),
                     P("data", None), P("data", None), P(),
                 ),
@@ -239,16 +258,30 @@ class ShardedGraph:
         d = self.cg.dead_pairs
         return set(zip(d[:, 0].tolist(), d[:, 1].tolist()))
 
-    def _host_base_split(self):
-        """(src, dst, exp, kept_blocks): the base edge set this mesh will
-        gather over (base residual + folded-back blocks, dst-sorted; the
-        delta segment stays separate) and the dense blocks that stay on
-        the MXU path (src axis divisible by the graph-axis size)."""
+    def _pad_level(self, src, dst, exp):
+        """Pad one level's edges with trash rows so the graph axis
+        divides evenly (at least ng rows so every chip has a chunk)."""
+        n = max(len(src), 1)
+        n_pad = ((n + self.ng - 1) // self.ng) * self.ng
+        s = np.full(n_pad, self.cg.M, dtype=np.int32)
+        d = np.full(n_pad, self.cg.M, dtype=np.int32)
+        e = np.full(n_pad, -np.inf, dtype=np.float32)
+        s[: len(src)] = src
+        d[: len(dst)] = dst
+        e[: len(exp)] = exp
+        return s, d, e
+
+    def _host_level_edges(self):
+        """(level_arrays, kept_blocks): per stratification level 0..L, the
+        (src, dst, exp) edge arrays this mesh gathers over (base residual
+        slice + folded-back blocks of that level, dst-sorted, padded to
+        the graph axis) and the dense blocks that stay on the MXU path
+        (src axis divisible by the graph-axis size)."""
         cg = self.cg
         dead = self._dead_set()
         if cg.res_idx is None or cg.res_src is None:
-            # no dense split computed: whole edge set on the segment path,
-            # with dead pairs killed in place
+            # no dense split computed: whole edge set on the segment path
+            # as one core level, with dead pairs killed in place
             b_src = cg.src[: cg.n_edges].astype(np.int32, copy=False)
             b_dst = cg.dst[: cg.n_edges].astype(np.int32, copy=False)
             b_exp = cg.exp_rel[: cg.n_edges].astype(np.float32, copy=True)
@@ -259,31 +292,40 @@ class ShardedGraph:
                     if lo < hi:
                         hit = lo + np.flatnonzero(b_src[lo:hi] == s)
                         b_exp[hit] = -np.inf
-            return b_src, b_dst, b_exp, []
-        # base residual host arrays already carry incremental
-        # invalidations (res_exp -> -inf), so they fold in as-is
-        parts = [(cg.res_src, cg.res_dst, cg.res_exp)]
+            return [self._pad_level(b_src, b_dst, b_exp)], []
         kept, folded = [], []
         for bm in cg.blocks:
             if bm.n_src % self.ng == 0:
                 kept.append(bm)
             else:
                 folded.append(bm)
-        for bm in folded:
-            e_src = (bm.src_off + bm.src_local).astype(np.int32)
-            e_dst = (bm.dst_off + bm.dst_local).astype(np.int32)
-            keep = self._not_dead_mask(e_src, e_dst, dead)
-            parts.append((
-                e_src[keep], e_dst[keep],
-                np.full(int(keep.sum()), np.inf, dtype=np.float32)))
-        src = np.concatenate([p[0] for p in parts])
-        dst = np.concatenate([p[1] for p in parts])
-        exp = np.concatenate([p[2] for p in parts])
-        # ALWAYS re-sort: cg.res_* is ordered by (level, dst) for the
-        # stratified single-chip schedule, but the sharded fixpoint runs
-        # unstratified and needs each contiguous chunk dst-sorted
-        order = np.argsort(dst, kind="stable")
-        return src[order], dst[order], exp[order], kept
+        bounds = cg.res_level_bounds or (0, len(cg.res_src))
+        n_levels = cg.n_levels
+        out = []
+        for k in range(n_levels + 1):
+            # base residual slice for the level: already dst-sorted and
+            # carrying incremental invalidations (res_exp -> -inf); its
+            # trailing bucket padding is harmless trash
+            lo, hi = bounds[k], bounds[k + 1]
+            parts = [(cg.res_src[lo:hi], cg.res_dst[lo:hi],
+                      cg.res_exp[lo:hi])]
+            for bm in folded:
+                if bm.level != k:
+                    continue
+                e_src = (bm.src_off + bm.src_local).astype(np.int32)
+                e_dst = (bm.dst_off + bm.dst_local).astype(np.int32)
+                keep = self._not_dead_mask(e_src, e_dst, dead)
+                parts.append((
+                    e_src[keep], e_dst[keep],
+                    np.full(int(keep.sum()), np.inf, dtype=np.float32)))
+            src = np.concatenate([p[0] for p in parts])
+            dst = np.concatenate([p[1] for p in parts])
+            exp = np.concatenate([p[2] for p in parts])
+            if len(parts) > 1:  # merged folded edges: restore dst order
+                order = np.argsort(dst, kind="stable")
+                src, dst, exp = src[order], dst[order], exp[order]
+            out.append(self._pad_level(src, dst, exp))
+        return out, kept
 
     @staticmethod
     def _not_dead_mask(e_src, e_dst, dead):
@@ -338,24 +380,29 @@ class ShardedGraph:
         fresh = keys[~np.isin(keys, self._applied_dead)]
         if len(fresh):
             pairs = np.stack([fresh >> 32, fresh & ((1 << 32) - 1)], axis=1)
-            pos: list[int] = []
+            pos_per_level: dict[int, list] = {}
             block_cells: dict[int, list] = {}
             for s, t in pairs.tolist():
-                lo = int(np.searchsorted(self._h_dst, t, side="left"))
-                hi = int(np.searchsorted(self._h_dst, t, side="right"))
-                if lo < hi:
-                    pos.extend(
-                        (lo + np.flatnonzero(
-                            self._h_src[lo:hi] == s)).tolist())
+                for k, (h_src, h_dst, _) in enumerate(self._h_levels):
+                    lo = int(np.searchsorted(h_dst, t, side="left"))
+                    hi = int(np.searchsorted(h_dst, t, side="right"))
+                    if lo < hi:
+                        pos_per_level.setdefault(k, []).extend(
+                            (lo + np.flatnonzero(
+                                h_src[lo:hi] == s)).tolist())
                 for i, bm in enumerate(self._block_meta):
                     if (bm.dst_off <= t < bm.dst_off + bm.n_dst
                             and bm.src_off <= s < bm.src_off + bm.n_src):
                         block_cells.setdefault(i, []).append(
                             (t - bm.dst_off, s - bm.src_off))
-            if pos:
-                new._exp = jax.device_put(
-                    self._exp.at[np.asarray(pos, dtype=np.int64)]
-                    .set(-np.inf), self._edge_sh)
+            if pos_per_level:
+                levels = list(self._level_edges)
+                for k, pos in pos_per_level.items():
+                    s_dev, d_dev, e_dev = levels[k]
+                    levels[k] = (s_dev, d_dev, jax.device_put(
+                        e_dev.at[np.asarray(pos, dtype=np.int64)]
+                        .set(-np.inf), self._edge_sh))
+                new._level_edges = tuple(levels)
             if block_cells:
                 blocks = list(self._blocks)
                 for i, cells in block_cells.items():
@@ -376,7 +423,7 @@ class ShardedGraph:
             (time.time() if now is None else now) - self.cg.base_time
         )
         out, converged, iters = self._run(
-            self._blocks, self._src, self._dst, self._exp,
+            self._level_edges, self._blocks,
             self._dsrc, self._ddst, self._dexp,
             jnp.asarray(seeds_pad), jnp.asarray(grid), now_rel,
         )

@@ -69,14 +69,16 @@ class TokenFileAuthenticator:
                 groups = [g.strip() for g in row[3].split(",")
                           if g.strip()] if len(row) > 3 else []
                 self._rows.append((
-                    row[0],
-                    UserInfo(name=row[1], groups=groups,
-                             extra={"uid": [row[2]]})))
+                    row[0].encode(),
+                    UserInfo(name=row[1], uid=row[2], groups=groups)))
 
     def authenticate_token(self, token: str) -> Optional[UserInfo]:
+        # compare BYTES: str compare_digest raises on non-ASCII input,
+        # which an anonymous client could trigger at will (500 not 401)
+        presented = token.encode("utf-8", "surrogateescape")
         found = None
         for tok, user in self._rows:  # constant-time, no early exit
-            if self._hmac.compare_digest(tok, token):
+            if self._hmac.compare_digest(tok, presented):
                 found = user
         return found
 

@@ -316,6 +316,19 @@ def test_token_file_authentication(env, tmp_path):
         # invalid bearer: 401, not a fall-through to anonymous/headers
         status, _, _ = await wrong.request("GET", "/api/v1/namespaces")
         assert status == 401
+        # non-ASCII bearer: still a clean 401, never a 500
+        weird = TokenClient(cfg.server.port, "caf\xe9")
+        status, _, _ = await weird.request("GET", "/api/v1/namespaces")
+        assert status == 401
+        # the uid column reaches the first-class UserInfo field rules
+        # template on ({{user.uid}})
+        from spicedb_kubeapi_proxy_tpu.proxy.authn import (
+            TokenFileAuthenticator,
+        )
+        u = TokenFileAuthenticator(str(tokens)).authenticate_token(
+            "tok-alice")
+        assert (u.name, u.uid, u.groups) == (
+            "alice", "u1", ["team-alpha", "devs"])
 
         await cfg.server.stop()
         await cfg.workflow.shutdown()

@@ -43,3 +43,8 @@ lint:
 clean:
 	rm -f spicedb_kubeapi_proxy_tpu/native/libgraphcore.so
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+# flake hunting: loop the suite until it fails (reference
+# `mage test:e2eUntilItFails`)
+test-until-it-fails:
+	while $(PY) -m pytest tests/ -q; do echo "=== pass, again ==="; done

@@ -590,6 +590,12 @@ class CompiledGraph:
             qb[:Q] = q_batch
             qs_dev, qb_dev = jnp.asarray(qs), jnp.asarray(qb)
             if q_cache_key:
+                # bounded: each entry pins megabytes of device arrays;
+                # evict the oldest rather than grow with key cardinality
+                q_keys = [k for k in d if isinstance(k, tuple)
+                          and k and k[0] == "q"]
+                if len(q_keys) >= 32:
+                    d.pop(q_keys[0], None)
                 d[("q", q_cache_key)] = (qs_dev, qb_dev)
         now_rel = np.float32((time.time() if now is None else now) - self.base_time)
         # named span in jax.profiler traces (bench --profile-dir / any

@@ -505,6 +505,9 @@ class ShardedGraph:
             grid_np[q_batch, cols] = q_slots
             grid = jnp.asarray(grid_np)
             if q_cache_key:
+                # bounded: grids pin device memory per distinct key
+                if len(self._qgrid) >= 32:
+                    self._qgrid.pop(next(iter(self._qgrid)), None)
                 self._qgrid[(q_cache_key, B_pad)] = grid
         out, converged, iters = self._dispatch(seeds, grid, now)
         return ShardedQueryFuture(out, converged, iters, (q_batch, cols),

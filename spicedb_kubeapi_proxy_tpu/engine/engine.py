@@ -72,16 +72,25 @@ def mask_pseudo_objects(mask: np.ndarray) -> np.ndarray:
 class EngineFuture:
     """A dispatched engine query: ``result()`` blocks and post-processes.
     ``fut`` is a :class:`~...ops.reachability.QueryFuture` or ``None`` for
-    trivially-resolved queries."""
+    trivially-resolved queries; multi-dispatch paths (chunked bulk checks)
+    pass ``fut=None`` plus an ``iters`` callable joining their futures."""
 
-    __slots__ = ("_fut", "_fin")
+    __slots__ = ("_fut", "_fin", "_iters")
 
-    def __init__(self, fut, fin):
+    def __init__(self, fut, fin, iters=None):
         self._fut = fut
         self._fin = fin
+        self._iters = iters
 
     def result(self):
         return self._fin(None if self._fut is None else self._fut.result())
+
+    def iterations(self) -> int:
+        """Fixpoint hops the query ran (dispatch-depth analog); valid
+        after ``result()``."""
+        if self._iters is not None:
+            return self._iters()
+        return 0 if self._fut is None else self._fut.iterations()
 
 
 class Engine:
@@ -290,19 +299,54 @@ class Engine:
                 self._sharded = sg
             return sg
 
-    def check_bulk_async(self, items: list[CheckItem],
-                         now: Optional[float] = None) -> "EngineFuture":
-        """Dispatch a bulk check without blocking (device→host readback
-        overlaps with other in-flight queries); ``.result()`` to wait."""
-        if not items:
-            return EngineFuture(None, lambda _: [])
-        cg = self.compiled()
-        objs = self._objects_by_name()
+    # bulk checks dispatch in chunks this size so host encode of the next
+    # chunk overlaps device execution of the previous one
+    CHECK_PIPELINE_CHUNK = 16384
+
+    def _encode_checks(self, cg, objs, items):
+        """Single-pass check-batch encode with per-(type, permission) and
+        per-type caches inlined, instead of two encode_* calls per item —
+        the two calls' attribute/dict traffic was over half the bulk-check
+        wall time at 65k items on a TPU chip (106ms of 176ms). Semantics
+        identical to ``encode_target`` / ``encode_subject``; the columnar
+        numpy alternative measured SLOWER (string-array materialization
+        dominates), so this stays a lean Python loop."""
+        from ..ops.reachability import VOID_IDX
+
+        n = len(items)
+        M = cg.M
+        offset_of = cg.offset_of
+        type_sizes = cg.type_sizes
+        q_slots = np.empty(n, dtype=np.int32)
+        q_batch = np.empty(n, dtype=np.int32)
+        tp_off: dict[tuple, int] = {}  # (type, permission) -> offset | -1
+        ti: dict[str, tuple] = {}  # type -> (id map | None, type size)
         subjects: dict[tuple, int] = {}
         seed_rows: list[tuple[int, int]] = []
-        q_slots = np.empty(len(items), dtype=np.int32)
-        q_batch = np.empty(len(items), dtype=np.int32)
         for i, it in enumerate(items):
+            t = it.resource_type
+            key = (t, it.permission)
+            off = tp_off.get(key)
+            if off is None:
+                o = offset_of(t, it.permission)
+                off = -1 if o is None else o
+                tp_off[key] = off
+            if off < 0:
+                q_slots[i] = M
+            else:
+                ent = ti.get(t)
+                if ent is None:
+                    interner = objs.get(t)
+                    ent = (interner.id_map() if interner is not None
+                           else None, type_sizes.get(t, 0))
+                    ti[t] = ent
+                to_id, size = ent
+                if to_id is None:
+                    q_slots[i] = off + VOID_IDX
+                else:
+                    oi = to_id.get(it.resource_id)
+                    q_slots[i] = off + (
+                        oi if oi is not None and oi < size else VOID_IDX)
             skey = (it.subject_type, it.subject_id, it.subject_relation)
             row = subjects.get(skey)
             if row is None:
@@ -312,22 +356,50 @@ class Engine:
                     cg.encode_subject(it.subject_type, it.subject_id,
                                       it.subject_relation, objs)
                 )
-            q_slots[i] = cg.encode_target(it.resource_type, it.permission,
-                                          it.resource_id, objs)
             q_batch[i] = row
-        seeds = np.asarray(seed_rows, dtype=np.int32)
-        t0 = time.perf_counter()
-        fut = self._backend(cg).query_async(seeds, q_slots, q_batch, now=now)
-        metrics.counter("engine_checks_total").inc(len(items))
+        return np.asarray(seed_rows, dtype=np.int32), q_slots, q_batch
 
-        def fin(out):
+    def check_bulk_async(self, items: list[CheckItem],
+                         now: Optional[float] = None) -> "EngineFuture":
+        """Dispatch a bulk check without blocking (device→host readback
+        overlaps with other in-flight queries); ``.result()`` to wait."""
+        if not items:
+            return EngineFuture(None, lambda _: [])
+        cg = self.compiled()
+        objs = self._objects_by_name()
+        t0 = time.perf_counter()
+        backend = self._backend(cg)
+        n = len(items)
+        chunk = self.CHECK_PIPELINE_CHUNK
+        if now is None:
+            # one clock for the whole bulk call: every chunk's expiration
+            # mask must see the same instant (one CheckBulkPermissions =
+            # one consistency snapshot, reference check.go:41-48)
+            now = time.time()
+        # chunked pipeline: dispatches are async, so encoding chunk k+1 on
+        # the host overlaps chunk k's device execution and readback —
+        # wall ≈ one_chunk_encode + transport + device, not encode + both
+        futs = []
+        for s in range(0, n, chunk):
+            seeds, q_slots, q_batch = self._encode_checks(
+                cg, objs, items[s:s + chunk])
+            futs.append(backend.query_async(seeds, q_slots, q_batch, now=now))
+        metrics.counter("engine_checks_total").inc(n)
+
+        def iters():
+            return max(f.iterations() for f in futs)
+
+        def fin(_):
+            out = [bool(x) for f in futs for x in f.result()]
+            # engine_check_seconds covers the WHOLE bulk call including
+            # host-side encode (what a caller experiences), not just
+            # dispatch+device+readback as before the chunked pipeline
             metrics.histogram("engine_check_seconds").observe(
                 time.perf_counter() - t0)
-            metrics.histogram("engine_fixpoint_iterations").observe(
-                fut.iterations())
-            return [bool(x) for x in out]
+            metrics.histogram("engine_fixpoint_iterations").observe(iters())
+            return out
 
-        return EngineFuture(fut, fin)
+        return EngineFuture(None, fin, iters=iters)
 
     def lookup_resources(self, resource_type: str, permission: str,
                          subject_type: str, subject_id: str,

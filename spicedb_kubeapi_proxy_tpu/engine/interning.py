@@ -106,5 +106,10 @@ class Interner:
             out[k] = i
         return out
 
+    def id_map(self) -> dict:
+        """The live string→id dict, for hot loops that inline lookups
+        (engine check-batch encode). Callers must treat it as read-only."""
+        return self._to_id
+
     def strings(self) -> list[str]:
         return list(self._to_str)

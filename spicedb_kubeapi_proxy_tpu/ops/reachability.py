@@ -161,10 +161,18 @@ class _BlockMeta:
     # stratification level of the dst range (0 = iterated core; k>=1 =
     # applied once at phase k — see _stratify)
     level: int = 0
+    # True when dst_local/src_local hold the REFLEXIVE-TRANSITIVE CLOSURE
+    # of a self-pair (src range == dst range) instead of its base edges:
+    # one application then yields every multi-hop value, so the range
+    # peels out of the iterated core (see _stratify's ignore_self). The
+    # diagonal keeps already-merged values alive across the replacing
+    # per-level merge. Derived cells cannot be deleted individually —
+    # incremental deletes touching a closured block force a recompile.
+    closured: bool = False
 
     def slim(self) -> "_BlockMeta":
         return _BlockMeta(self.dst_off, self.n_dst, self.src_off,
-                          self.n_src, None, None, self.level)
+                          self.n_src, None, None, self.level, self.closured)
 
 
 # dense-block eligibility: a block must carry enough edges to beat the
@@ -199,8 +207,54 @@ def _range_id(offs: np.ndarray, slot) -> int:
     return int(np.searchsorted(offs, slot, side="right")) - 1
 
 
+# self-pair closures larger than this many pairs fall back to the plain
+# iterated-core block (the closure of a dense DAG can approach n^2 pairs;
+# the dense matrix tolerates that, but host join memory should stay bounded)
+CLOSURE_MAX_PAIRS = 1 << 24
+
+
+def _closure_pairs(dst_local: np.ndarray, src_local: np.ndarray,
+                   n: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Reflexive-transitive closure of an n-node COO self-block (edge
+    ``src -> dst`` flows the src slot's value to the dst slot). Returns
+    (dst_local, src_local) int32 arrays INCLUDING the diagonal, or None
+    when the closure exceeds CLOSURE_MAX_PAIRS. Sparse semi-join on the
+    host: group graphs are shallow and narrow, so this is microseconds
+    where a dense matrix power would stream gigabytes. Handles instance
+    cycles (recursive groups) — the pair-set union converges regardless."""
+    base_order = np.argsort(src_local, kind="stable")
+    b_src = src_local[base_order].astype(np.int64)
+    b_dst = dst_local[base_order].astype(np.int64)
+    cur = np.unique(src_local.astype(np.int64) * n + dst_local)
+    while True:
+        cs, cd = cur // n, cur % n
+        # compose: (s -> d) ∘ (d -> d2) gives (s -> d2)
+        lo = np.searchsorted(b_src, cd, side="left")
+        hi = np.searchsorted(b_src, cd, side="right")
+        counts = hi - lo
+        total = int(counts.sum())
+        if total:
+            starts = np.repeat(lo, counts)
+            offsets = np.arange(total) - np.repeat(
+                np.cumsum(counts) - counts, counts)
+            new_pairs = (np.repeat(cs, counts) * n
+                         + b_dst[starts + offsets])
+            merged = np.unique(np.concatenate([cur, new_pairs]))
+        else:
+            merged = cur
+        if len(merged) > CLOSURE_MAX_PAIRS:
+            return None
+        if len(merged) == len(cur):
+            break
+        cur = merged
+    diag = np.arange(n, dtype=np.int64)
+    cur = np.unique(np.concatenate([cur, diag * n + diag]))
+    return (cur % n).astype(np.int32), (cur // n).astype(np.int32)
+
+
 def _stratify(offs: np.ndarray, src_rid: np.ndarray, dst_rid: np.ndarray,
-              programs: list) -> tuple[dict, int]:
+              programs: list, ignore_self: frozenset = frozenset(),
+              ) -> tuple[dict, int]:
     """Range-level stratification of the dependency graph.
 
     Build the range-granularity dependency graph (edges: src range feeds
@@ -216,6 +270,10 @@ def _stratify(offs: np.ndarray, src_rid: np.ndarray, dst_rid: np.ndarray,
     ranges (per-pod relations) are acyclic sinks — iterating them with
     the core multiplies the dominant per-hop HBM traffic by the graph
     diameter for nothing. Returns ({range_id: level}, n_levels).
+
+    ``ignore_self``: range ids whose self-dependency (r -> r edges) is
+    satisfied by a closured dense block (one application = all hops), so
+    the self-edge must not force the range into the core.
     """
     n_ranges = len(offs)
     consumers: list[set] = [set() for _ in range(n_ranges)]
@@ -225,6 +283,8 @@ def _stratify(offs: np.ndarray, src_rid: np.ndarray, dst_rid: np.ndarray,
         pairs = np.unique(src_rid.astype(np.int64) * n_ranges + dst_rid)
         for p in pairs.tolist():
             s, d = divmod(p, n_ranges)
+            if s == d and s in ignore_self:
+                continue
             consumers[s].add(d)
     for p in programs:
         p_rid = _range_id(offs, p.dst_off)
@@ -396,7 +456,8 @@ class CompiledGraph:
             tuple((p.dst_off, p.size, p.level,
                    expr_sig(p.expr, p.leaf_off))
                   for p in self.programs),
-            tuple((b.dst_off, b.n_dst, b.src_off, b.n_src, b.level)
+            tuple((b.dst_off, b.n_dst, b.src_off, b.n_src, b.level,
+                   b.closured)
                   for b in self.blocks),
             # padded delta-segment length (grows by buckets under
             # incremental updates; each growth re-specializes once). The
@@ -440,10 +501,16 @@ class CompiledGraph:
             offs = self.range_offs
             ends = np.append(offs[1:], self.M)
             for k in range(1, self.n_levels + 1):
-                level_ranges.append(tuple(
+                wins = [
                     (int(offs[rid]), int(ends[rid]) - int(offs[rid]))
                     for rid in np.flatnonzero(
-                        self.range_levels == k).tolist()))
+                        self.range_levels == k).tolist()]
+                # even phases merge exactly the closured blocks' ranges
+                # (their in-edges merged at the odd phase just before;
+                # the closure application finalizes them here)
+                wins += [(b.dst_off, b.n_dst) for b in self.blocks
+                         if b.closured and b.level == k]
+                level_ranges.append(tuple(wins))
         return RunMeta(
             M=self.M,
             programs=tuple(self.programs),
@@ -1124,22 +1191,28 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         src_rid = np.searchsorted(offs, src, side="right") - 1
     else:
         dst_rid = src_rid = np.empty(0, dtype=np.int64)
-    level_map, n_levels = _stratify(offs, src_rid, dst_rid, programs)
-    range_levels = np.asarray(
-        [level_map[r] for r in range(len(offs))], dtype=np.int32)
-    for p in programs:
-        p.level = int(range_levels[_range_id(offs, p.dst_off)])
 
-    blocks: list[_BlockMeta] = []
+    # Dense-pair decisions come BEFORE stratification: a dense SELF-pair
+    # (recursive relation like `group#member: group#member`) with no
+    # expiring edges gets its block replaced by the reflexive-transitive
+    # closure, which satisfies the self-dependency in ONE application —
+    # so _stratify may peel the range instead of iterating it with the
+    # core. Nested-group workloads (BASELINE config 3) then converge
+    # without core iterations at all.
+    dense_sel: dict[int, np.ndarray] = {}  # pair key -> edge indices
     res_parts: list[np.ndarray] = []
+    closure_rids: set[int] = set()
+    closure_coo: dict[int, tuple] = {}  # self range id -> closured COO
     if n_edges:
         never_expires = exp == np.inf
-        edge_level = range_levels[dst_rid]
         key = dst_rid * len(offs) + src_rid
         # expiring edges always ride the residual path (query-time clock)
         key = np.where(never_expires, key, -1)
         uniq, inv, counts = np.unique(key, return_inverse=True,
                                       return_counts=True)
+        expiring_pairs = (set(np.unique(
+            dst_rid[~never_expires] * len(offs) + src_rid[~never_expires]
+        ).tolist()) if not never_expires.all() else set())
         for ui, (k, cnt) in enumerate(zip(uniq.tolist(), counts.tolist())):
             sel = np.flatnonzero(inv == ui)
             if k < 0:
@@ -1153,13 +1226,57 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
                         and cnt / cells < DENSE_MIN_DENSITY)):
                 res_parts.append(sel)
                 continue
-            blocks.append(_BlockMeta(
-                dst_off=int(offs[d_rid]), n_dst=n_dst,
-                src_off=int(offs[s_rid]), n_src=n_src,
-                dst_local=(dst[sel] - offs[d_rid]).astype(np.int32),
-                src_local=(src[sel] - offs[s_rid]).astype(np.int32),
-                level=int(range_levels[d_rid]),
-            ))
+            dense_sel[k] = sel
+            if d_rid == s_rid and k not in expiring_pairs:
+                coo = _closure_pairs(
+                    (dst[sel] - offs[d_rid]).astype(np.int32),
+                    (src[sel] - offs[s_rid]).astype(np.int32), n_dst)
+                if coo is not None:
+                    closure_rids.add(d_rid)
+                    closure_coo[d_rid] = coo
+
+    level_map, n_levels = _stratify(offs, src_rid, dst_rid, programs,
+                                    ignore_self=frozenset(closure_rids))
+    if closure_rids:
+        # Levels are DOUBLED so a peeled closured range gets two ordered
+        # phases at its position in the topo order: odd phase 2k-1
+        # applies the range's in-edges (+ normal blocks + programs) and
+        # merges; even phase 2k applies only closure blocks, whose
+        # diagonal re-gathers the freshly merged values and whose closure
+        # cells complete every multi-hop chain. Without closured blocks
+        # the schedule keeps its original single phase per level.
+        range_levels = np.asarray(
+            [0 if level_map[r] == 0 else 2 * level_map[r] - 1
+             for r in range(len(offs))], dtype=np.int32)
+        n_levels *= 2
+    else:
+        range_levels = np.asarray(
+            [level_map[r] for r in range(len(offs))], dtype=np.int32)
+    for p in programs:
+        p.level = int(range_levels[_range_id(offs, p.dst_off)])
+
+    blocks: list[_BlockMeta] = []
+    if n_edges:
+        edge_level = range_levels[dst_rid]
+        for k, sel in dense_sel.items():
+            d_rid, s_rid = divmod(k, len(offs))
+            lvl = int(range_levels[d_rid])
+            if d_rid == s_rid and d_rid in closure_rids:
+                dl, sl = closure_coo[d_rid]
+                blocks.append(_BlockMeta(
+                    dst_off=int(offs[d_rid]), n_dst=int(sizes[d_rid]),
+                    src_off=int(offs[s_rid]), n_src=int(sizes[s_rid]),
+                    dst_local=dl, src_local=sl,
+                    level=lvl + 1 if lvl else 0, closured=True,
+                ))
+            else:
+                blocks.append(_BlockMeta(
+                    dst_off=int(offs[d_rid]), n_dst=int(sizes[d_rid]),
+                    src_off=int(offs[s_rid]), n_src=int(sizes[s_rid]),
+                    dst_local=(dst[sel] - offs[d_rid]).astype(np.int32),
+                    src_local=(src[sel] - offs[s_rid]).astype(np.int32),
+                    level=lvl,
+                ))
     res_idx = (np.sort(np.concatenate(res_parts)) if res_parts
                else np.empty(0, dtype=np.int64))
 
@@ -1368,6 +1485,20 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
             b = _pair_block(cg, src, dst)
             if b is not None:
                 bm = cg.blocks[b]
+                if bm.closured and (
+                        is_delete or relationship.expiration is not None):
+                    # closure cells are DERIVED reachability, not base
+                    # edges: clearing one cell would leave multi-hop
+                    # products of the deleted edge alive (over-allow).
+                    # Deletes — and touches that attach an expiration,
+                    # whose multi-hop products would outlive the
+                    # expiration — re-close via a full recompile.
+                    # (Non-expiring touches are safe: the cleared direct
+                    # cell is re-derived by the delta edge — in the core
+                    # every iteration, at peeled levels the same-level
+                    # add already forced a recompile via
+                    # _level_order_ok.)
+                    return None
                 block_cells.setdefault(b, {})[
                     (dst - bm.dst_off, src - bm.src_off)] = 0
             for p in _res_positions(cg, src, dst):

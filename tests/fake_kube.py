@@ -177,6 +177,20 @@ class FakeKube:
             stream=frames(),
         )
 
+    def emit_watch_event(self, res: str, event_type: str, name: str,
+                         ns: str = "") -> None:
+        """Emit a synthetic watch event for an (existing or ad-hoc) object
+        — lets tests inject upstream events without a write round trip."""
+        obj = self.objects.get((res, ns, name))
+        if obj is None:
+            obj = {"kind": _kind_for(res), "metadata": {"name": name}}
+            if ns:
+                obj["metadata"]["namespace"] = ns
+        obj = json.loads(json.dumps(obj))  # private copy
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        self._notify(res, ns, {"type": event_type, "object": obj})
+
     def stop_watches(self):
         for _, _, q in self._watchers:
             q.put_nowait(None)

@@ -423,6 +423,54 @@ def test_watch_namespaced_resource_keys_frames_by_prefilter():
     run(go())
 
 
+def test_watch_drops_frames_after_revocation_mid_stream():
+    """Reference proxy_test.go:905-943: once a subject's permission on an
+    object is revoked, subsequent watch events for that object are dropped
+    from the stream (and other objects keep flowing)."""
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+        from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+
+        env = Env()
+        await env.create_ns("mine", user="alice")
+        resp = await env.request("GET", "/api/v1/namespaces", user="alice",
+                                 query={"watch": ["true"]})
+        frames = []
+
+        async def consume():
+            async for f in resp.stream:
+                frames.append(json.loads(f))
+
+        task = asyncio.ensure_future(consume())
+        # alice owns "mine": the ADDED frame flows through
+        await asyncio.wait_for(_wait_for(lambda: len(frames) >= 1), timeout=5)
+        assert frames[0]["object"]["metadata"]["name"] == "mine"
+        # revoke alice's ownership, then emit a MODIFIED event upstream
+        env.engine.write_relationships([WriteOp("delete", parse_relationship(
+            "namespace:mine#creator@user:alice"))])
+        await asyncio.sleep(0.05)  # let the revocation reach the tracker
+        env.kube.emit_watch_event("namespaces", "MODIFIED", "mine")
+        # and a fresh grant on another namespace must still flow
+        await env.create_ns("other", user="bob")
+        env.engine.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:other#viewer@user:alice"))])
+        await asyncio.wait_for(
+            _wait_for(lambda: any(
+                f["object"]["metadata"]["name"] == "other" for f in frames)),
+            timeout=5)
+        names = [f["object"]["metadata"]["name"] for f in frames]
+        # the post-revocation MODIFIED frame for "mine" was dropped
+        assert names.count("mine") == 1, names
+        task.cancel()
+        env.kube.stop_watches()
+    run(go())
+
+
+async def _wait_for(pred, interval=0.02):
+    while not pred():
+        await asyncio.sleep(interval)
+
+
 def test_multiple_update_rules_rejected():
     async def go():
         dup = RULES + "\n---\n" + RULES.split("---")[0]  # duplicate create rule

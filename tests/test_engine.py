@@ -516,6 +516,193 @@ def test_dense_block_path_matches_oracle(monkeypatch):
     )
 
 
+def test_closure_pairs_helper():
+    """Reflexive-transitive closure of a COO self-block: chains complete,
+    cycles converge, the diagonal is always present, and oversized
+    closures bail to None."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    # chain 0 -> 1 -> 2 (edge src->dst flows src's value to dst)
+    dl, sl = R._closure_pairs(np.array([1, 2], dtype=np.int32),
+                              np.array([0, 1], dtype=np.int32), 4)
+    pairs = set(zip(sl.tolist(), dl.tolist()))
+    assert {(0, 1), (1, 2), (0, 2)} <= pairs  # incl. the composed hop
+    assert {(i, i) for i in range(4)} <= pairs  # diagonal
+    # 2-cycle converges, reaching each other and themselves
+    dl, sl = R._closure_pairs(np.array([1, 0], dtype=np.int32),
+                              np.array([0, 1], dtype=np.int32), 2)
+    assert set(zip(sl.tolist(), dl.tolist())) == {
+        (0, 1), (1, 0), (0, 0), (1, 1)}
+    # cap: a complete bipartite-ish blowup past the limit returns None
+    old = R.CLOSURE_MAX_PAIRS
+    try:
+        R.CLOSURE_MAX_PAIRS = 4
+        big_d = np.arange(1, 9, dtype=np.int32)
+        big_s = np.zeros(8, dtype=np.int32)
+        assert R._closure_pairs(big_d, big_s, 16) is None
+    finally:
+        R.CLOSURE_MAX_PAIRS = old
+
+
+NESTED_GROUP_SCHEMA = """
+definition user {}
+definition group { relation member: user | group#member }
+definition namespace {
+  relation viewer: group#member
+  permission view = viewer
+}
+"""
+
+
+def _nested_group_engine(depth: int = 6, fan: int = 3) -> Engine:
+    """A strictly layered group tree: users in leaf groups, each layer a
+    member of the next, namespaces viewing the root groups."""
+    e = Engine(schema=parse_schema(NESTED_GROUP_SCHEMA))
+    ops = []
+    for g in range(fan):
+        ops.append(f"group:l0-{g}#member@user:u{g}")
+        for d in range(1, depth):
+            ops.append(f"group:l{d}-{g}#member@group:l{d - 1}-{g}#member")
+        ops.append(f"namespace:ns{g}#viewer@group:l{depth - 1}-{g}#member")
+    e.write_relationships(touch(*ops))
+    return e
+
+
+def test_closured_self_block_peels_nested_groups(monkeypatch):
+    """With the group#member self-pair densified, its block holds the
+    closure and the range PEELS: deep nested-group membership resolves
+    without core iterations (BASELINE config 3's shape)."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    e = _nested_group_engine()
+    cg = e.compiled()
+    closured = [b for b in cg.blocks if b.closured]
+    assert closured, "group#member self-pair must be closured"
+    assert all(b.level % 2 == 0 or b.level == 0 for b in closured)
+    assert_engine_matches_oracle(e)
+    # u0 sees ns0 through 6 membership hops in ONE core iteration (the
+    # convergence probe): closure + peel, no per-hop iteration
+    fut = e.check_bulk_async(
+        [CheckItem("namespace", "ns0", "view", "user", "u0"),
+         CheckItem("namespace", "ns1", "view", "user", "u0")])
+    assert fut.result() == [True, False]
+    assert fut.iterations() <= 1
+
+
+def test_closured_block_recursive_group_cycle(monkeypatch):
+    """Instance CYCLES inside the closured self-pair (mutually recursive
+    groups) stay correct — closure covers them without iteration."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    e = Engine(schema=parse_schema(NESTED_GROUP_SCHEMA))
+    e.write_relationships(touch(
+        "group:a#member@user:alice",
+        "group:a#member@group:b#member",
+        "group:b#member@group:a#member",  # a <-> b cycle
+        "group:c#member@group:b#member",
+        "namespace:ns#viewer@group:c#member",
+    ))
+    cg = e.compiled()
+    assert any(b.closured for b in cg.blocks)
+    assert_engine_matches_oracle(e)
+    assert e.check_bulk([
+        CheckItem("namespace", "ns", "view", "user", "alice")]) == [True]
+
+
+def test_closured_block_write_paths(monkeypatch):
+    """Writes against a closured self-pair stay fully consistent: adds
+    and deletes of member edges are visible on the next read (closure
+    cells are derived, so deletes force the re-closing recompile)."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    e = _nested_group_engine(depth=4)
+    assert any(b.closured for b in e.compiled().blocks)
+    chk = lambda u, ns: e.check_bulk(  # noqa: E731
+        [CheckItem("namespace", ns, "view", "user", u)])[0]
+    assert chk("u1", "ns1")
+    # delete a mid-chain membership edge: the chain must break
+    e.write_relationships([WriteOp("delete", rel(
+        "group:l2-1#member@group:l1-1#member"))])
+    assert not chk("u1", "ns1")
+    # re-add it: the chain must re-form
+    e.write_relationships(touch("group:l2-1#member@group:l1-1#member"))
+    assert chk("u1", "ns1")
+    assert_engine_matches_oracle(e)
+
+
+def test_closured_core_block_expiring_touch_recompiles(monkeypatch):
+    """Review regression: a TOUCH attaching an expiration to an edge of a
+    CORE-level closured block (self-pair kept in the core by a
+    cross-range cycle, so _level_order_ok passes) must force a recompile
+    — otherwise multi-hop closure cells derived through the edge outlive
+    its expiration (permanent over-allow)."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    e = Engine(schema=parse_schema("""
+use expiration
+
+definition user {}
+definition team { relation member: group#member }
+definition group { relation member: user | group#member with expiration | team#member }
+definition namespace {
+  relation viewer: group#member
+  permission view = viewer
+}
+"""))
+    now = time.time()
+    e.write_relationships(touch(
+        "group:a#member@user:alice",
+        "group:b#member@group:a#member",
+        "group:c#member@group:b#member",
+        # cross-range cycle keeps group#member in the iterated core
+        "team:t#member@group:c#member",
+        "group:d#member@team:t#member",
+        "namespace:ns#viewer@group:c#member",
+    ))
+    cg = e.compiled()
+    core_closured = [b for b in cg.blocks if b.closured and b.level == 0]
+    assert core_closured, "self-pair must be closured inside the core"
+    item = CheckItem("namespace", "ns", "view", "user", "alice")
+    assert e.check_bulk([item], now=now) == [True]
+    # touch the mid-chain edge with an expiration 50s out
+    e.write_relationships([WriteOp("touch", Relationship(
+        "group", "b", "member", "group", "a",
+        subject_relation="member", expiration=now + 50))])
+    assert e.check_bulk([item], now=now + 10) == [True]  # still valid
+    assert e.check_bulk([item], now=now + 100) == [False]  # expired
+
+
+def test_closured_block_sharded_parity(monkeypatch):
+    """The closured block rides the sharded path too (kept on the MXU
+    when the graph axis divides its src range, folded to closure edges
+    when it does not) — parity against the single-chip engine."""
+    import jax
+
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+    from spicedb_kubeapi_proxy_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(4, devices=devs[:4])
+    e1 = _nested_group_engine()
+    em = Engine(schema=parse_schema(NESTED_GROUP_SCHEMA), mesh=mesh)
+    ops = [str(r) for r in e1.read_relationships(RelationshipFilter())]
+    em.write_relationships(touch(*ops))
+    assert any(b.closured for b in em.compiled().blocks)
+    items = [CheckItem("namespace", f"ns{g}", "view", "user", f"u{u}")
+             for g in range(3) for u in range(3)]
+    assert em.check_bulk(items) == e1.check_bulk(items)
+    for g in range(3):
+        assert em.lookup_resources("namespace", "view", "user", f"u{g}") \
+            == e1.lookup_resources("namespace", "view", "user", f"u{g}")
+
+
 def test_check_bulk_mixed_subjects_and_unknowns():
     e = make_engine(
         "namespace:ns1#creator@user:alice",

@@ -482,6 +482,70 @@ def test_tpu_matches_oracle_fuzz():
         )
 
 
+def test_closured_block_fuzz_matches_oracle(monkeypatch):
+    """Randomized recursive-group graphs with the dense threshold forced
+    low, fuzzing the closure machinery: random group→group edges (chains,
+    diamonds, cycles), wildcard grants, and — in half the trials —
+    expiring group→group edges, which must disqualify the self-pair from
+    closure (expiring edges ride the residual path) without losing oracle
+    parity either way."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    rng = np.random.default_rng(1234)
+    schema = parse_schema("""
+use expiration
+
+definition user {}
+definition group { relation member: user | group#member with expiration }
+definition namespace {
+  relation viewer: group#member | user:*
+  permission view = viewer
+}
+""")
+    saw_closured = saw_unclosured = False
+    for trial in range(8):
+        e = Engine(schema=schema)
+        users = [f"u{i}" for i in range(5)]
+        groups = [f"g{i}" for i in range(7)]
+        ops = []
+        for g in groups:
+            for u in rng.choice(users, size=2, replace=False):
+                ops.append(WriteOp("touch", rel(f"group:{g}#member@user:{u}")))
+        n_gg = int(rng.integers(3, 9))
+        expiring_trial = trial % 2 == 0
+        seen_gg = set()
+        for _ in range(n_gg):
+            a, b = rng.choice(groups, size=2, replace=False)
+            if (a, b) in seen_gg:
+                continue
+            seen_gg.add((a, b))
+            exp_ = (time.time() + 1000
+                    if expiring_trial and rng.random() < 0.4 else None)
+            ops.append(WriteOp("touch", Relationship(
+                "group", a, "member", "group", b,
+                subject_relation="member", expiration=exp_)))
+        for i in range(4):
+            g = rng.choice(groups)
+            ops.append(WriteOp("touch", rel(
+                f"namespace:ns{i}#viewer@group:{g}#member")))
+        if rng.random() < 0.3:
+            ops.append(WriteOp("touch", rel("namespace:ns0#viewer@user:*")))
+        e.write_relationships(ops)
+        cg = e.compiled()
+        has_closured = any(b.closured for b in cg.blocks)
+        if expiring_trial and any(
+                op.rel.expiration is not None for op in ops):
+            assert not has_closured, \
+                "expiring self-edges must disqualify closure"
+            saw_unclosured = True
+        saw_closured = saw_closured or has_closured
+        assert_engine_matches_oracle(
+            e, subjects=[("user", u) for u in users]
+            + [("group", g) for g in groups[:2]] + [("user", "nobody")])
+    assert saw_closured and saw_unclosured, "fuzz must cover both paths"
+
+
 def test_dense_block_path_matches_oracle(monkeypatch):
     """Force the dense MXU block path (normally >=1024 edges per block) on
     the fuzz graphs and assert oracle parity — covers block splitting,

@@ -255,6 +255,84 @@ if: ['user.name']
 
 # -- structured templates -----------------------------------------------------
 
+def test_input_conversion_matrix():
+    """Input → evaluation-data conversion parity with the reference's
+    TestConvertToBloblangInput matrix (rules_test.go:1755-2003): user
+    extra fields, groups, multi-value headers, the resourceId alias,
+    nested object metadata merge, and the empty/missing edge cases."""
+    from spicedb_kubeapi_proxy_tpu.rules.input import (
+        RequestInfo,
+        ResolveInput,
+        UserInfo,
+    )
+
+    # basic input with user extra fields + multi-value headers
+    inp = ResolveInput(
+        name="test-pod", namespace="default",
+        namespaced_name="default/test-pod",
+        request=RequestInfo(verb="create", api_group="v1", api_version="v1",
+                            resource="pods", name="test-pod",
+                            namespace="default"),
+        user=UserInfo(name="test-user", uid="uid123",
+                      groups=["group1", "group2"],
+                      extra={"department": ["engineering", "security"],
+                             "role": ["admin"],
+                             "project": ["alpha", "beta", "gamma"]}),
+        headers={"Authorization": "Bearer token123",
+                 "X-Custom": "value1"},
+        object=None, body=None,
+    )
+    d = inp.template_data()
+    assert d["name"] == "test-pod"
+    assert d["namespacedName"] == "default/test-pod"
+    assert d["resourceId"] == "default/test-pod"  # alias, same value
+    assert d["request"]["verb"] == "create"
+    assert d["request"]["apiGroup"] == "v1"
+    assert d["user"]["uid"] == "uid123"
+    assert d["user"]["groups"] == ["group1", "group2"]
+    assert d["user"]["extra"]["project"] == ["alpha", "beta", "gamma"]
+    assert d["headers"]["Authorization"] == "Bearer token123"
+    # CEL-shape: namespace spelled resourceNamespace (rules.go:467-518)
+    c = inp.condition_data()
+    assert c["resourceNamespace"] == "default"
+    assert c["user"]["extra"]["role"] == ["admin"]
+
+    # object metadata with nested structure: metadata hoisted beside object
+    inp2 = ResolveInput(
+        name="cm", namespace="ns1", namespaced_name="ns1/cm",
+        request=RequestInfo(verb="create", resource="configmaps",
+                            namespace="ns1"),
+        user=UserInfo(name="u"),
+        headers={},
+        body=None,
+        object={"metadata": {"name": "cm",
+                             "labels": {"env": "prod", "team": "platform"},
+                             "annotations": {"a/b": "c"}},
+                "data": {"k": "v"}},
+    )
+    d2 = inp2.template_data()
+    assert d2["metadata"]["labels"]["env"] == "prod"
+    assert d2["object"]["data"]["k"] == "v"
+    # expressions traverse the merged shape
+    from spicedb_kubeapi_proxy_tpu.rules.expr import compile_template
+    assert compile_template(
+        "{{metadata.labels.team}}").evaluate(d2) == "platform"
+
+    # empty extra/headers and a user with no groups
+    inp3 = ResolveInput(
+        name="x", namespace="", namespaced_name="x",
+        request=RequestInfo(verb="get", resource="namespaces", name="x"),
+        user=UserInfo(name="solo", extra={}),
+        headers={},
+        object=None, body=None,
+    )
+    d3 = inp3.template_data()
+    assert d3["user"]["extra"] == {}
+    assert d3["user"]["groups"] == []
+    assert d3["headers"] == {}
+    assert d3["resourceId"] == "x"  # cluster-scoped: no namespace prefix
+
+
 def test_structured_template_round_trip():
     rule = _rule("""
 match: [{apiVersion: v1, resource: namespaces, verbs: [create]}]

@@ -167,12 +167,35 @@ class _BlockMeta:
     # peels out of the iterated core (see _stratify's ignore_self). The
     # diagonal keeps already-merged values alive across the replacing
     # per-level merge. Derived cells cannot be deleted individually —
-    # incremental deletes touching a closured block force a recompile.
+    # incremental deletes RE-CLOSE the block from its base edges
+    # (base_dst_local/base_src_local, kept for exactly this) in O(block).
     closured: bool = False
+    base_dst_local: Optional[np.ndarray] = None
+    base_src_local: Optional[np.ndarray] = None
 
     def slim(self) -> "_BlockMeta":
         return _BlockMeta(self.dst_off, self.n_dst, self.src_off,
                           self.n_src, None, None, self.level, self.closured)
+
+    def reclosed(self, remove: set) -> Optional["_BlockMeta"]:
+        """A new closured block with ``remove`` (local (dst, src) pairs)
+        deleted from the BASE edge set and the closure recomputed — the
+        O(block) alternative to a full graph recompile on membership
+        deletes. None when the closure overflows (caller recompiles)."""
+        keep = np.fromiter(
+            ((int(d), int(s)) not in remove
+             for d, s in zip(self.base_dst_local.tolist(),
+                             self.base_src_local.tolist())),
+            dtype=bool, count=len(self.base_dst_local))
+        nb_dst = self.base_dst_local[keep]
+        nb_src = self.base_src_local[keep]
+        coo = _closure_pairs(nb_dst, nb_src, self.n_dst)
+        if coo is None:
+            return None
+        dl, sl = coo
+        return _BlockMeta(self.dst_off, self.n_dst, self.src_off,
+                          self.n_src, dl, sl, self.level, True,
+                          nb_dst, nb_src)
 
 
 # dense-block eligibility: a block must carry enough edges to beat the
@@ -1268,6 +1291,8 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
                     src_off=int(offs[s_rid]), n_src=int(sizes[s_rid]),
                     dst_local=dl, src_local=sl,
                     level=lvl + 1 if lvl else 0, closured=True,
+                    base_dst_local=(dst[sel] - offs[d_rid]).astype(np.int32),
+                    base_src_local=(src[sel] - offs[s_rid]).astype(np.int32),
                 ))
             else:
                 blocks.append(_BlockMeta(
@@ -1466,6 +1491,9 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     res_inval: set[int] = set()
     block_cells: dict[int, dict[tuple[int, int], int]] = {}
     dead: list[tuple[int, int]] = []
+    # closured blocks whose BASE edges lost pairs: re-closed wholesale
+    reclose: dict[int, set] = {}  # block idx -> local (dst, src) pairs
+    base_codes_cache: dict[int, np.ndarray] = {}  # block idx -> sorted codes
 
     for is_delete, relationship in records:
         edges = _edges_for_tuple(cg, store, relationship)
@@ -1485,20 +1513,39 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
             b = _pair_block(cg, src, dst)
             if b is not None:
                 bm = cg.blocks[b]
-                if bm.closured and (
-                        is_delete or relationship.expiration is not None):
-                    # closure cells are DERIVED reachability, not base
-                    # edges: clearing one cell would leave multi-hop
-                    # products of the deleted edge alive (over-allow).
-                    # Deletes — and touches that attach an expiration,
-                    # whose multi-hop products would outlive the
-                    # expiration — re-close via a full recompile.
-                    # (Non-expiring touches are safe: the cleared direct
-                    # cell is re-derived by the delta edge — in the core
-                    # every iteration, at peeled levels the same-level
-                    # add already forced a recompile via
-                    # _level_order_ok.)
+                if bm.closured and relationship.expiration is not None:
+                    # a touch attaching an expiration de-qualifies the
+                    # pair from closure entirely (expiring edges must
+                    # ride the residual path): re-stratify via recompile
                     return None
+                if bm.closured and is_delete:
+                    # closure cells are DERIVED reachability — clearing
+                    # one cell would leave multi-hop products of the
+                    # deleted edge alive (over-allow) and could kill
+                    # cells still justified by alternative paths
+                    # (under-allow). Instead RE-CLOSE the block from its
+                    # base edges minus the deleted pair, O(block); the
+                    # pair must NOT enter dead_pairs/block_cells — the
+                    # recomputed closure is the sole truth (a surviving
+                    # alternative path may legitimately keep the direct
+                    # cell set).
+                    dl_, sl_ = int(dst - bm.dst_off), int(src - bm.src_off)
+                    codes = base_codes_cache.get(b)
+                    if codes is None:
+                        codes = np.sort(
+                            bm.base_dst_local.astype(np.int64) * bm.n_src
+                            + bm.base_src_local)
+                        base_codes_cache[b] = codes
+                    code = dl_ * bm.n_src + sl_
+                    p_ = int(np.searchsorted(codes, code))
+                    if p_ < len(codes) and codes[p_] == code:
+                        reclose.setdefault(b, set()).add((dl_, sl_))
+                    # not in base (delta-only or nonexistent): popping the
+                    # delta edge below is the entire delete — re-closing
+                    # an unchanged base would rebuild device/sharded
+                    # state for a no-op
+                    delta_state.pop((src, dst), None)
+                    continue
                 block_cells.setdefault(b, {})[
                     (dst - bm.dst_off, src - bm.src_off)] = 0
             for p in _res_positions(cg, src, dst):
@@ -1549,6 +1596,15 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     if len(dead_pairs) > DELTA_MAX_EDGES:
         return None
 
+    blocks_host = cg.blocks
+    if reclose:
+        blocks_host = list(cg.blocks)
+        for b, pairs in reclose.items():
+            nb = blocks_host[b].reclosed(pairs)
+            if nb is None:  # closure overflow: re-stratify instead
+                return None
+            blocks_host[b] = nb
+
     new = CompiledGraph(
         schema=cg.schema,
         revision=new_revision,
@@ -1561,7 +1617,7 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
         exp_rel=cg.exp_rel,
         n_edges=cg.n_edges,
         programs=cg.programs,
-        blocks=cg.blocks,
+        blocks=blocks_host,
         res_idx=cg.res_idx,
         delta_src=d_src,
         delta_dst=d_dst,
@@ -1595,9 +1651,20 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     if res_inval:
         d["exp"] = old["exp"].at[np.fromiter(
             res_inval, dtype=np.int64)].set(-np.inf)
-    if block_cells:
+    if block_cells or reclose:
         blocks_dev = list(old["blocks"])
         bits_dev = list(old["blocks_bits"])
+        for b in reclose:
+            # re-closed block: fresh device matrix scattered from the new
+            # closure COO (uploading the pairs, not the dense matrix)
+            bm = blocks_host[b]
+            blocks_dev[b] = jnp.zeros(
+                (bm.n_dst, bm.n_src), dtype=jnp.int8
+            ).at[jnp.asarray(bm.dst_local),
+                 jnp.asarray(bm.src_local)].set(1)
+            if bits_dev[b] is not None:
+                bits_dev[b] = jnp.asarray(bitprop.pack_block_host(
+                    bm.dst_local, bm.src_local, bm.n_dst, bm.n_src))
         for b, cells in block_cells.items():
             dl = np.fromiter((c[0] for c in cells), dtype=np.int32,
                              count=len(cells))

@@ -370,11 +370,43 @@ class ShardedGraph:
         old = self.cg
         if cg is old:
             return self
-        if cg.signature() != old.signature() or cg.blocks is not old.blocks:
+        if cg.signature() != old.signature():
             return ShardedGraph(cg, self.mesh, self.max_iters)
+        reclosed_idx: list[int] = []
+        if cg.blocks is not old.blocks:
+            # a re-closed closured block (incremental membership delete)
+            # keeps shape/level/flags — only its cells changed. Re-upload
+            # just those matrices instead of rebuilding the whole sharded
+            # state; anything else (and folded blocks, whose closure
+            # edges live inside the level arrays) needs the full rebuild.
+            if len(cg.blocks) != len(old.blocks):
+                return ShardedGraph(cg, self.mesh, self.max_iters)
+            for i, (nb, ob) in enumerate(zip(cg.blocks, old.blocks)):
+                if nb is ob:
+                    continue
+                same_shape = (
+                    nb.dst_off == ob.dst_off and nb.n_dst == ob.n_dst
+                    and nb.src_off == ob.src_off and nb.n_src == ob.n_src
+                    and nb.level == ob.level and nb.closured
+                    and ob.closured)
+                if not same_shape or nb.n_src % self.ng:
+                    return ShardedGraph(cg, self.mesh, self.max_iters)
+                reclosed_idx.append(i)
         new = object.__new__(ShardedGraph)
         new.__dict__.update(self.__dict__)
         new.cg = cg
+        if reclosed_idx:
+            kept_pos = {}
+            pos = 0
+            for i, bm in enumerate(cg.blocks):
+                if bm.n_src % self.ng == 0:
+                    kept_pos[i] = pos
+                    pos += 1
+            blocks = list(new._blocks)
+            for i in reclosed_idx:
+                blocks[kept_pos[i]] = jax.device_put(
+                    self._block_matrix(cg.blocks[i]), self._block_sh)
+            new._blocks = tuple(blocks)
         # kill base edges for dead pairs not yet applied to these shards
         keys = _pair_keys(cg.dead_pairs)
         fresh = keys[~np.isin(keys, self._applied_dead)]

@@ -540,9 +540,21 @@ definition namespace {
                 "expiring self-edges must disqualify closure"
             saw_unclosured = True
         saw_closured = saw_closured or has_closured
-        assert_engine_matches_oracle(
-            e, subjects=[("user", u) for u in users]
-            + [("group", g) for g in groups[:2]] + [("user", "nobody")])
+        subjects = ([("user", u) for u in users]
+                    + [("group", g) for g in groups[:2]]
+                    + [("user", "nobody")])
+        assert_engine_matches_oracle(e, subjects=subjects)
+        # random delete batch (the re-close path: cycle edges, leaf
+        # edges, already-deleted idempotence), then parity again
+        del_ops = []
+        for op in rng.choice(len(ops), size=min(4, len(ops)),
+                             replace=False).tolist():
+            if ops[op].rel.expiration is None:
+                del_ops.append(WriteOp("delete", ops[op].rel))
+        if del_ops:
+            e.write_relationships(del_ops)
+            e.write_relationships(del_ops)  # idempotent re-delete
+            assert_engine_matches_oracle(e, subjects=subjects)
     assert saw_closured and saw_unclosured, "fuzz must cover both paths"
 
 
@@ -740,6 +752,46 @@ definition namespace {
     assert e.check_bulk([item], now=now + 100) == [False]  # expired
 
 
+def test_closured_block_delete_recloses_incrementally(monkeypatch):
+    """A membership delete inside a closured block re-closes that block
+    from its base edges in O(block) — no full graph recompile — and a
+    surviving alternative path keeps derived reachability alive (the
+    dead-cell approach would have under-allowed it)."""
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    e = Engine(schema=parse_schema(NESTED_GROUP_SCHEMA))
+    # two paths a->b: direct, and via c (a -> c -> b)
+    e.write_relationships(touch(
+        "group:a#member@user:alice",
+        "group:b#member@group:a#member",   # direct
+        "group:c#member@group:a#member",
+        "group:b#member@group:c#member",   # alternative
+        "group:z#member@user:zed",
+        "namespace:ns#viewer@group:b#member",
+    ))
+    assert any(b.closured for b in e.compiled().blocks)
+    item = CheckItem("namespace", "ns", "view", "user", "alice")
+    assert e.check_bulk([item]) == [True]
+    compiles = metrics.counter("engine_graph_compiles_total").value
+    inc = metrics.counter("engine_graph_incremental_updates_total").value
+    # delete the direct edge: reachability survives via c
+    e.write_relationships([WriteOp("delete", rel(
+        "group:b#member@group:a#member"))])
+    assert e.check_bulk([item]) == [True]
+    # delete the alternative too: now revoked
+    e.write_relationships([WriteOp("delete", rel(
+        "group:b#member@group:c#member"))])
+    assert e.check_bulk([item]) == [False]
+    assert metrics.counter("engine_graph_compiles_total").value == compiles, \
+        "closured deletes must not trigger a full recompile"
+    assert metrics.counter(
+        "engine_graph_incremental_updates_total").value >= inc + 2
+    assert_engine_matches_oracle(e)
+
+
 def test_closured_block_sharded_parity(monkeypatch):
     """The closured block rides the sharded path too (kept on the MXU
     when the graph axis divides its src range, folded to closure edges
@@ -765,6 +817,17 @@ def test_closured_block_sharded_parity(monkeypatch):
     for g in range(3):
         assert em.lookup_resources("namespace", "view", "user", f"u{g}") \
             == e1.lookup_resources("namespace", "view", "user", f"u{g}")
+    # a closured-block delete must stay consistent on the sharded path
+    # (re-closed matrices re-uploaded without a full sharded rebuild)
+    run_before = em._sharded._run
+    for eng in (em, e1):
+        eng.write_relationships([WriteOp("delete", rel(
+            "group:l3-1#member@group:l2-1#member"))])
+    assert em.check_bulk(items) == e1.check_bulk(items)
+    assert em._sharded._run is run_before, \
+        "re-closed delete must reuse the jitted shard_map (fast path)"
+    assert em.lookup_resources("namespace", "view", "user", "u1") \
+        == e1.lookup_resources("namespace", "view", "user", "u1")
 
 
 def test_check_bulk_mixed_subjects_and_unknowns():

@@ -136,6 +136,35 @@ class FakeKube:
             self.objects[key] = obj
             self._notify(res, ns, {"type": "MODIFIED", "object": obj})
             return json_response(200, obj)
+        if info.verb == "patch":
+            key = (res, ns, name)
+            if key not in self.objects:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            try:
+                patch = json.loads(req.body)
+            except ValueError:
+                return kube_status(400, "invalid patch body", "BadRequest")
+            if not isinstance(patch, dict):
+                return kube_status(
+                    415, "only merge-patch objects supported", "BadRequest")
+            obj = json.loads(json.dumps(self.objects[key]))
+
+            def merge(dst, src):
+                # JSON Merge Patch (RFC 7386): null deletes the key
+                for k, v in src.items():
+                    if v is None:
+                        dst.pop(k, None)
+                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    else:
+                        dst[k] = v
+
+            merge(obj, patch)
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            self.objects[key] = obj
+            self._notify(res, ns, {"type": "MODIFIED", "object": obj})
+            return json_response(200, obj)
         if info.verb == "delete":
             key = (res, ns, name)
             obj = self.objects.pop(key, None)

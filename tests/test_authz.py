@@ -471,6 +471,76 @@ async def _wait_for(pred, interval=0.02):
         await asyncio.sleep(interval)
 
 
+UPDATE_PATCH_RULES = RULES + """
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata:
+  name: pod-update
+match:
+  - apiVersion: v1
+    resource: pods
+    verbs: ["update", "patch"]
+check:
+  - tpl: "pod:{{namespacedName}}#edit@user:{{user.name}}"
+update:
+  touches:
+    # viewer is NOT written by the create rule, so its existence after an
+    # update proves this rule's touches ran (reference touches #creator,
+    # which create also writes — that assertion would be vacuous here)
+    - tpl: "pod:{{namespacedName}}#viewer@user:{{user.name}}"
+"""
+
+
+def test_update_and_patch_verbs_dual_write():
+    """Reference e2e updateTestResource rule (proxy_test.go:1256-1272):
+    update/patch gated on #edit, dual-writing a #creator touch. The
+    creator may update AND patch; a viewer-only user is denied both."""
+    async def go():
+        env = Env(rules_yaml=UPDATE_PATCH_RULES)
+        await env.create_ns("upd", user="alice")
+        await env.create_pod("upd", "api", user="alice")
+        from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+        from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+        env.engine.write_relationships([WriteOp("touch", parse_relationship(
+            "pod:upd/api#viewer@user:bob"))])  # bob can view, not edit
+        # the touched relation must not pre-exist: the assertion below is
+        # only meaningful if the PUT's dual-write creates it
+        assert not env.engine.store.exists(RelationshipFilter(
+            "pod", "upd/api", "viewer", "user", "alice"))
+        body = {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "api", "namespace": "upd",
+                             "labels": {"v": "2"}}}
+        # creator updates: allowed, upstream applied, touch written
+        r = await env.request("PUT", "/api/v1/namespaces/upd/pods/api",
+                              user="alice", body=body)
+        assert r.status == 200, r.body
+        assert env.kube.objects[("pods", "upd", "api")]["metadata"][
+            "labels"] == {"v": "2"}
+        assert env.engine.store.exists(RelationshipFilter(
+            "pod", "upd/api", "viewer", "user", "alice"))
+        # creator patches: allowed
+        body["metadata"]["labels"] = {"v": "3"}
+        r = await env.request("PATCH", "/api/v1/namespaces/upd/pods/api",
+                              user="alice", body=body)
+        assert r.status == 200, r.body
+        # viewer-only bob: denied on both verbs with a DISTINCT body, so
+        # a fail-open forward would be visible upstream
+        bob_body = {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "api", "namespace": "upd",
+                                 "labels": {"v": "bob-was-here"}}}
+        rv = env.kube.objects[("pods", "upd", "api")]["metadata"][
+            "resourceVersion"]
+        for method in ("PUT", "PATCH"):
+            r = await env.request(method, "/api/v1/namespaces/upd/pods/api",
+                                  user="bob", body=bob_body)
+            assert r.status == 403, (method, r.status)
+        meta = env.kube.objects[("pods", "upd", "api")]["metadata"]
+        assert meta["labels"] == {"v": "3"}
+        assert meta["resourceVersion"] == rv  # no upstream write happened
+    run(go())
+
+
 def test_multiple_update_rules_rejected():
     async def go():
         dup = RULES + "\n---\n" + RULES.split("---")[0]  # duplicate create rule

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-quick bench bench-quick serve-dev native lint clean
+.PHONY: test test-quick bench bench-quick serve-dev demo native lint clean
 
 # full suite on the virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -20,6 +20,12 @@ bench:
 
 bench-quick:
 	$(PY) bench.py --quick
+
+# fully self-contained demo: proxy + in-memory upstream + sample rules
+# on http://127.0.0.1:8080 (the reference's `mage dev:up`+`dev:run` flow
+# without a kind cluster); it prints curl examples on boot
+demo:
+	$(PY) -m spicedb_kubeapi_proxy_tpu.proxy.demo
 
 # run a local dev proxy with the in-repo rule set against YOUR apiserver
 # (reference `mage dev:run` runs against a kind cluster; set UPSTREAM_URL

@@ -1,0 +1,213 @@
+"""In-memory kube-apiserver implementing the Upstream interface.
+
+One implementation, two consumers: the test suite's FakeKube (which adds
+failure injection on top — the role envtest's real apiserver plays in
+the reference e2e suite, e2e/util_test.go:65-102) and the self-contained
+demo (`proxy/demo.py`, the reference's `mage dev:up` flow without a kind
+cluster). CRUD + list + merge-patch + watch over JSON resources; content
+shape follows kube conventions (kind lists, Status errors,
+resourceVersion).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from .requestinfo import parse_request_info
+from .types import ProxyRequest, ProxyResponse, json_response, kube_status
+
+
+def kind_for(resource: str) -> str:
+    singular = resource[:-1] if resource.endswith("s") else resource
+    return "".join(p.capitalize() for p in singular.split("-"))
+
+
+class InMemoryKube:
+    def __init__(self):
+        # (resource, namespace, name) -> object dict
+        self.objects: dict[tuple, dict] = {}
+        self.rv = 0
+        self._watchers: list[tuple[str, str, asyncio.Queue]] = []
+
+    # -- seeding -------------------------------------------------------------
+
+    def put(self, resource: str, name: str, ns: str = "",
+            obj: dict | None = None) -> dict:
+        """Seed an object directly (demo/test setup), notifying watchers."""
+        obj = dict(obj or {})
+        obj.setdefault("apiVersion", "v1")
+        obj.setdefault("kind", kind_for(resource))
+        meta = obj.setdefault("metadata", {})
+        meta["name"] = name
+        if ns:
+            meta["namespace"] = ns
+        self.rv += 1
+        meta["resourceVersion"] = str(self.rv)
+        self.objects[(resource, ns, name)] = obj
+        self._notify(resource, ns, {"type": "ADDED", "object": obj})
+        return obj
+
+    # -- upstream interface --------------------------------------------------
+
+    async def __call__(self, req: ProxyRequest) -> ProxyResponse:
+        # the dual-write workflow replays raw requests without a parsed
+        # request_info (dtx/activity.py write_to_kube)
+        info = req.request_info or parse_request_info(
+            req.method, req.path, req.query)
+        if not info.is_resource_request:
+            if info.path.startswith(("/api", "/apis", "/openapi", "/version")):
+                return json_response(200, {"kind": "APIVersions",
+                                           "versions": ["v1"]})
+            return kube_status(404, "not found")
+        res, ns, name = info.resource, info.namespace, info.name
+        if info.verb == "get":
+            obj = self.objects.get((res, ns, name))
+            if obj is None:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            return json_response(200, obj)
+        if info.verb == "list" or info.verb == "watch":
+            if info.verb == "watch":
+                return self._start_watch(res, ns)
+            items = [o for (r, n_, _), o in sorted(self.objects.items())
+                     if r == res and (not ns or n_ == ns)]
+            return json_response(200, {
+                "kind": kind_for(res) + "List",
+                "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(self.rv)},
+                "items": items,
+            })
+        if info.verb == "create":
+            try:
+                obj = json.loads(req.body)
+            except ValueError:
+                return kube_status(400, "invalid body")
+            if not isinstance(obj, dict):
+                return kube_status(400, "body must be an object")
+            name = (obj.get("metadata") or {}).get("name", "")
+            if not name:
+                return kube_status(400, "name required")
+            key = (res, ns, name)
+            if key in self.objects:
+                return kube_status(409, f'{res} "{name}" already exists',
+                                   "AlreadyExists")
+            self.rv += 1
+            if not isinstance(obj.get("metadata"), dict):
+                obj["metadata"] = {"name": name}
+            obj["metadata"]["resourceVersion"] = str(self.rv)
+            if ns:
+                obj["metadata"]["namespace"] = ns
+            obj.setdefault("kind", kind_for(res))
+            self.objects[key] = obj
+            self._notify(res, ns, {"type": "ADDED", "object": obj})
+            return json_response(201, obj)
+        if info.verb == "update":
+            key = (res, ns, name)
+            if key not in self.objects:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            try:
+                obj = json.loads(req.body)
+            except ValueError:
+                return kube_status(400, "invalid body")
+            if not isinstance(obj, dict):
+                return kube_status(400, "body must be an object")
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            self.objects[key] = obj
+            self._notify(res, ns, {"type": "MODIFIED", "object": obj})
+            return json_response(200, obj)
+        if info.verb == "patch":
+            key = (res, ns, name)
+            if key not in self.objects:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            try:
+                patch = json.loads(req.body)
+            except ValueError:
+                return kube_status(400, "invalid patch body", "BadRequest")
+            if not isinstance(patch, dict):
+                return kube_status(
+                    415, "only merge-patch objects supported", "BadRequest")
+            obj = json.loads(json.dumps(self.objects[key]))
+
+            def merge(dst, src):
+                # JSON Merge Patch (RFC 7386): null deletes the key
+                for k, v in src.items():
+                    if v is None:
+                        dst.pop(k, None)
+                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    else:
+                        dst[k] = v
+
+            merge(obj, patch)
+            self.rv += 1
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+            self.objects[key] = obj
+            self._notify(res, ns, {"type": "MODIFIED", "object": obj})
+            return json_response(200, obj)
+        if info.verb == "delete":
+            key = (res, ns, name)
+            obj = self.objects.pop(key, None)
+            if obj is None:
+                return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            self.rv += 1
+            self._notify(res, ns, {"type": "DELETED", "object": obj})
+            return json_response(200, {"kind": "Status", "status": "Success",
+                                       "code": 200})
+        return kube_status(405, f"verb {info.verb} not supported")
+
+    # -- watch ---------------------------------------------------------------
+
+    def _notify(self, res: str, ns: str, event: dict) -> None:
+        for r, n_, q in self._watchers:
+            if r == res and (not n_ or n_ == ns):
+                q.put_nowait(event)
+
+    def _start_watch(self, res: str, ns: str) -> ProxyResponse:
+        q: asyncio.Queue = asyncio.Queue()
+        # emit existing objects as initial ADDED events (kube semantics with
+        # resourceVersion=0 watches)
+        for (r, n_, _), o in sorted(self.objects.items()):
+            if r == res and (not ns or n_ == ns):
+                q.put_nowait({"type": "ADDED", "object": o})
+        entry = (res, ns, q)
+        self._watchers.append(entry)
+
+        async def frames():
+            try:
+                while True:
+                    ev = await q.get()
+                    if ev is None:
+                        return
+                    yield (json.dumps(ev) + "\n").encode()
+            finally:
+                # client disconnect / generator close: stop fanning events
+                # into a dead queue (long-running demos would leak)
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return ProxyResponse(
+            status=200,
+            headers={"Content-Type": "application/json",
+                     "Transfer-Encoding": "chunked"},
+            stream=frames(),
+        )
+
+    def emit_watch_event(self, res: str, event_type: str, name: str,
+                         ns: str = "") -> None:
+        """Emit a synthetic watch event for an (existing or ad-hoc) object
+        — lets tests inject upstream events without a write round trip."""
+        obj = self.objects.get((res, ns, name))
+        if obj is None:
+            obj = {"kind": kind_for(res), "metadata": {"name": name}}
+            if ns:
+                obj["metadata"]["namespace"] = ns
+        obj = json.loads(json.dumps(obj))  # private copy
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        self._notify(res, ns, {"type": event_type, "object": obj})
+
+    def stop_watches(self):
+        for _, _, q in list(self._watchers):
+            q.put_nowait(None)
+        self._watchers.clear()

@@ -1,29 +1,20 @@
-"""In-memory fake kube-apiserver implementing the Upstream interface.
-
-Plays the role envtest's real apiserver plays in the reference e2e suite
-(reference e2e/util_test.go:65-102): CRUD + list + watch over JSON
-resources, with injectable failures for the crash matrix. Content shape
-follows kube conventions (kind lists, Status errors, resourceVersion).
+"""Test fake kube-apiserver: the package's in-memory upstream
+(`proxy/inmemkube.py`) plus failure injection for the crash matrix and
+request recording — the role envtest's real apiserver plays in the
+reference e2e suite (reference e2e/util_test.go:65-102).
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
 from typing import Optional
 
+from spicedb_kubeapi_proxy_tpu.proxy.inmemkube import InMemoryKube
 from spicedb_kubeapi_proxy_tpu.proxy.types import (
     ProxyRequest,
     ProxyResponse,
-    json_response,
     kube_status,
 )
-from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
-
-
-def _kind_for(resource: str) -> str:
-    singular = resource[:-1] if resource.endswith("s") else resource
-    return "".join(p.capitalize() for p in singular.split("-"))
 
 
 async def serve_upstream(fake):
@@ -53,14 +44,11 @@ async def serve_upstream(fake):
     return server, server.sockets[0].getsockname()[1]
 
 
-class FakeKube:
+class FakeKube(InMemoryKube):
     def __init__(self):
-        # (resource, namespace, name) -> object dict
-        self.objects: dict[tuple, dict] = {}
-        self.rv = 0
-        self._fail_next: list = []  # (matcher, status | Exception)
+        super().__init__()
+        self._fail_next: list = []  # (method | None, status, Exception | None)
         self.requests: list[ProxyRequest] = []
-        self._watchers: list[tuple[str, str, asyncio.Queue]] = []
 
     # -- failure injection ---------------------------------------------------
 
@@ -69,8 +57,6 @@ class FakeKube:
                   method: Optional[str] = None):
         for _ in range(n):
             self._fail_next.append((method, status, exception))
-
-    # -- upstream interface --------------------------------------------------
 
     async def __call__(self, req: ProxyRequest) -> ProxyResponse:
         self.requests.append(req)
@@ -81,146 +67,4 @@ class FakeKube:
                 if exc is not None:
                     raise exc
                 return kube_status(status, "injected failure")
-        info = req.request_info or parse_request_info(
-            req.method, req.path, req.query)
-        if not info.is_resource_request:
-            if info.path.startswith(("/api", "/apis", "/openapi", "/version")):
-                return json_response(200, {"kind": "APIVersions",
-                                           "versions": ["v1"]})
-            return kube_status(404, "not found")
-        res, ns, name = info.resource, info.namespace, info.name
-        if info.verb == "get":
-            obj = self.objects.get((res, ns, name))
-            if obj is None:
-                return kube_status(404, f'{res} "{name}" not found', "NotFound")
-            return json_response(200, obj)
-        if info.verb == "list" or info.verb == "watch":
-            if info.verb == "watch":
-                return self._start_watch(res, ns)
-            items = [o for (r, n_, _), o in sorted(self.objects.items())
-                     if r == res and (not ns or n_ == ns)]
-            return json_response(200, {
-                "kind": _kind_for(res) + "List",
-                "apiVersion": "v1",
-                "metadata": {"resourceVersion": str(self.rv)},
-                "items": items,
-            })
-        if info.verb == "create":
-            try:
-                obj = json.loads(req.body)
-            except ValueError:
-                return kube_status(400, "invalid body")
-            name = (obj.get("metadata") or {}).get("name", "")
-            if not name:
-                return kube_status(400, "name required")
-            key = (res, ns, name)
-            if key in self.objects:
-                return kube_status(409, f'{res} "{name}" already exists',
-                                   "AlreadyExists")
-            self.rv += 1
-            obj.setdefault("metadata", {})
-            obj["metadata"]["resourceVersion"] = str(self.rv)
-            if ns:
-                obj["metadata"]["namespace"] = ns
-            obj.setdefault("kind", _kind_for(res))
-            self.objects[key] = obj
-            self._notify(res, ns, {"type": "ADDED", "object": obj})
-            return json_response(201, obj)
-        if info.verb == "update":
-            key = (res, ns, name)
-            if key not in self.objects:
-                return kube_status(404, f'{res} "{name}" not found', "NotFound")
-            obj = json.loads(req.body)
-            self.rv += 1
-            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
-            self.objects[key] = obj
-            self._notify(res, ns, {"type": "MODIFIED", "object": obj})
-            return json_response(200, obj)
-        if info.verb == "patch":
-            key = (res, ns, name)
-            if key not in self.objects:
-                return kube_status(404, f'{res} "{name}" not found', "NotFound")
-            try:
-                patch = json.loads(req.body)
-            except ValueError:
-                return kube_status(400, "invalid patch body", "BadRequest")
-            if not isinstance(patch, dict):
-                return kube_status(
-                    415, "only merge-patch objects supported", "BadRequest")
-            obj = json.loads(json.dumps(self.objects[key]))
-
-            def merge(dst, src):
-                # JSON Merge Patch (RFC 7386): null deletes the key
-                for k, v in src.items():
-                    if v is None:
-                        dst.pop(k, None)
-                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
-                        merge(dst[k], v)
-                    else:
-                        dst[k] = v
-
-            merge(obj, patch)
-            self.rv += 1
-            obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
-            self.objects[key] = obj
-            self._notify(res, ns, {"type": "MODIFIED", "object": obj})
-            return json_response(200, obj)
-        if info.verb == "delete":
-            key = (res, ns, name)
-            obj = self.objects.pop(key, None)
-            if obj is None:
-                return kube_status(404, f'{res} "{name}" not found', "NotFound")
-            self.rv += 1
-            self._notify(res, ns, {"type": "DELETED", "object": obj})
-            return json_response(200, {"kind": "Status", "status": "Success",
-                                       "code": 200})
-        return kube_status(405, f"verb {info.verb} not supported")
-
-    # -- watch ---------------------------------------------------------------
-
-    def _notify(self, res: str, ns: str, event: dict) -> None:
-        for r, n_, q in self._watchers:
-            if r == res and (not n_ or n_ == ns):
-                q.put_nowait(event)
-
-    def _start_watch(self, res: str, ns: str) -> ProxyResponse:
-        q: asyncio.Queue = asyncio.Queue()
-        # emit existing objects as initial ADDED events (kube semantics with
-        # resourceVersion=0 watches)
-        for (r, n_, _), o in sorted(self.objects.items()):
-            if r == res and (not ns or n_ == ns):
-                q.put_nowait({"type": "ADDED", "object": o})
-        self._watchers.append((res, ns, q))
-
-        async def frames():
-            while True:
-                ev = await q.get()
-                if ev is None:
-                    return
-                yield (json.dumps(ev) + "\n").encode()
-
-        return ProxyResponse(
-            status=200,
-            headers={"Content-Type": "application/json",
-                     "Transfer-Encoding": "chunked"},
-            stream=frames(),
-        )
-
-    def emit_watch_event(self, res: str, event_type: str, name: str,
-                         ns: str = "") -> None:
-        """Emit a synthetic watch event for an (existing or ad-hoc) object
-        — lets tests inject upstream events without a write round trip."""
-        obj = self.objects.get((res, ns, name))
-        if obj is None:
-            obj = {"kind": _kind_for(res), "metadata": {"name": name}}
-            if ns:
-                obj["metadata"]["namespace"] = ns
-        obj = json.loads(json.dumps(obj))  # private copy
-        self.rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
-        self._notify(res, ns, {"type": event_type, "object": obj})
-
-    def stop_watches(self):
-        for _, _, q in self._watchers:
-            q.put_nowait(None)
-        self._watchers.clear()
+        return await super().__call__(req)

@@ -78,6 +78,53 @@ def env(tmp_path):
     return str(tmp_path / "dtx.sqlite")
 
 
+def test_demo_stack_end_to_end():
+    """`make demo` wiring (proxy/demo.py): the self-contained stack must
+    serve per-user-isolated lists, gets, and a dual-write create over
+    real HTTP with nothing external."""
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.proxy.demo import build
+
+        cfg = build(port=0)
+        await cfg.run()
+        try:
+            alice = HttpClient(cfg.server.port, "alice")
+            carol = HttpClient(cfg.server.port, "carol")
+
+            async def names(client):
+                status, _, body = await client.request(
+                    "GET", "/api/v1/namespaces")
+                assert status == 200, body
+                return [i["metadata"]["name"]
+                        for i in json.loads(body)["items"]]
+
+            assert await names(alice) == ["dev"]
+            assert await names(carol) == ["prod"]
+            # pods inherit namespace visibility via the arrow
+            status, _, body = await alice.request("GET", "/api/v1/pods")
+            assert status == 200
+            assert [i["metadata"]["namespace"]
+                    for i in json.loads(body)["items"]] == ["dev"]
+            # cross-user get denied; own get allowed
+            status, _, _ = await carol.request(
+                "GET", "/api/v1/namespaces/dev")
+            assert status in (401, 403, 404)
+            status, _, _ = await alice.request(
+                "GET", "/api/v1/namespaces/dev")
+            assert status == 200
+            # dual-write create lands in BOTH the upstream and the graph
+            status, _, body = await alice.request(
+                "POST", "/api/v1/namespaces",
+                body={"metadata": {"name": "mine"}})
+            assert status == 201, body
+            assert await names(alice) == ["dev", "mine"]
+            assert await names(carol) == ["prod"]
+        finally:
+            await cfg.server.stop()
+            await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
 def test_full_http_round_trips(env):
     async def go():
         fake = FakeKube()

@@ -28,6 +28,9 @@ from typing import Optional
 
 import numpy as np
 
+# BASELINE.md north-star target: <50ms p50 list filter on one v5e chip
+BASELINE_TARGET_MS = 50.0
+
 BENCH_SCHEMA = """
 use expiration
 
@@ -503,10 +506,19 @@ def _measure(args, result: dict) -> None:
     # legible: p50_wall_minus_floor_ms is what the framework itself adds,
     # i.e. the wall latency a host-local chip would see (plus ~floor).
     floor = _dispatch_floor_ms()
+    minus_floor = max(p50_wall - floor, 0.0)
     result["dispatch_floor_ms"] = round(floor, 3)
-    result["p50_wall_minus_floor_ms"] = round(max(p50_wall - floor, 0.0), 3)
+    result["p50_wall_minus_floor_ms"] = round(minus_floor, 3)
+    # the 50ms BASELINE target describes chip+framework latency; through a
+    # remote tunnel the raw vs_baseline mostly measures the tunnel, so the
+    # transport-excluded ratio is reported alongside (never as `value`).
+    # Residuals below measurement jitter would publish noise as a huge
+    # ratio, so they report nothing instead.
+    if minus_floor >= 0.25:
+        result["vs_baseline_excl_transport"] = round(
+            BASELINE_TARGET_MS / minus_floor, 2)
     log(f"dispatch floor (no-op jit round trip): {floor:.2f}ms; "
-        f"p50 minus floor = {max(p50_wall - floor, 0.0):.2f}ms")
+        f"p50 minus floor = {minus_floor:.2f}ms")
 
     # The headline value is the MEASURED wall p50 (vs_baseline divides the
     # 50ms BASELINE target by it). The chained-dispatch slope — per-query
@@ -517,7 +529,7 @@ def _measure(args, result: dict) -> None:
         f"1 chip" + (" [DEGRADED: cpu]" if degraded else ""))
     result["value"] = round(p50_wall, 3)
     result["unit"] = "ms"
-    result["vs_baseline"] = round(50.0 / p50_wall, 2)
+    result["vs_baseline"] = round(BASELINE_TARGET_MS / p50_wall, 2)
     result["p50_wall_ms"] = round(p50_wall, 3)
     result["p99_wall_ms"] = round(p99_wall, 3)
 

@@ -14,7 +14,7 @@ import pytest
 
 from spicedb_kubeapi_proxy_tpu.authz import AuthzDeps, authorize
 from spicedb_kubeapi_proxy_tpu.dtx import ActivityHandler, WorkflowEngine, register_workflows
-from spicedb_kubeapi_proxy_tpu.engine import Engine, RelationshipFilter
+from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, RelationshipFilter
 from spicedb_kubeapi_proxy_tpu.proxy.authn import HeaderAuthenticator
 from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
 from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest
@@ -480,6 +480,11 @@ def test_concurrent_watchers_per_user_isolation():
         from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
 
         env = Env()
+        # warm the jitted watch-check kernels before the delivery clock
+        # starts: a cold first compile (up to ~3s on a loaded machine)
+        # made a 5s all-or-nothing wait flaky
+        env.engine.check_bulk([
+            CheckItem("namespace", "warm", "view", "user", "alice")])
         frames = {}
 
         async def consume(user, stream):
@@ -504,13 +509,16 @@ def test_concurrent_watchers_per_user_isolation():
         assert r.status == 201
         env.engine.write_relationships([WriteOp("touch", parse_relationship(
             "namespace:shared#viewer@user:carol"))])
-        await asyncio.wait_for(_wait_for(
-            lambda: frames["alice"] == ["a-ns"]
-            and frames["bob"] == ["b-ns", "shared"]
-            and frames["carol"] == ["c-ns", "shared"]), timeout=5)
-        for t in tasks:
-            t.cancel()
-        env.kube.stop_watches()
+        want = {"alice": ["a-ns"], "bob": ["b-ns", "shared"],
+                "carol": ["c-ns", "shared"]}
+        try:
+            await asyncio.wait_for(
+                _wait_for(lambda: frames == want), timeout=15)
+        finally:
+            for t in tasks:
+                t.cancel()
+            env.kube.stop_watches()
+        assert frames == want  # reports per-user stream contents on failure
     run(go())
 
 

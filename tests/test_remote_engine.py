@@ -90,6 +90,36 @@ def test_remote_round_trips():
     run_with_server(e, fn)
 
 
+def test_remote_large_chunked_check_bulk():
+    """A 40k-item bulk check over tcp:// — the shared-engine-host shape —
+    exercising the chunked device pipeline server-side, the big-frame
+    path client-side, and exact result ordering across chunk bounds."""
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    e = Engine()
+    n_ns, n_users = 40, 25
+    ops = []
+    grants = set()
+    for i in range(n_ns):
+        u = int(rng.integers(n_users))
+        ops.append(f"namespace:n{i}#creator@user:u{u}")
+        grants.add((i, u))
+    e.write_relationships(
+        [WriteOp("touch", parse_relationship(r)) for r in ops])
+
+    items, want = [], []
+    for _ in range(40_000):
+        i, u = int(rng.integers(n_ns)), int(rng.integers(n_users))
+        items.append(CheckItem("namespace", f"n{i}", "view", "user", f"u{u}"))
+        want.append((i, u) in grants)
+
+    async def fn(remote):
+        got = await asyncio.to_thread(remote.check_bulk, items)
+        assert got == want
+    run_with_server(e, fn)
+
+
 def test_remote_error_kinds_round_trip():
     e = Engine()
 

@@ -277,22 +277,79 @@ def test_optimistic_ambiguous_kube_failure_object_absent():
     asyncio.run(run())
 
 
+def seed_pod_delete_by_filter(w: "World") -> "WorkflowInput":
+    """Three pod viewer rels + the kube object, and the deleteByFilter
+    input that removes them — shared by the happy-path and crash-resume
+    variants."""
+    w.engine.write_relationships([
+        WriteOp("touch", parse_relationship(f"pod:ns/p#viewer@user:u{i}"))
+        for i in range(3)
+    ])
+    w.kube.objects[("pods", "ns", "p")] = {
+        "kind": "Pod", "metadata": {"name": "p", "namespace": "ns"}}
+    return WorkflowInput(
+        verb="delete", path="/api/v1/namespaces/ns/pods/p",
+        uri="/api/v1/namespaces/ns/pods/p", headers={},
+        user_name="alice", object_name="p", namespace="ns",
+        api_group="", resource="pods",
+        delete_by_filter=[{"resource_type": "pod", "resource_id": "ns/p"}],
+    )
+
+
+@pytest.mark.parametrize("failpoint", ["panicReadSpiceDB",
+                                       "panicSpiceDBReadRelResp"])
+def test_crash_during_delete_by_filter_read_resumes(tmp_path, failpoint):
+    """Crash inside the ReadRelationships activity (before/after the
+    read) while expanding deleteByFilter: the resumed workflow still
+    deletes the stable concrete set exactly once (reference
+    workflow.go:354-389; failpoints at activity.go:153,155)."""
+    async def run():
+        db = str(tmp_path / f"dbf-{failpoint}.sqlite")
+        w = World(db_path=db)
+        inp = seed_pod_delete_by_filter(w)
+        failpoints.enable(failpoint, 1)
+        iid = await w.runner.create_instance(LOCK_MODE_PESSIMISTIC,
+                                             inp.to_dict())
+        with pytest.raises(asyncio.TimeoutError):
+            await w.runner.get_result(iid, timeout=0.5)
+        w.runner = w.new_runner()
+        await w.runner.resume_pending()
+        out = await w.runner.get_result(iid, timeout=10)
+        assert out["status"] == 200
+        assert not w.engine.store.exists(
+            RelationshipFilter(resource_type="pod"))
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
+def test_crash_during_kube_existence_probe_resumes(tmp_path):
+    """Optimistic arbitration: the kube write fails ambiguously, then the
+    process dies INSIDE the existence probe (failpoint at
+    activity.go:233-247). The resumed workflow re-probes, finds the
+    object absent, and rolls the relationships back."""
+    async def run():
+        db = str(tmp_path / "probe.sqlite")
+        w = World(db_path=db)
+        w.kube.fail_next(n=20, exception=ConnectionError("down"),
+                         method="POST")
+        failpoints.enable("panicCheckKube", 1)
+        iid = await w.runner.create_instance(
+            LOCK_MODE_OPTIMISTIC, ns_create_input().to_dict())
+        with pytest.raises(asyncio.TimeoutError):
+            await w.runner.get_result(iid, timeout=1.0)
+        w.runner = w.new_runner()
+        await w.runner.resume_pending()
+        with pytest.raises(ActivityError):
+            await w.runner.get_result(iid, timeout=10)
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+        assert ("namespaces", "", "team-a") not in w.kube.objects
+    asyncio.run(run())
+
+
 def test_delete_by_filter_expansion():
     async def run():
         w = World()
-        w.engine.write_relationships([
-            WriteOp("touch", parse_relationship(f"pod:ns/p#viewer@user:u{i}"))
-            for i in range(3)
-        ])
-        w.kube.objects[("pods", "ns", "p")] = {
-            "kind": "Pod", "metadata": {"name": "p", "namespace": "ns"}}
-        inp = WorkflowInput(
-            verb="delete", path="/api/v1/namespaces/ns/pods/p",
-            uri="/api/v1/namespaces/ns/pods/p", headers={},
-            user_name="alice", object_name="p", namespace="ns",
-            api_group="", resource="pods",
-            delete_by_filter=[{"resource_type": "pod", "resource_id": "ns/p"}],
-        )
+        inp = seed_pod_delete_by_filter(w)
         iid = await w.runner.create_instance(LOCK_MODE_PESSIMISTIC,
                                              inp.to_dict())
         out = await w.runner.get_result(iid, timeout=10)

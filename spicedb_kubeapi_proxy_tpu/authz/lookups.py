@@ -64,7 +64,13 @@ def single_prefilter(rules: list[RunnableRule]) -> Optional[tuple[RunnableRule, 
 
 
 def run_prefilter_sync(engine: Engine, pf: PreFilter,
-                       input: ResolveInput) -> AllowedSet:
+                       input: ResolveInput,
+                       strict: bool = True) -> AllowedSet:
+    """``strict=False`` skips ids whose name/namespace mapping expression
+    fails instead of raising — for MID-STREAM recomputes, where one
+    unmappable id must not freeze the allowed set (a frozen set fails
+    OPEN for revocations). The initial, pre-headers run stays strict so
+    misconfigured mappings surface as a 500."""
     rel = pf.rel.generate(input)[0]
     if rel.resource_id != MATCHING_ID_FIELD_VALUE:
         raise PreFilterError(
@@ -84,14 +90,19 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
             ns = (pf.namespace_expr.evaluate_str(data)
                   if pf.namespace_expr else "")
         except ExprError as e:
-            raise PreFilterError(f"mapping looked-up id {obj_id!r}: {e}") from None
+            if strict:
+                raise PreFilterError(
+                    f"mapping looked-up id {obj_id!r}: {e}") from None
+            continue
         allowed.add(ns, name)
     return allowed
 
 
 async def run_prefilter(engine: Engine, pf: PreFilter,
-                        input: ResolveInput) -> AllowedSet:
+                        input: ResolveInput,
+                        strict: bool = True) -> AllowedSet:
     """Async wrapper so the device query overlaps the upstream kube request
     (the reference overlaps via goroutine+channel,
     responsefilterer.go:165-183)."""
-    return await asyncio.to_thread(run_prefilter_sync, engine, pf, input)
+    return await asyncio.to_thread(run_prefilter_sync, engine, pf, input,
+                                   strict)

@@ -4,12 +4,14 @@ updates from the engine.
 Mirrors the reference's dual-stream join
 (/root/reference/pkg/authz/watch.go:27-111 and
 responsefilterer.go:434-714): one side consumes relationship-update events
-from the engine (the SpiceDB Watch API role) and re-checks the affected
-objects' permission, mapping object ids to NamespacedNames with the
-prefilter expressions; the other side decodes upstream watch frames,
-passing frames for allowed objects through byte-identical, buffering the
-latest frame of not-yet-allowed objects (flushed on an allow transition,
-dropped on deny).
+from the engine (the SpiceDB Watch API role) and recomputes the allowed
+set — one device query for the WHOLE set per event batch, which also
+catches grants/revocations mediated through arrows and usersets that the
+reference's per-object re-checks of same-type events cannot see; the
+other side decodes upstream watch frames, passing frames for allowed
+objects through byte-identical, buffering the latest frame of
+not-yet-allowed objects (flushed on an allow transition, dropped on
+deny).
 
 The engine side is poll-based (watch_since on the revisioned store log)
 rather than a gRPC stream — same semantics, in-process.
@@ -21,9 +23,9 @@ import asyncio
 import json
 from typing import AsyncIterator, Optional
 
-from ..engine import CheckItem, Engine
+from ..engine import Engine
 from ..rules.compile import PreFilter
-from ..rules.expr import ExprError
+
 from ..rules.input import ResolveInput
 from ..proxy.types import ProxyRequest, ProxyResponse
 from .lookups import AllowedSet, run_prefilter
@@ -36,9 +38,6 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     if upstream_resp.status != 200 or upstream_resp.stream is None:
         return upstream_resp
 
-    rel = pf.rel.generate(input)[0]
-    base_data = input.template_data()
-
     # Capture the revision BEFORE the prefilter snapshot: a grant landing
     # between the two is then re-checked by the event loop (idempotent)
     # instead of being lost. Running the prefilter eagerly (not inside the
@@ -47,17 +46,6 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     # to_thread: a remote (tcp://) engine blocks on a socket.
     start_rev = await asyncio.to_thread(lambda: engine.revision)
     allowed = await run_prefilter(engine, pf, input)
-
-    def map_id(obj_id: str) -> Optional[tuple[str, str]]:
-        data = dict(base_data)
-        data["resourceId"] = obj_id
-        try:
-            name = pf.name_expr.evaluate_str(data)
-            ns = (pf.namespace_expr.evaluate_str(data)
-                  if pf.namespace_expr else "")
-        except ExprError:
-            return None
-        return (ns or "", name)
 
     async def frames() -> AsyncIterator[bytes]:
         last_rev = start_rev
@@ -74,35 +62,29 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
         reader = asyncio.get_running_loop().create_task(read_upstream())
         try:
             while True:
-                # 1) drain permission transitions from the engine log
+                # 1) drain permission transitions from the engine log:
+                # any event batch recomputes the FULL allowed set in one
+                # device query, so grants/revocations mediated through
+                # arrows and usersets (a namespace-level grant changing
+                # pod visibility) move the stream too — per-id re-checks
+                # of same-type events (the reference's model,
+                # watch.go:48-109) cannot see those.
                 events = await asyncio.to_thread(engine.watch_since,
                                                  last_rev)
                 if events:
                     last_rev = max(e.revision for e in events)
-                    ids = sorted({
-                        e.relationship.resource_id for e in events
-                        if e.relationship.resource_type == rel.resource_type
-                    })
-                    if ids:
-                        results = await asyncio.to_thread(engine.check_bulk, [
-                            CheckItem(rel.resource_type, oid,
-                                      rel.resource_relation,
-                                      rel.subject_type, rel.subject_id,
-                                      rel.subject_relation or None)
-                            for oid in ids
-                        ])
-                        for oid, ok in zip(ids, results):
-                            key = map_id(oid)
-                            if key is None:
-                                continue
-                            if ok and key not in allowed.pairs:
-                                allowed.pairs.add(key)
-                                frame = buffered.pop(key, None)
-                                if frame is not None:
-                                    yield frame
-                            elif not ok and key in allowed.pairs:
-                                allowed.pairs.discard(key)
-                                buffered.pop(key, None)
+                    # strict=False: one unmappable id skips that id only —
+                    # aborting the recompute would freeze the allowed set,
+                    # and a frozen set fails OPEN for revocations
+                    fresh = await run_prefilter(engine, pf, input,
+                                                strict=False)
+                    for key in fresh.pairs - allowed.pairs:
+                        frame = buffered.pop(key, None)
+                        if frame is not None:
+                            yield frame
+                    for key in allowed.pairs - fresh.pairs:
+                        buffered.pop(key, None)
+                    allowed.pairs = fresh.pairs
                 # 2) pass through / buffer upstream frames
                 try:
                     frame = frame_q.get_nowait()
@@ -143,7 +125,7 @@ def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
 
     The key space is defined by the PREFILTER's expressions: the grant
     side maps object ids through ``name_expr``/``namespace_expr``
-    (map_id above), so the frame side must key identically — a prefilter
+    (run_prefilter_sync mapping), so the frame side must key identically — a prefilter
     with no namespace expression produces cluster-scoped ("", name) keys,
     and the frame's metadata.namespace must then be ignored rather than
     guessed from the resource name."""

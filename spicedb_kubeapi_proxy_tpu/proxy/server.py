@@ -70,6 +70,7 @@ class Server:
         self.client_ca_configured = client_ca_configured
         self.requestheader_allowed_names = set(requestheader_allowed_names)
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()  # live connection-handler tasks
 
     # -- handler chain -------------------------------------------------------
 
@@ -144,14 +145,41 @@ class Server:
                  "https" if self.ssl_context else "http")
         return self.port
 
-    async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+    async def stop(self, grace: float = 2.0) -> None:
+        """Stop listening and drain connections. Idle streaming handlers
+        (a watch with no traffic) only notice a dead peer on WRITE, so
+        after ``grace`` seconds remaining handlers are cancelled — without
+        this, ``wait_closed()`` blocks forever on any idle watch."""
+        if self._server is None:
+            return
+        self._server.close()
+        # loop until the set is EMPTY: a connection accepted just before
+        # close() has its handler task created but not yet started, so it
+        # registers only during the first grace await — one snapshot would
+        # miss it and wait_closed() (which waits for all connections on
+        # 3.12+) would hang anyway
+        while self._conns:
+            tasks = list(self._conns)
+            _, pending = await asyncio.wait(tasks, timeout=grace)
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            grace = 0.1  # later rounds only sweep late registrants
+        await self._server.wait_closed()
+        self._server = None
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
                                 writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await self._serve_connection_inner(reader, writer)
+        finally:
+            self._conns.discard(task)
+
+    async def _serve_connection_inner(self, reader: asyncio.StreamReader,
+                                      writer: asyncio.StreamWriter) -> None:
         # cert identity is per-connection: resolve once, stamp each request
         peer_user = None
         peer_error: Optional[str] = None

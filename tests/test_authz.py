@@ -410,8 +410,9 @@ def test_watch_namespaced_resource_keys_frames_by_prefilter():
         task = asyncio.ensure_future(consume())
         await asyncio.sleep(0.05)
         assert not frames  # buffered: alice can't view bob's namespace
-        # grant alice view on the pod's namespace -> pod#view via arrow ->
-        # the buffered ADDED frame for (wns, api) must flush
+        # grant alice view on the pod directly (the default bootstrap has
+        # no namespace arrow) -> the buffered ADDED frame for (wns, api)
+        # must flush
         from spicedb_kubeapi_proxy_tpu.engine import WriteOp
         from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
         env.engine.write_relationships([WriteOp("touch", parse_relationship(
@@ -469,6 +470,119 @@ def test_watch_drops_frames_after_revocation_mid_stream():
 async def _wait_for(pred, interval=0.02):
     while not pred():
         await asyncio.sleep(interval)
+
+
+def test_prefilter_strict_vs_lenient_id_mapping():
+    """strict=True (the pre-headers run) raises on an unmappable id;
+    strict=False (mid-stream recomputes) skips only that id — an aborted
+    recompute would freeze the watch's allowed set, which fails OPEN for
+    revocations."""
+    from spicedb_kubeapi_proxy_tpu.authz.lookups import (
+        PreFilterError,
+        run_prefilter_sync,
+    )
+    from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+    from spicedb_kubeapi_proxy_tpu.rules.expr import ExprError
+
+    env = Env()
+    env.engine.write_relationships([
+        WriteOp("touch", parse_relationship("namespace:good#creator@user:a")),
+        WriteOp("touch", parse_relationship("namespace:bad#creator@user:a")),
+    ])
+    info = parse_request_info("GET", "/api/v1/namespaces",
+                              {"watch": ["true"]})
+    from spicedb_kubeapi_proxy_tpu.rules.input import ResolveInput
+    inp = ResolveInput.create(info, UserInfo(name="a"), headers={})
+    from spicedb_kubeapi_proxy_tpu.rules.compile import compile_rule
+    from spicedb_kubeapi_proxy_tpu.rules.proxyrule import parse_rule_configs
+    rule = compile_rule(parse_rule_configs("""
+match: [{apiVersion: v1, resource: namespaces, verbs: [list, watch]}]
+prefilter:
+  - fromObjectIDNameExpr: "{{resourceId}}"
+    lookupMatchingResources:
+      tpl: "namespace:$#view@user:{{user.name}}"
+""")[0])
+    pf = rule.pre_filters[0]
+
+    class FailsOnBad:
+        def evaluate_str(self, data):
+            if data["resourceId"] == "bad":
+                raise ExprError("unmappable id")
+            return data["resourceId"]
+
+    object.__setattr__(pf, "name_expr", FailsOnBad())
+    with pytest.raises(PreFilterError, match="unmappable|mapping"):
+        run_prefilter_sync(env.engine, pf, inp)  # strict default
+    allowed = run_prefilter_sync(env.engine, pf, inp, strict=False)
+    assert allowed.pairs == {("", "good")}  # bad skipped, not fatal
+
+
+def test_watch_flushes_on_arrow_mediated_grant():
+    """A NAMESPACE-level grant makes buffered POD frames flush (pod#view
+    includes namespace->view): the event batch recomputes the full
+    allowed set, catching permission changes the changed relationship's
+    own type never mentions. (The reference's per-object re-check of
+    same-type events misses this — our join is strictly stronger.)
+    Symmetrically, revoking the namespace grant drops subsequent pod
+    frames."""
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+        from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+
+        # the DEFAULT bootstrap has no namespace->view arrow on pods (and
+        # the reference's sample create-pods rule writes the namespace
+        # tuple keyed by bare name, disconnected from namespacedName
+        # checks — our deploy/rules.yaml fixes that): use an arrowed
+        # schema and write the consistently-keyed namespace tuple
+        env = Env(bootstrap="""
+schema: |-
+  definition user {}
+  definition namespace {
+    relation creator: user
+    relation viewer: user
+    permission admin = creator
+    permission view = viewer + creator
+  }
+  definition pod {
+    relation namespace: namespace
+    relation creator: user
+    relation viewer: user
+    permission edit = creator
+    permission view = viewer + creator + namespace->view
+  }
+relationships: ""
+""")
+        await env.create_ns("wa", user="bob")
+        await env.create_pod("wa", "api", user="bob")
+        env.engine.write_relationships([WriteOp("touch", parse_relationship(
+            "pod:wa/api#namespace@namespace:wa"))])
+        resp = await env.request("GET", "/api/v1/pods", user="alice",
+                                 query={"watch": ["true"]})
+        frames = []
+
+        async def consume():
+            async for f in resp.stream:
+                frames.append(json.loads(f)["object"]["metadata"]["name"])
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.05)
+        assert not frames  # buffered: alice can't view bob's namespace
+        # grant at the NAMESPACE level — no pod-type relationship changes
+        env.engine.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:wa#viewer@user:alice"))])
+        await asyncio.wait_for(_wait_for(lambda: frames == ["api"]),
+                               timeout=10)
+        # revoke the namespace grant; a subsequent pod event is dropped
+        env.engine.write_relationships([WriteOp("delete", parse_relationship(
+            "namespace:wa#viewer@user:alice"))])
+        await asyncio.sleep(0.1)  # let the revocation reach the join
+        env.kube.emit_watch_event("pods", "MODIFIED", "api", ns="wa")
+        await asyncio.sleep(0.3)
+        assert frames == ["api"]  # the MODIFIED frame was dropped
+        task.cancel()
+        env.kube.stop_watches()
+    run(go())
 
 
 def test_concurrent_watchers_per_user_isolation():

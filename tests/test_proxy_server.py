@@ -78,6 +78,33 @@ def env(tmp_path):
     return str(tmp_path / "dtx.sqlite")
 
 
+def test_server_stop_drains_idle_watch_connections():
+    """Graceful stop with an idle watch stream open must complete within
+    the grace period: idle streaming handlers never write, so they only
+    notice a dead peer on write — stop() cancels them after the grace
+    instead of blocking in wait_closed() forever."""
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.proxy.demo import build
+
+        cfg = build(port=0)
+        await cfg.run()
+        # open a watch as alice and read just the response headers,
+        # leaving the (idle) stream open
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", cfg.server.port)
+        writer.write(b"GET /api/v1/namespaces?watch=true HTTP/1.1\r\n"
+                     b"Host: x\r\nX-Remote-User: alice\r\n\r\n")
+        await writer.drain()
+        line = await asyncio.wait_for(reader.readline(), timeout=10)
+        assert b"200" in line
+        t0 = asyncio.get_running_loop().time()
+        await asyncio.wait_for(cfg.server.stop(grace=1.0), timeout=10)
+        assert asyncio.get_running_loop().time() - t0 < 8
+        writer.close()
+        await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
 def test_demo_stack_end_to_end():
     """`make demo` wiring (proxy/demo.py): the self-contained stack must
     serve per-user-isolated lists, gets, and a dual-write create over

@@ -17,8 +17,11 @@ deploy/rules.yaml), names are materialized lazily only for allowed ids.
 from __future__ import annotations
 
 import asyncio
+import logging
 from dataclasses import dataclass, field
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 from ..engine import Engine
 from ..rules.compile import PreFilter, RunnableRule
@@ -82,6 +85,7 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
     )
     allowed = AllowedSet()
     base = input.template_data()
+    skipped = 0
     for obj_id in ids:
         data = dict(base)
         data["resourceId"] = obj_id
@@ -93,8 +97,17 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
             if strict:
                 raise PreFilterError(
                     f"mapping looked-up id {obj_id!r}: {e}") from None
+            # fail-closed skip, but never silently: without a log line a
+            # mapping bug surfacing mid-stream would just make objects
+            # vanish from watches with nothing to debug from
+            skipped += 1
+            if skipped == 1:
+                log.warning("prefilter id mapping failed for %r "
+                            "(skipping; fails closed): %s", obj_id, e)
             continue
         allowed.add(ns, name)
+    if skipped > 1:
+        log.warning("prefilter id mapping skipped %d more ids", skipped - 1)
     return allowed
 
 

@@ -24,11 +24,16 @@ import json
 from typing import AsyncIterator, Optional
 
 from ..engine import Engine
+from ..models.schema import relevant_resource_types
 from ..rules.compile import PreFilter
 
 from ..rules.input import ResolveInput
 from ..proxy.types import ProxyRequest, ProxyResponse
 from .lookups import AllowedSet, run_prefilter
+
+# how often watches re-evaluate the allowed set when the schema uses
+# expiring relationships (expiry emits no events; see filtered_watch)
+EXPIRY_RECOMPUTE_INTERVAL = 1.0
 
 
 async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
@@ -47,8 +52,27 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     start_rev = await asyncio.to_thread(lambda: engine.revision)
     allowed = await run_prefilter(engine, pf, input)
 
+    # types whose writes can affect the watched permission: event batches
+    # composed entirely of OTHER types skip the allowed-set recompute
+    # (unrelated write traffic must not cost a device query per watcher).
+    # None (no local schema, e.g. a remote engine) = always recompute.
+    rel = pf.rel.generate(input)[0]
+    schema = getattr(engine, "schema", None)
+    relevant = (relevant_resource_types(schema, rel.resource_type,
+                                        rel.resource_relation)
+                if schema is not None else None)
+    # Expiring tuples revoke at QUERY time and emit no watch event, so
+    # nothing event-driven ever re-evaluates them: schemas using
+    # expiration (and unknown remote schemas) get a periodic recompute
+    # tick. This also fixes a pre-existing gap — before the type gate,
+    # expiry enforcement on watches silently depended on unrelated write
+    # traffic happening to arrive.
+    expiry_interval = (EXPIRY_RECOMPUTE_INTERVAL
+                       if schema is None or schema.use_expiration else None)
+
     async def frames() -> AsyncIterator[bytes]:
         last_rev = start_rev
+        last_recompute = asyncio.get_running_loop().time()
         buffered: dict[tuple, bytes] = {}
         frame_q: asyncio.Queue = asyncio.Queue()
 
@@ -71,13 +95,23 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                 # watch.go:48-109) cannot see those.
                 events = await asyncio.to_thread(engine.watch_since,
                                                  last_rev)
+                need = False
                 if events:
                     last_rev = max(e.revision for e in events)
+                    need = relevant is None or any(
+                        e.relationship.resource_type in relevant
+                        for e in events)
+                now_t = asyncio.get_running_loop().time()
+                if (not need and expiry_interval is not None
+                        and now_t - last_recompute >= expiry_interval):
+                    need = True  # expiring tuples revoke without events
+                if need:
                     # strict=False: one unmappable id skips that id only —
                     # aborting the recompute would freeze the allowed set,
-                    # and a frozen set fails OPEN for revocations
+                    # which fails OPEN for revocations
                     fresh = await run_prefilter(engine, pf, input,
                                                 strict=False)
+                    last_recompute = now_t
                     for key in fresh.pairs - allowed.pairs:
                         frame = buffered.pop(key, None)
                         if frame is not None:

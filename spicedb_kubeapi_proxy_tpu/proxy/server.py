@@ -153,11 +153,13 @@ class Server:
         if self._server is None:
             return
         self._server.close()
-        # loop until the set is EMPTY: a connection accepted just before
-        # close() has its handler task created but not yet started, so it
-        # registers only during the first grace await — one snapshot would
-        # miss it and wait_closed() (which waits for all connections on
-        # 3.12+) would hang anyway
+        # let handler tasks of just-accepted connections start and
+        # register before the emptiness check — they are created by the
+        # accept callback but may not have run yet
+        await asyncio.sleep(0)
+        # loop until the set is EMPTY: late registrants appear during the
+        # grace await, so one snapshot would miss them and wait_closed()
+        # (which waits for all connections on 3.12+) would hang anyway
         while self._conns:
             tasks = list(self._conns)
             _, pending = await asyncio.wait(tasks, timeout=grace)
@@ -166,7 +168,19 @@ class Server:
             if pending:
                 await asyncio.gather(*pending, return_exceptions=True)
             grace = 0.1  # later rounds only sweep late registrants
-        await self._server.wait_closed()
+        # a handler can still register between the loop exit and here;
+        # bound wait_closed and sweep again rather than trusting emptiness
+        while True:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=1.0)
+                break
+            except asyncio.TimeoutError:
+                for t in list(self._conns):
+                    t.cancel()
+                if self._conns:
+                    await asyncio.gather(*list(self._conns),
+                                         return_exceptions=True)
         self._server = None
 
     async def _serve_connection(self, reader: asyncio.StreamReader,

@@ -472,6 +472,114 @@ async def _wait_for(pred, interval=0.02):
         await asyncio.sleep(interval)
 
 
+def test_watch_skips_recompute_for_unrelated_writes(monkeypatch):
+    """Writes to types that cannot affect the watched permission must not
+    cost a device query per watcher: the schema-derived relevant-type set
+    gates the recompute. (The expiry tick is pinned long so only the gate
+    is under test.)"""
+    from spicedb_kubeapi_proxy_tpu.authz import watch as watch_mod
+
+    monkeypatch.setattr(watch_mod, "EXPIRY_RECOMPUTE_INTERVAL", 600.0)
+
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+        from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+        from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+        env = Env()
+        await env.create_ns("sk", user="alice")
+        resp = await env.request("GET", "/api/v1/namespaces", user="alice",
+                                 query={"watch": ["true"]})
+        frames = []
+
+        async def consume():
+            async for f in resp.stream:
+                frames.append(json.loads(f))
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.wait_for(_wait_for(lambda: len(frames) >= 1),
+                               timeout=10)
+        await asyncio.sleep(0.1)  # drain any startup polls
+        lookups0 = metrics.counter("engine_lookups_total").value
+        # lock/workflow writes (the dual-write machinery's own types)
+        # cannot affect namespace#view: no recompute may fire
+        for i in range(3):
+            env.engine.write_relationships([WriteOp(
+                "touch", parse_relationship(
+                    f"lock:unrelated-{i}#workflow@workflow:w{i}"))])
+            await asyncio.sleep(0.05)
+        await asyncio.sleep(0.2)  # several poll ticks
+        assert metrics.counter("engine_lookups_total").value == lookups0, \
+            "unrelated writes triggered allowed-set recomputes"
+        # a RELEVANT write still recomputes and flushes
+        await env.create_ns("sk2", user="bob")
+        env.engine.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:sk2#viewer@user:alice"))])
+        await asyncio.wait_for(_wait_for(lambda: any(
+            f["object"]["metadata"]["name"] == "sk2" for f in frames)),
+            timeout=10)
+        assert metrics.counter("engine_lookups_total").value > lookups0
+        task.cancel()
+        env.kube.stop_watches()
+    run(go())
+
+
+def test_watch_enforces_expiring_grant_without_events(monkeypatch):
+    """An expiring grant revokes at QUERY time with no watch event: the
+    periodic recompute tick must drop post-expiry frames even when no
+    other write ever lands (review finding: the type gate must not starve
+    expiry enforcement — which previously depended on unrelated write
+    traffic arriving at all)."""
+    import time as _time
+
+    from spicedb_kubeapi_proxy_tpu.authz import watch as watch_mod
+    from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+
+    monkeypatch.setattr(watch_mod, "EXPIRY_RECOMPUTE_INTERVAL", 0.05)
+
+    async def go():
+        env = Env(bootstrap="""
+schema: |-
+  use expiration
+
+  definition user {}
+  definition cluster {}
+  definition namespace {
+    relation cluster: cluster
+    relation creator: user
+    relation viewer: user with expiration
+    permission admin = creator
+    permission view = viewer + creator
+  }
+relationships: ""
+""")
+        await env.create_ns("exp", user="bob")
+        env.engine.write_relationships([WriteOp("touch", Relationship(
+            "namespace", "exp", "viewer", "user", "alice",
+            expiration=_time.time() + 0.6))])
+        resp = await env.request("GET", "/api/v1/namespaces", user="alice",
+                                 query={"watch": ["true"]})
+        frames = []
+
+        async def consume():
+            async for f in resp.stream:
+                frames.append(json.loads(f))
+
+        task = asyncio.ensure_future(consume())
+        # while the grant is live, the ADDED frame flows
+        await asyncio.wait_for(_wait_for(lambda: len(frames) >= 1),
+                               timeout=10)
+        # wait past expiry with ZERO further writes, then emit an event
+        await asyncio.sleep(0.9)
+        env.kube.emit_watch_event("namespaces", "MODIFIED", "exp")
+        await asyncio.sleep(0.4)
+        assert len(frames) == 1, "post-expiry frame must be dropped"
+        task.cancel()
+        env.kube.stop_watches()
+    run(go())
+
+
 def test_prefilter_strict_vs_lenient_id_mapping():
     """strict=True (the pre-headers run) raises on an unmappable id;
     strict=False (mid-stream recomputes) skips only that id — an aborted

@@ -246,3 +246,38 @@ def test_review_findings_regressions():
     # Wildcard subject ids still parse as concrete tuples.
     r = parse_relationship("pod:x#viewer@user:*")
     assert r.subject_id == "*"
+
+
+def test_relevant_resource_types():
+    """The schema walk that gates watch recomputes: exactly the types
+    whose writes can affect a permission, through relations, usersets,
+    arrows, and recursive groups; unrelated types excluded."""
+    from spicedb_kubeapi_proxy_tpu.models.schema import (
+        relevant_resource_types,
+    )
+
+    s = parse_schema("""
+definition user {}
+definition team { relation member: user | group#member }
+definition group { relation member: user | group#member }
+definition namespace {
+  relation creator: user
+  relation viewer: group#member
+  permission view = viewer + creator
+}
+definition pod {
+  relation namespace: namespace
+  relation viewer: user
+  permission view = viewer + namespace->view
+}
+definition unrelated { relation owner: user }
+""")
+    assert relevant_resource_types(s, "pod", "view") == {
+        "pod", "namespace", "group"}
+    assert relevant_resource_types(s, "namespace", "view") == {
+        "namespace", "group"}
+    # recursive groups terminate; team is NOT pulled in by pod#view
+    assert relevant_resource_types(s, "group", "member") == {"group"}
+    assert "unrelated" not in relevant_resource_types(s, "pod", "view")
+    # a relation (not permission) target works too
+    assert relevant_resource_types(s, "pod", "viewer") == {"pod"}

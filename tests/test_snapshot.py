@@ -96,6 +96,51 @@ def test_snapshot_watch_relist_contract(tmp_path):
         e2.watch_since(rev - 2)
 
 
+def test_snapshot_round_trip_with_closured_block(tmp_path, monkeypatch):
+    """Save/load with a closured self-pair block: the restored engine
+    re-closes at compile, incremental membership deletes still take the
+    O(block) re-close path, and results stay ground-truth exact."""
+    import spicedb_kubeapi_proxy_tpu.ops.reachability as R
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    monkeypatch.setattr(R, "DENSE_MIN_EDGES", 1)
+    schema = parse_schema("""
+definition user {}
+definition group { relation member: user | group#member }
+definition namespace {
+  relation viewer: group#member
+  permission view = viewer
+}
+""")
+    e = Engine(schema=schema)
+    e.write_relationships([WriteOp("touch", parse_relationship(r)) for r in (
+        "group:leaf#member@user:alice",
+        "group:mid#member@group:leaf#member",
+        "group:root#member@group:mid#member",
+        "namespace:ns#viewer@group:root#member",
+    )])
+    assert any(b.closured for b in e.compiled().blocks)
+    path = str(tmp_path / "closured.npz")
+    e.save_snapshot(path)
+
+    e2 = Engine(schema=schema)
+    e2.load_snapshot(path)
+    cg2 = e2.compiled()
+    assert any(b.closured for b in cg2.blocks), "closure survives restore"
+    item = CheckItem("namespace", "ns", "view", "user", "alice")
+    assert e2.check(item)
+    # incremental delete on the restored engine stays O(block)
+    compiles = metrics.counter("engine_graph_compiles_total").value
+    e2.write_relationships([WriteOp("delete", parse_relationship(
+        "group:mid#member@group:leaf#member"))])
+    assert not e2.check(item)
+    assert metrics.counter("engine_graph_compiles_total").value == compiles
+    # re-add across the snapshot boundary: chain re-forms
+    e2.write_relationships([WriteOp("touch", parse_relationship(
+        "group:mid#member@group:leaf#member"))])
+    assert e2.check(item)
+
+
 def test_snapshot_atomic_overwrite(tmp_path):
     e = build()
     path = str(tmp_path / "graph.npz")

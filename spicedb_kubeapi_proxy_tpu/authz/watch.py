@@ -24,7 +24,6 @@ import json
 from typing import AsyncIterator, Optional
 
 from ..engine import Engine
-from ..models.schema import relevant_resource_types
 from ..rules.compile import PreFilter
 
 from ..rules.input import ResolveInput
@@ -52,23 +51,24 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     start_rev = await asyncio.to_thread(lambda: engine.revision)
     allowed = await run_prefilter(engine, pf, input)
 
-    # types whose writes can affect the watched permission: event batches
-    # composed entirely of OTHER types skip the allowed-set recompute
-    # (unrelated write traffic must not cost a device query per watcher).
-    # None (no local schema, e.g. a remote engine) = always recompute.
+    # The watch gate: (a) types whose writes can affect the watched
+    # permission — event batches composed entirely of OTHER types skip
+    # the allowed-set recompute (unrelated write traffic must not cost a
+    # device query per watcher); (b) whether the schema can expire
+    # grants — expiring tuples revoke at QUERY time with no event, so
+    # such schemas get a periodic recompute tick (this also fixed a
+    # pre-existing gap: expiry enforcement on watches silently depended
+    # on unrelated write traffic arriving at all). Both the in-process
+    # Engine and the tcp:// RemoteEngine expose watch_gate();
+    # (None, True) = recompute on every batch + tick (the safe default).
     rel = pf.rel.generate(input)[0]
-    schema = getattr(engine, "schema", None)
-    relevant = (relevant_resource_types(schema, rel.resource_type,
-                                        rel.resource_relation)
-                if schema is not None else None)
-    # Expiring tuples revoke at QUERY time and emit no watch event, so
-    # nothing event-driven ever re-evaluates them: schemas using
-    # expiration (and unknown remote schemas) get a periodic recompute
-    # tick. This also fixes a pre-existing gap — before the type gate,
-    # expiry enforcement on watches silently depended on unrelated write
-    # traffic happening to arrive.
-    expiry_interval = (EXPIRY_RECOMPUTE_INTERVAL
-                       if schema is None or schema.use_expiration else None)
+    gate = getattr(engine, "watch_gate", None)
+    relevant, uses_expiration = (None, True)
+    if gate is not None:
+        relevant, uses_expiration = await asyncio.to_thread(
+            gate, rel.resource_type, rel.resource_relation)
+    expiry_interval = (EXPIRY_RECOMPUTE_INTERVAL if uses_expiration
+                       else None)
 
     async def frames() -> AsyncIterator[bytes]:
         last_rev = start_rev

@@ -266,6 +266,19 @@ class Engine:
     def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
         return self.check_bulk([item], now=now)[0]
 
+    def watch_gate(self, resource_type: str, name: str
+                   ) -> tuple[frozenset, bool]:
+        """(relevant types, schema uses expiration) for watch streams:
+        the types whose writes can affect ``resource_type#name``
+        (models/schema.py relevant_resource_types), and whether expiring
+        tuples exist at all — watches skip allowed-set recomputes on
+        unrelated write traffic, and only tick periodically for expiry
+        when the schema can actually expire grants."""
+        from ..models.schema import relevant_resource_types
+
+        return (relevant_resource_types(self.schema, resource_type, name),
+                self.schema.use_expiration)
+
     def check_bulk(self, items: list[CheckItem],
                    now: Optional[float] = None) -> list[bool]:
         """CheckBulkPermissions: evaluate all items in one device pass,

@@ -31,6 +31,8 @@ import threading
 from dataclasses import asdict
 from typing import Optional
 
+from ..utils.net import drain_server
+
 from ..models.tuples import Relationship
 from .engine import CheckItem, Engine, SchemaViolation, WatchEvent
 from .store import (
@@ -113,6 +115,7 @@ class EngineServer:
         self.port = port
         self.token = token
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()  # live connection-handler tasks
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
@@ -121,14 +124,26 @@ class EngineServer:
         log.info("engine listening on %s:%d", self.host, self.port)
         return self.port
 
-    async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+    async def stop(self, grace: float = 2.0) -> None:
+        """Stop listening and drain connections (utils/net.py: clients
+        pool idle sockets blocked in _read_frame, which ``wait_closed()``
+        would wait on forever on Python 3.12+)."""
+        if self._server is None:
+            return
+        await drain_server(self._server, self._conns, grace)
+        self._server = None
 
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await self._serve_inner(reader, writer)
+        finally:
+            self._conns.discard(task)
+
+    async def _serve_inner(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
         authed = not self.token
         try:
             while True:
@@ -209,6 +224,11 @@ class EngineServer:
              "rel": _rel_to_dict(e.relationship)}
             for e in self.engine.watch_since(req["revision"])
         ]
+
+    def _op_watch_gate(self, req: dict):
+        types, use_exp = self.engine.watch_gate(
+            req["resource_type"], req["name"])
+        return {"types": sorted(types), "use_expiration": use_exp}
 
     def _op_revision(self, req: dict):
         return self.engine.revision
@@ -406,6 +426,19 @@ class RemoteEngine:
                        _rel_from_dict(d["rel"]))
             for d in self._call("watch_since", revision=revision)
         ]
+
+    def watch_gate(self, resource_type: str, name: str
+                   ) -> tuple[Optional[frozenset], bool]:
+        """Schema-derived recompute gate for watches, fetched from the
+        engine host (which owns the schema). (None, True) against an
+        older host that lacks the op — callers then recompute
+        unconditionally and keep the expiry tick (the safe direction)."""
+        try:
+            r = self._call("watch_gate", resource_type=resource_type,
+                           name=name)
+            return frozenset(r["types"]), bool(r["use_expiration"])
+        except RemoteEngineError:
+            return None, True
 
     @property
     def revision(self) -> int:

@@ -30,6 +30,7 @@ from ..proxy.authn import (
 from ..proxy.requestinfo import parse_request_info
 from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
 from ..utils.metrics import metrics
+from ..utils.net import drain_server
 
 log = logging.getLogger("sdbkp.proxy")
 
@@ -146,41 +147,12 @@ class Server:
         return self.port
 
     async def stop(self, grace: float = 2.0) -> None:
-        """Stop listening and drain connections. Idle streaming handlers
-        (a watch with no traffic) only notice a dead peer on WRITE, so
-        after ``grace`` seconds remaining handlers are cancelled — without
-        this, ``wait_closed()`` blocks forever on any idle watch."""
+        """Stop listening and drain connections (utils/net.py: idle
+        streaming handlers never write, so without the drain
+        ``wait_closed()`` blocks forever on any idle watch)."""
         if self._server is None:
             return
-        self._server.close()
-        # let handler tasks of just-accepted connections start and
-        # register before the emptiness check — they are created by the
-        # accept callback but may not have run yet
-        await asyncio.sleep(0)
-        # loop until the set is EMPTY: late registrants appear during the
-        # grace await, so one snapshot would miss them and wait_closed()
-        # (which waits for all connections on 3.12+) would hang anyway
-        while self._conns:
-            tasks = list(self._conns)
-            _, pending = await asyncio.wait(tasks, timeout=grace)
-            for t in pending:
-                t.cancel()
-            if pending:
-                await asyncio.gather(*pending, return_exceptions=True)
-            grace = 0.1  # later rounds only sweep late registrants
-        # a handler can still register between the loop exit and here;
-        # bound wait_closed and sweep again rather than trusting emptiness
-        while True:
-            try:
-                await asyncio.wait_for(self._server.wait_closed(),
-                                       timeout=1.0)
-                break
-            except asyncio.TimeoutError:
-                for t in list(self._conns):
-                    t.cancel()
-                if self._conns:
-                    await asyncio.gather(*list(self._conns),
-                                         return_exceptions=True)
+        await drain_server(self._server, self._conns, grace)
         self._server = None
 
     async def _serve_connection(self, reader: asyncio.StreamReader,

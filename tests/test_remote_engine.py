@@ -120,6 +120,23 @@ def test_remote_large_chunked_check_bulk():
     run_with_server(e, fn)
 
 
+def test_remote_watch_gate():
+    """The watch recompute gate round-trips from the engine host: type
+    set and use_expiration both carried, so remote watchers skip
+    unrelated recomputes and only expiry-tick when the schema can
+    actually expire grants."""
+    e = Engine()  # DEFAULT_BOOTSTRAP: uses expiration (idempotency keys)
+
+    async def fn(remote):
+        types, use_exp = await asyncio.to_thread(
+            remote.watch_gate, "namespace", "view")
+        assert types == frozenset({"namespace"})
+        assert use_exp is True
+        types, _ = await asyncio.to_thread(remote.watch_gate, "pod", "view")
+        assert types == frozenset({"pod"})
+    run_with_server(e, fn)
+
+
 def test_remote_error_kinds_round_trip():
     e = Engine()
 

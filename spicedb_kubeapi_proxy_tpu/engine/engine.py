@@ -268,16 +268,16 @@ class Engine:
 
     def watch_gate(self, resource_type: str, name: str
                    ) -> tuple[frozenset, bool]:
-        """(relevant types, schema uses expiration) for watch streams:
+        """(relevant types, reachable expiration) for watch streams:
         the types whose writes can affect ``resource_type#name``
-        (models/schema.py relevant_resource_types), and whether expiring
-        tuples exist at all — watches skip allowed-set recomputes on
-        unrelated write traffic, and only tick periodically for expiry
-        when the schema can actually expire grants."""
-        from ..models.schema import relevant_resource_types
+        (models/schema.py watch_relevance), and whether a relation the
+        watched permission can reach allows expiring tuples — watches skip
+        allowed-set recomputes on unrelated write traffic, and only tick
+        periodically for expiry when the WATCHED permission (not just the
+        schema somewhere) can actually lose grants to the clock."""
+        from ..models.schema import watch_relevance
 
-        return (relevant_resource_types(self.schema, resource_type, name),
-                self.schema.use_expiration)
+        return watch_relevance(self.schema, resource_type, name)
 
     def check_bulk(self, items: list[CheckItem],
                    now: Optional[float] = None) -> list[bool]:

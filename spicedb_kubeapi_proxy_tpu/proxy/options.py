@@ -84,6 +84,16 @@ class Options:
     tls_requestheader_allowed_names: list = field(default_factory=list)
     # kube static token file (token,user,uid[,groups]) for Bearer authn
     token_auth_file: Optional[str] = None
+    # OIDC bearer authentication (kube --oidc-* option names; the last of
+    # the reference's four built-in authn modes, authn.go:40-47)
+    oidc_issuer_url: Optional[str] = None
+    oidc_client_id: Optional[str] = None
+    oidc_username_claim: str = "sub"
+    oidc_username_prefix: Optional[str] = None  # "-" disables prefixing
+    oidc_groups_claim: Optional[str] = None
+    oidc_groups_prefix: str = ""
+    oidc_ca_file: Optional[str] = None
+    oidc_signing_algs: str = "RS256"  # comma-separated
     # dual-write
     workflow_database_path: str = DEFAULT_WORKFLOW_DB
     lock_mode: str = LOCK_MODE_PESSIMISTIC
@@ -170,6 +180,21 @@ class Options:
             raise OptionsError(
                 "tls-requestheader-allowed-names requires "
                 "tls-client-ca-file")
+        if self.oidc_issuer_url and not self.oidc_client_id:
+            raise OptionsError("oidc-issuer-url requires oidc-client-id")
+        if not self.oidc_issuer_url and any(
+                x is not None for x in (
+                    self.oidc_client_id, self.oidc_username_prefix,
+                    self.oidc_groups_claim, self.oidc_ca_file)):
+            raise OptionsError(
+                "oidc-* options require oidc-issuer-url")
+        if self.oidc_issuer_url:
+            from .oidc import OIDCError, parse_signing_algs
+
+            try:
+                parse_signing_algs(self.oidc_signing_algs)
+            except OIDCError as e:
+                raise OptionsError(f"oidc-signing-algs: {e}") from None
         if not (self.rule_files or self.rule_content):
             raise OptionsError("at least one rule file is required")
         if self.upstream_url and self.kubeconfig:
@@ -280,12 +305,33 @@ class Options:
                 # health endpoints and get clean 401s on resources
                 # (kube-apiserver semantics) instead of handshake failures
                 ssl_context.verify_mode = ssl.CERT_OPTIONAL
-        token_authenticator = None
+        token_authenticators = []
         if self.token_auth_file:
             from .authn import TokenFileAuthenticator
 
-            token_authenticator = TokenFileAuthenticator(
-                self.token_auth_file)
+            token_authenticators.append(
+                TokenFileAuthenticator(self.token_auth_file))
+        if self.oidc_issuer_url:
+            from .oidc import OIDCAuthenticator, parse_signing_algs
+
+            token_authenticators.append(OIDCAuthenticator(
+                issuer_url=self.oidc_issuer_url,
+                client_id=self.oidc_client_id,
+                username_claim=self.oidc_username_claim,
+                username_prefix=self.oidc_username_prefix,
+                groups_claim=self.oidc_groups_claim,
+                groups_prefix=self.oidc_groups_prefix,
+                ca_file=self.oidc_ca_file,
+                signing_algs=parse_signing_algs(self.oidc_signing_algs),
+            ))
+        token_authenticator = None
+        if len(token_authenticators) == 1:
+            token_authenticator = token_authenticators[0]
+        elif token_authenticators:
+            from .oidc import ChainTokenAuthenticator
+
+            token_authenticator = ChainTokenAuthenticator(
+                token_authenticators)
         server = Server(deps, HeaderAuthenticator(),
                         host=self.bind_host, port=self.bind_port,
                         config_dump=(self.debug_dump()
@@ -373,6 +419,22 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="kube static token file "
                              "(token,user,uid[,\"g1,g2\"]) for Bearer "
                              "authentication")
+    parser.add_argument("--oidc-issuer-url",
+                        help="OIDC issuer URL; enables bearer-JWT "
+                             "authentication against its JWKS")
+    parser.add_argument("--oidc-client-id",
+                        help="audience the token must be issued for")
+    parser.add_argument("--oidc-username-claim", default="sub")
+    parser.add_argument("--oidc-username-prefix",
+                        help="prefix for OIDC usernames; '-' disables; "
+                             "default '<issuer>#' for non-email claims")
+    parser.add_argument("--oidc-groups-claim",
+                        help="claim carrying the user's groups")
+    parser.add_argument("--oidc-groups-prefix", default="")
+    parser.add_argument("--oidc-ca-file",
+                        help="CA bundle for the issuer's HTTPS endpoints")
+    parser.add_argument("--oidc-signing-algs", default="RS256",
+                        help="comma-separated accepted JWS algorithms")
     parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
     parser.add_argument("--snapshot-path",
                         help="relationship-store snapshot file: loaded at "
@@ -420,6 +482,14 @@ def options_from_args(args: argparse.Namespace) -> Options:
         tls_client_ca_file=args.tls_client_ca_file,
         tls_requestheader_allowed_names=args.tls_requestheader_allowed_names,
         token_auth_file=args.token_auth_file,
+        oidc_issuer_url=args.oidc_issuer_url,
+        oidc_client_id=args.oidc_client_id,
+        oidc_username_claim=args.oidc_username_claim,
+        oidc_username_prefix=args.oidc_username_prefix,
+        oidc_groups_claim=args.oidc_groups_claim,
+        oidc_groups_prefix=args.oidc_groups_prefix,
+        oidc_ca_file=args.oidc_ca_file,
+        oidc_signing_algs=args.oidc_signing_algs,
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,

@@ -109,7 +109,11 @@ class Server:
             auth = next((v for k, v in req.headers.items()
                          if k.lower() == "authorization"), "")
             if auth.lower().startswith("bearer "):
-                user = self.token_authenticator.authenticate_token(
+                # to_thread: OIDC verification can do a blocking JWKS
+                # fetch (plus modular-exponentiation work) — neither
+                # belongs on the event loop
+                user = await asyncio.to_thread(
+                    self.token_authenticator.authenticate_token,
                     auth[7:].strip())
                 if user is None:
                     # credentials were presented and are wrong: reject

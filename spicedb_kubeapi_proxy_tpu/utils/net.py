@@ -11,6 +11,7 @@ host (engine/remote.py).
 from __future__ import annotations
 
 import asyncio
+import logging
 
 
 async def drain_server(server: asyncio.AbstractServer, conns: set,
@@ -36,7 +37,7 @@ async def drain_server(server: asyncio.AbstractServer, conns: set,
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
         grace = 0.1  # later rounds only sweep late registrants
-    while True:
+    for sweep in range(10):
         try:
             await asyncio.wait_for(server.wait_closed(), timeout=1.0)
             return
@@ -45,3 +46,8 @@ async def drain_server(server: asyncio.AbstractServer, conns: set,
                 t.cancel()
             if conns:
                 await asyncio.gather(*list(conns), return_exceptions=True)
+    # A handler wedged in non-cancellable work can defeat wait_closed()
+    # forever; after the sweep budget, give up rather than hang stop().
+    logging.getLogger(__name__).warning(
+        "drain_server: wait_closed() unresolved after 10 cancel sweeps; "
+        "abandoning drain with %d handler task(s) still live", len(conns))

@@ -890,6 +890,52 @@ def test_check_bulk_fast_encode_matches_reference_encode():
             it.subject_type, it.subject_id, it.subject_relation, objs), i
 
 
+def test_check_bulk_fast_encode_randomized_parity():
+    """Randomized parity fuzz (advisor r3): _encode_checks hand-inlines
+    encode_target/encode_subject/Interner.lookup semantics for speed; a
+    future change to the canonical encoders must not silently diverge from
+    this hot path. 500 random items over known/unknown types, permissions,
+    relations, object ids, subject relations, and wildcards."""
+    import random
+
+    rng = random.Random(0xC0FFEE)
+    e = make_engine(
+        "namespace:ns1#creator@user:alice",
+        "namespace:ns2#viewer@group:eng#member",
+        "group:eng#member@user:carol",
+        "namespace:open#viewer@user:*",
+        "pod:ns1/api#namespace@namespace:ns1",
+    )
+    types = ["namespace", "pod", "group", "user", "ghost-type"]
+    perms = ["view", "edit", "member", "wat", "creator", "viewer"]
+    ids = ["ns1", "ns2", "open", "eng", "alice", "carol", "ns1/api",
+           "missing", "*", ""]
+    srels = [None, "", "member", "ghost-rel"]
+    items = [
+        CheckItem(rng.choice(types), rng.choice(ids), rng.choice(perms),
+                  rng.choice(types), rng.choice(ids), rng.choice(srels))
+        for _ in range(500)
+    ]
+    cg = e.compiled()
+    # post-compile interned ids: a write between compiled() and
+    # _objects_by_name() interns ids past the compiled type size — both
+    # encoders must agree on the size-overflow (treat-as-void) rule
+    e.store.write([WriteOp("touch", parse_relationship(
+        "namespace:late-ns#creator@user:late-user"))], [])
+    objs = e._objects_by_name()
+    items += [
+        CheckItem("namespace", "late-ns", "view", "user", "alice"),
+        CheckItem("namespace", "ns1", "view", "user", "late-user"),
+    ]
+    seeds, q_slots, q_batch = e._encode_checks(cg, objs, items)
+    for i, it in enumerate(items):
+        assert q_slots[i] == cg.encode_target(
+            it.resource_type, it.permission, it.resource_id, objs), (i, it)
+        assert tuple(seeds[q_batch[i]].tolist()) == cg.encode_subject(
+            it.subject_type, it.subject_id, it.subject_relation, objs), \
+            (i, it)
+
+
 def test_check_bulk_chunked_pipeline_preserves_order(monkeypatch):
     """Bulk checks split into pipelined dispatch chunks must return the
     same per-item results in the same order, including a remainder chunk

@@ -122,18 +122,24 @@ def test_remote_large_chunked_check_bulk():
 
 def test_remote_watch_gate():
     """The watch recompute gate round-trips from the engine host: type
-    set and use_expiration both carried, so remote watchers skip
-    unrelated recomputes and only expiry-tick when the schema can
-    actually expire grants."""
+    set and the expiration flag both carried, so remote watchers skip
+    unrelated recomputes and only expiry-tick when the WATCHED permission
+    can actually expire (the DEFAULT_BOOTSTRAP's expiration lives on the
+    workflow idempotency-key relation, which namespace#view cannot reach
+    — schema-wide `use expiration` must not make it tick)."""
     e = Engine()  # DEFAULT_BOOTSTRAP: uses expiration (idempotency keys)
 
     async def fn(remote):
         types, use_exp = await asyncio.to_thread(
             remote.watch_gate, "namespace", "view")
         assert types == frozenset({"namespace"})
-        assert use_exp is True
+        assert use_exp is False
         types, _ = await asyncio.to_thread(remote.watch_gate, "pod", "view")
         assert types == frozenset({"pod"})
+        # the idempotency-key relation itself IS expiring
+        _, use_exp = await asyncio.to_thread(
+            remote.watch_gate, "workflow", "idempotency_key")
+        assert use_exp is True
     run_with_server(e, fn)
 
 

@@ -281,3 +281,50 @@ definition unrelated { relation owner: user }
     assert "unrelated" not in relevant_resource_types(s, "pod", "view")
     # a relation (not permission) target works too
     assert relevant_resource_types(s, "pod", "viewer") == {"pod"}
+
+
+def test_watch_relevance_scopes_expiration_to_watched_permission():
+    """`with expiration` anywhere in the schema must NOT make every
+    watcher tick (advisor r3): the flag is true only when a relation the
+    watched permission can reach allows expiring tuples."""
+    from spicedb_kubeapi_proxy_tpu.models.schema import watch_relevance
+
+    s = parse_schema("""
+use expiration
+definition user {}
+definition group { relation member: user | group#member }
+definition badge { relation holder: user with expiration }
+definition namespace {
+  relation creator: user
+  relation viewer: group#member
+  permission view = viewer + creator
+}
+definition door {
+  relation badge: badge
+  permission open = badge->holder
+}
+""")
+    assert s.use_expiration  # the schema-wide flag is set...
+    # ...but namespace#view cannot reach badge#holder: no expiry tick
+    types, expires = watch_relevance(s, "namespace", "view")
+    assert types == {"namespace", "group"}
+    assert expires is False
+    # door#open walks badge->holder, which expires
+    types, expires = watch_relevance(s, "door", "open")
+    assert "badge" in types
+    assert expires is True
+    # watching the expiring relation itself
+    _, expires = watch_relevance(s, "badge", "holder")
+    assert expires is True
+    # userset-reached expiring relation: group#member with expiration
+    s2 = parse_schema("""
+use expiration
+definition user {}
+definition group { relation member: user with expiration }
+definition ns {
+  relation viewer: group#member
+  permission view = viewer
+}
+""")
+    _, expires = watch_relevance(s2, "ns", "view")
+    assert expires is True

@@ -55,6 +55,9 @@ class AuthzDeps:
     workflow: Optional[WorkflowEngine] = None
     default_lock_mode: str = LOCK_MODE_PESSIMISTIC
     watch_poll_interval: float = 0.05
+    # shared watch machinery (one event pump per engine, one allowed-set
+    # recompute per (rule, subject) group); created lazily on first watch
+    watch_hub: Optional[object] = None
     # TTL/disk cache for the always-allowed discovery paths (reference
     # disk-cached discovery RESTMapper, server.go:228-243); None = every
     # discovery request hits the upstream
@@ -135,11 +138,17 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
     if info.verb == "watch":
         if pf is None:
             return await deps.upstream(req)
+        if deps.watch_hub is None:
+            from .watchhub import WatchHub
+
+            deps.watch_hub = WatchHub(
+                deps.engine, poll_interval=deps.watch_poll_interval)
         try:
             upstream_resp = await deps.upstream(req)
             return await filtered_watch(
                 deps.engine, upstream_resp, pf[1], input,
-                poll_interval=deps.watch_poll_interval)
+                poll_interval=deps.watch_poll_interval,
+                hub=deps.watch_hub)
         except (PreFilterError, ExprError) as e:
             return kube_status(500, f"watch prefilter: {e}")
 

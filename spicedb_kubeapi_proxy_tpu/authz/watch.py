@@ -13,8 +13,12 @@ objects through byte-identical, buffering the latest frame of
 not-yet-allowed objects (flushed on an allow transition, dropped on
 deny).
 
-The engine side is poll-based (watch_since on the revisioned store log)
-rather than a gRPC stream — same semantics, in-process.
+The engine side rides the shared :class:`~.watchhub.WatchHub`: one event
+pump per engine (store-condition push in-process, server-push stream for
+``tcp://`` hosts — no polling) and ONE allowed-set recompute per distinct
+(prefilter rule, subject) group per relevant event batch, fanned out to
+every watcher in the group. The per-watcher loop below sleeps on a single
+queue carrying both upstream frames and hub updates — zero idle wakeups.
 """
 
 from __future__ import annotations
@@ -29,124 +33,102 @@ from ..rules.compile import PreFilter
 from ..rules.input import ResolveInput
 from ..proxy.types import ProxyRequest, ProxyResponse
 from .lookups import AllowedSet, run_prefilter
-
-# how often watches re-evaluate the allowed set when the schema uses
-# expiring relationships (expiry emits no events; see filtered_watch)
-EXPIRY_RECOMPUTE_INTERVAL = 1.0
+from .watchhub import EXPIRY_RECOMPUTE_INTERVAL, WatchHub  # noqa: F401
+# (EXPIRY_RECOMPUTE_INTERVAL re-exported: tests and older callers patch it
+# through this module; the hub reads it at group creation)
 
 
 async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                          pf: PreFilter, input: ResolveInput,
-                         poll_interval: float = 0.05) -> ProxyResponse:
+                         poll_interval: float = 0.05,
+                         hub: Optional[WatchHub] = None) -> ProxyResponse:
     """Wrap an upstream watch response with permission filtering."""
     if upstream_resp.status != 200 or upstream_resp.stream is None:
         return upstream_resp
 
-    # Capture the revision BEFORE the prefilter snapshot: a grant landing
-    # between the two is then re-checked by the event loop (idempotent)
-    # instead of being lost. Running the prefilter eagerly (not inside the
-    # streaming generator) also lets PreFilterError surface as a 500 before
-    # the 200/chunked headers are committed. Engine calls go through
-    # to_thread: a remote (tcp://) engine blocks on a socket.
-    start_rev = await asyncio.to_thread(lambda: engine.revision)
-    allowed = await run_prefilter(engine, pf, input)
+    # A private hub for direct callers (tests); the middleware passes the
+    # proxy-wide hub so recomputes are shared across watchers.
+    if hub is None:
+        hub = WatchHub(engine, poll_interval)
 
-    # The watch gate: (a) types whose writes can affect the watched
-    # permission — event batches composed entirely of OTHER types skip
-    # the allowed-set recompute (unrelated write traffic must not cost a
-    # device query per watcher); (b) whether the schema can expire
-    # grants — expiring tuples revoke at QUERY time with no event, so
-    # such schemas get a periodic recompute tick (this also fixed a
-    # pre-existing gap: expiry enforcement on watches silently depended
-    # on unrelated write traffic arriving at all). Both the in-process
-    # Engine and the tcp:// RemoteEngine expose watch_gate();
-    # (None, True) = recompute on every batch + tick (the safe default).
-    rel = pf.rel.generate(input)[0]
-    gate = getattr(engine, "watch_gate", None)
-    relevant, uses_expiration = (None, True)
-    if gate is not None:
-        relevant, uses_expiration = await asyncio.to_thread(
-            gate, rel.resource_type, rel.resource_relation)
-    expiry_interval = (EXPIRY_RECOMPUTE_INTERVAL if uses_expiration
-                       else None)
+    # Register with the hub BEFORE the initial snapshot: the pump anchors
+    # at a revision <= the snapshot's, so a grant landing between the two
+    # is re-checked by a recompute (idempotent) instead of being lost.
+    # Running the prefilter eagerly (not inside the streaming generator)
+    # also lets PreFilterError surface as a 500 before the 200/chunked
+    # headers are committed.
+    handle = await hub.register(pf, input)
+    try:
+        allowed = await run_prefilter(engine, pf, input)
+    except BaseException:
+        await hub.unregister(handle)
+        raise
 
     async def frames() -> AsyncIterator[bytes]:
-        last_rev = start_rev
-        last_recompute = asyncio.get_running_loop().time()
+        nonlocal allowed
         buffered: dict[tuple, bytes] = {}
-        frame_q: asyncio.Queue = asyncio.Queue()
+        # frames held while a recompute covering an earlier event batch is
+        # in flight — a revoked object's frame must be judged against the
+        # POST-event allowed set, not race the device query (("pending")
+        # markers from the hub; same ordering the old per-watcher loop
+        # got by draining events before frames)
+        held: list[bytes] = []
+        waiting_for = 0  # highest pending seq seen
+        applied = 0  # highest seq a received allowed set covers
+        q = handle.queue  # hub updates AND upstream frames land here
 
         async def read_upstream():
             try:
                 async for chunk in upstream_resp.stream:
-                    frame_q.put_nowait(chunk)
+                    q.put_nowait(("frame", chunk))
             finally:
-                frame_q.put_nowait(None)
+                q.put_nowait(("frame", None))
+
+        def emit(frame: bytes) -> Optional[bytes]:
+            key = _frame_object_key(frame, pf)
+            if key is None or allowed.allows(*key):
+                return frame  # byte-identical passthrough
+            buffered[key] = frame
+            return None
 
         reader = asyncio.get_running_loop().create_task(read_upstream())
         try:
             while True:
-                # 1) drain permission transitions from the engine log:
-                # any event batch recomputes the FULL allowed set in one
-                # device query, so grants/revocations mediated through
-                # arrows and usersets (a namespace-level grant changing
-                # pod visibility) move the stream too — per-id re-checks
-                # of same-type events (the reference's model,
-                # watch.go:48-109) cannot see those.
-                events = await asyncio.to_thread(engine.watch_since,
-                                                 last_rev)
-                need = False
-                if events:
-                    last_rev = max(e.revision for e in events)
-                    need = relevant is None or any(
-                        e.relationship.resource_type in relevant
-                        for e in events)
-                now_t = asyncio.get_running_loop().time()
-                if (not need and expiry_interval is not None
-                        and now_t - last_recompute >= expiry_interval):
-                    need = True  # expiring tuples revoke without events
-                if need:
-                    # strict=False: one unmappable id skips that id only —
-                    # aborting the recompute would freeze the allowed set,
-                    # which fails OPEN for revocations
-                    fresh = await run_prefilter(engine, pf, input,
-                                                strict=False)
-                    last_recompute = now_t
+                item = await q.get()
+                kind = item[0]
+                if kind == "frame":
+                    frame = item[1]
+                    if frame is None:
+                        return  # upstream ended
+                    if waiting_for > applied:
+                        held.append(frame)
+                        continue
+                    out = emit(frame)
+                    if out is not None:
+                        yield out
+                elif kind == "pending":
+                    waiting_for = max(waiting_for, item[1])
+                elif kind == "allowed":
+                    fresh: AllowedSet = item[1]
                     for key in fresh.pairs - allowed.pairs:
                         frame = buffered.pop(key, None)
                         if frame is not None:
                             yield frame
                     for key in allowed.pairs - fresh.pairs:
                         buffered.pop(key, None)
-                    allowed.pairs = fresh.pairs
-                # 2) pass through / buffer upstream frames
-                try:
-                    frame = frame_q.get_nowait()
-                    if frame is None:
-                        return
-                    key = _frame_object_key(frame, pf)
-                    if key is None or allowed.allows(*key):
-                        yield frame  # byte-identical passthrough
-                    else:
-                        buffered[key] = frame
-                    continue  # drain frames eagerly before next poll
-                except asyncio.QueueEmpty:
-                    pass
-                # idle: wait for a frame or the next poll tick
-                try:
-                    frame = await asyncio.wait_for(frame_q.get(),
-                                                   timeout=poll_interval)
-                    if frame is None:
-                        return
-                    key = _frame_object_key(frame, pf)
-                    if key is None or allowed.allows(*key):
-                        yield frame
-                    else:
-                        buffered[key] = frame
-                except asyncio.TimeoutError:
-                    continue
+                    allowed = fresh
+                    applied = max(applied, item[2])
+                    if applied >= waiting_for and held:
+                        for frame in held:
+                            out = emit(frame)
+                            if out is not None:
+                                yield out
+                        held = []
+                else:  # "error": shared recompute or event pump died —
+                    return  # end the stream; the client re-lists+rewatches
         finally:
             reader.cancel()
+            await hub.unregister(handle)
 
     return ProxyResponse(status=200, headers=dict(upstream_resp.headers),
                          stream=frames())

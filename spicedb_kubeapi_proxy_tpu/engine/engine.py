@@ -528,6 +528,16 @@ class Engine:
             for r in self.store.watch_since(revision)
         ]
 
+    def wait_events(self, revision: int, timeout: float) -> list[WatchEvent]:
+        """Block until events past ``revision`` land (or ``timeout`` — then
+        ``[]``). The push-latency form of :meth:`watch_since`: the watch
+        hub parks ONE thread here per engine instead of every watcher
+        polling on an interval."""
+        return [
+            WatchEvent(r.revision, "touch" if r.op == 2 else "delete", r.rel)
+            for r in self.store.wait_since(revision, timeout)
+        ]
+
     # -- debugging ----------------------------------------------------------
 
     def oracle(self, now: Optional[float] = None) -> OracleEvaluator:

@@ -9,14 +9,25 @@ same engine API remotely — ``EngineServer`` wraps an in-process
 surface the proxy consumes (check_bulk, lookup_resources,
 write/read/delete relationships, watch_since, revision, store.exists).
 
-Protocol: 4-byte big-endian length-prefixed JSON frames.
-    request:  {"op": str, "token": str?, ...args}
-    response: {"ok": true, "result": ...}
-            | {"ok": false, "kind": str, "error": str}
+Protocol: 4-byte big-endian length-prefixed frames.
+    request:  JSON {"op": str, "token": str?, ...args}
+    response: JSON {"ok": true, "result": ...}
+            | JSON {"ok": false, "kind": str, "error": str}
+            | binary: 0x00 byte + 4-byte meta length + meta JSON + payload
 Errors round-trip by kind so precondition failures and schema violations
 keep their meaning across the wire (the dual-write activities branch on
 them). Transport security is left to the surrounding infrastructure; a
 shared bearer token gates requests like the reference's token option.
+
+The binary response form exists for the list-filter hot path: the
+``lookup_mask`` op returns the allowed set as a PACKED BITMASK over the
+resource type's interned object space (~12.5 KB at 100k objects) instead
+of a multi-MB JSON id list, mirroring how the reference streams
+LookupResources over gRPC rather than materializing strings
+(/root/reference/pkg/authz/lookups.go:74). Mask indices resolve through a
+client-side id table synced INCREMENTALLY via ``object_ids`` (interners
+are append-only within a store epoch; a snapshot restore mints a new
+epoch and invalidates client caches).
 """
 
 from __future__ import annotations
@@ -86,6 +97,50 @@ def _pack(msg: dict) -> bytes:
     return struct.pack(">I", len(body)) + body
 
 
+class BinaryResult:
+    """An op result carried as a binary frame (meta JSON + raw payload)
+    instead of the normal ``{"ok": true, "result": ...}`` JSON."""
+
+    __slots__ = ("meta", "payload")
+
+    def __init__(self, meta: dict, payload: bytes):
+        self.meta = meta
+        self.payload = payload
+
+
+def _pack_binary(res: BinaryResult) -> bytes:
+    # a leading NUL distinguishes binary frames: JSON bodies always start
+    # with '{'
+    meta = json.dumps(res.meta).encode()
+    body = b"\x00" + struct.pack(">I", len(meta)) + meta + res.payload
+    return struct.pack(">I", len(body)) + body
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionResetError("engine connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_frame_sync(s: socket.socket):
+    """Blocking read of one response frame off a socket: a parsed JSON
+    dict, or ``(meta, payload)`` for binary frames. The ONE place client-
+    side framing lives (request path and watch push stream both use it)."""
+    header = _recv_exact(s, 4)
+    (n,) = struct.unpack(">I", header)
+    if n > MAX_FRAME:
+        raise RemoteEngineError(f"frame of {n} bytes exceeds limit")
+    body = _recv_exact(s, n)
+    if body[:1] == b"\x00":
+        (m,) = struct.unpack(">I", body[1:5])
+        return json.loads(body[5:5 + m]), body[5 + m:]
+    return json.loads(body)
+
+
 async def _read_frame(reader: asyncio.StreamReader,
                       limit: int = MAX_FRAME) -> Optional[dict]:
     try:
@@ -152,10 +207,21 @@ class EngineServer:
                 if req is None:
                     return
                 resp = await self._dispatch(req)
-                if resp.get("ok") or resp.get("kind") != "auth":
+                if isinstance(resp, BinaryResult):
                     authed = True
-                writer.write(_pack(resp))
+                    writer.write(_pack_binary(resp))
+                else:
+                    if resp.get("ok") or resp.get("kind") != "auth":
+                        authed = True
+                    writer.write(_pack(resp))
                 await writer.drain()
+                if not isinstance(resp, BinaryResult) and resp.get("ok") \
+                        and req.get("op") == "watch_subscribe":
+                    # the ack is out; the connection now becomes a
+                    # one-way server-push event stream
+                    await self._push_events(writer,
+                                            int(req["from_revision"]))
+                    return
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         except Exception:
@@ -178,6 +244,8 @@ class EngineServer:
                 return {"ok": False, "kind": "proto",
                         "error": f"unknown op {op!r}"}
             result = await asyncio.to_thread(fn, req)
+            if isinstance(result, BinaryResult):
+                return result
             return {"ok": True, "result": result}
         except PreconditionFailed as e:
             return {"ok": False, "kind": "precondition", "error": str(e)}
@@ -201,6 +269,47 @@ class EngineServer:
             req["subject_id"], req.get("subject_relation"),
             now=req.get("now"))
 
+    def _op_lookup_mask(self, req: dict):
+        """The hot-path variant: packed bitmask over the type's object
+        index space (see module docstring). ~12.5 KB at 100k objects."""
+        import numpy as np
+
+        for _ in range(3):
+            # bracket the query with epoch reads: a concurrent snapshot
+            # restore between them would otherwise stamp OLD-interner mask
+            # indices with the NEW epoch — exactly the aliasing the epoch
+            # exists to prevent (the client would resolve wrong names)
+            epoch = self.engine.store.epoch
+            mask, interner = self.engine.lookup_resources_mask(
+                req["resource_type"], req["permission"],
+                req["subject_type"], req["subject_id"],
+                req.get("subject_relation"), now=req.get("now"))
+            if self.engine.store.epoch != epoch:
+                continue
+            if mask is None:
+                return {"found": False}
+            return BinaryResult(
+                {"found": True, "n": int(mask.size), "gen": len(interner),
+                 "epoch": epoch},
+                np.packbits(mask).tobytes())
+        raise StoreError("store epoch kept changing during lookup")
+
+    def _op_object_ids(self, req: dict):
+        """Incremental id-table sync: strings interned at or past ``from``
+        for a resource type. Append-only within an epoch, so clients fetch
+        only the delta."""
+        store = self.engine.store
+        with store._lock:
+            epoch = store.epoch
+            tid = store.types.lookup(req["type"])
+            it = store.objects.get(tid) if tid is not None else None
+            if it is None:
+                return {"epoch": epoch, "gen": 0, "ids": []}
+            strings = it.strings()
+        start = max(0, int(req.get("from", 0)))
+        return {"epoch": epoch, "gen": len(strings),
+                "ids": strings[start:]}
+
     def _op_write_relationships(self, req: dict):
         ops = [WriteOp(o["op"], _rel_from_dict(o["rel"]))
                for o in req["ops"]]
@@ -217,6 +326,44 @@ class EngineServer:
     def _op_read_relationships(self, req: dict):
         return [_rel_to_dict(r) for r in self.engine.read_relationships(
             _filter_from_dict(req["filter"]))]
+
+    # seconds between keepalive frames on an idle push stream (lets the
+    # client distinguish "no events" from a dead peer)
+    PUSH_HEARTBEAT = 15.0
+
+    def _op_watch_subscribe(self, req: dict):
+        """Ack only — _serve_inner switches the connection into the push
+        loop after this response is written (reference watches are a
+        long-lived server-push stream, pkg/authz/watch.go:29)."""
+        int(req["from_revision"])  # validate now, fail as a JSON error
+        return {"subscribed": True, "revision": self.engine.revision}
+
+    async def _push_events(self, writer: asyncio.StreamWriter,
+                           from_rev: int) -> None:
+        """Server-push loop: block on the store's revision condition (in a
+        worker thread) and write each event batch as it lands — no
+        client polling, grant/revoke latency = write latency + one
+        one-way trip. Heartbeats mark liveness on idle streams."""
+        rev = from_rev
+        while True:
+            try:
+                events = await asyncio.to_thread(
+                    self.engine.wait_events, rev, self.PUSH_HEARTBEAT)
+            except StoreError as e:
+                writer.write(_pack({"ok": False, "push": True,
+                                    "kind": "store", "error": str(e)}))
+                await writer.drain()
+                return
+            if events:
+                rev = max(e.revision for e in events)
+            writer.write(_pack({
+                "ok": True, "push": True, "revision": rev,
+                "events": [
+                    {"revision": e.revision, "operation": e.operation,
+                     "rel": _rel_to_dict(e.relationship)}
+                    for e in events
+                ]}))
+            await writer.drain()
 
     def _op_watch_since(self, req: dict):
         return [
@@ -238,6 +385,78 @@ class EngineServer:
 
 
 # -- client ------------------------------------------------------------------
+
+
+class RemoteWatchStream:
+    """Client end of a server-push watch subscription: a DEDICATED socket
+    (never pooled) on which the engine host pushes event batches.
+    ``next_batch()`` blocks until a batch, heartbeat (``[]``), or error.
+    Zero steady-state request traffic — the reference's long-lived gRPC
+    watch stream shape (pkg/authz/watch.go:29)."""
+
+    def __init__(self, client: "RemoteEngine", from_revision: int):
+        self._s = client._connect()
+        # heartbeats arrive every PUSH_HEARTBEAT; anything slower means a
+        # dead peer, not an idle stream
+        self._s.settimeout(EngineServer.PUSH_HEARTBEAT * 3 + 5.0)
+        msg = {"op": "watch_subscribe", "from_revision": from_revision}
+        if client.token:
+            msg["token"] = client.token
+        try:
+            self._s.sendall(_pack(msg))
+            ack = self._read()
+        except Exception:
+            self._s.close()
+            raise
+        if isinstance(ack, tuple) or not ack.get("ok"):
+            self._s.close()
+            kind = ack.get("kind", "internal") if isinstance(ack, dict) \
+                else "proto"
+            err = ack.get("error", "") if isinstance(ack, dict) else ""
+            raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
+        self.revision = ack["result"]["revision"]
+
+    def _read(self):
+        return _read_frame_sync(self._s)
+
+    def next_batch(self) -> list:
+        """Blocks for the next pushed frame; ``[]`` is a liveness
+        heartbeat. Raises the mapped error kind when the server ends the
+        stream (e.g. trimmed watch history -> StoreError)."""
+        frame = self._read()
+        if not frame.get("ok"):
+            raise _ERROR_KINDS.get(frame.get("kind", "internal"),
+                                   RemoteEngineError)(frame.get("error", ""))
+        events = [
+            WatchEvent(d["revision"], d["operation"],
+                       _rel_from_dict(d["rel"]))
+            for d in frame.get("events", [])
+        ]
+        if events:
+            self.revision = max(e.revision for e in events)
+        return events
+
+    def close(self) -> None:
+        try:
+            self._s.close()
+        except OSError:
+            pass
+
+
+class RemoteInterner:
+    """Client-side id→string view over a synced table; the sliver of the
+    Interner surface the lookup paths touch."""
+
+    __slots__ = ("_strings",)
+
+    def __init__(self, strings: list[str]):
+        self._strings = strings
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def string(self, i: int) -> str:
+        return self._strings[i]
 
 
 class _StoreShim:
@@ -272,6 +491,10 @@ class RemoteEngine:
         self._pool_lock = threading.Lock()
         self._pool_size = pool_size
         self.store = _StoreShim(self)
+        # per-type id tables synced from the engine host (append-only
+        # within a store epoch): type -> (epoch, [strings])
+        self._ids_lock = threading.Lock()
+        self._ids: dict[str, tuple[str, list[str]]] = {}
 
     # -- transport ----------------------------------------------------------
 
@@ -325,6 +548,15 @@ class RemoteEngine:
             self._pool.clear()
 
     def _call(self, op: str, **args):
+        r = self._call_any(op, **args)
+        if isinstance(r, tuple):
+            raise RemoteEngineError(
+                f"op {op!r} unexpectedly returned a binary frame")
+        return r
+
+    def _call_any(self, op: str, **args):
+        """Like ``_call`` but passes binary responses through as a
+        ``(meta, payload)`` tuple."""
         msg = {"op": op, **args}
         if self.token:
             msg["token"] = self.token
@@ -350,32 +582,21 @@ class RemoteEngine:
             s.close()
             raise
         self._release(s)
+        if isinstance(resp, tuple):
+            return resp  # (meta, payload) binary response
         if resp.get("ok"):
             return resp.get("result")
         kind = resp.get("kind", "internal")
         err = resp.get("error", "")
         raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
 
-    def _round_trip(self, s: socket.socket, payload: bytes) -> dict:
+    def _round_trip(self, s: socket.socket, payload: bytes):
         s.sendall(payload)
         return self._read_response(s)
 
-    def _read_response(self, s: socket.socket) -> dict:
-        header = self._recv_exact(s, 4)
-        (n,) = struct.unpack(">I", header)
-        if n > MAX_FRAME:
-            raise RemoteEngineError(f"frame of {n} bytes exceeds limit")
-        return json.loads(self._recv_exact(s, n))
-
-    @staticmethod
-    def _recv_exact(s: socket.socket, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = s.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionResetError("engine connection closed")
-            buf.extend(chunk)
-        return bytes(buf)
+    def _read_response(self, s: socket.socket):
+        """A JSON response dict, or (meta, payload) for binary frames."""
+        return _read_frame_sync(s)
 
     # -- engine surface ------------------------------------------------------
 
@@ -393,11 +614,81 @@ class RemoteEngine:
                          subject_type: str, subject_id: str,
                          subject_relation: Optional[str] = None,
                          now: Optional[float] = None) -> list:
-        return self._call(
-            "lookup_resources", resource_type=resource_type,
-            permission=permission, subject_type=subject_type,
-            subject_id=subject_id, subject_relation=subject_relation,
-            now=now)
+        """Materialize allowed id strings from the mask wire (one ~12.5KB
+        frame + an amortized id-table delta, not a multi-MB JSON list);
+        falls back to the JSON op against hosts predating lookup_mask."""
+        try:
+            mask, interner = self.lookup_resources_mask(
+                resource_type, permission, subject_type, subject_id,
+                subject_relation, now=now)
+        except RemoteEngineError:
+            return self._call(
+                "lookup_resources", resource_type=resource_type,
+                permission=permission, subject_type=subject_type,
+                subject_id=subject_id, subject_relation=subject_relation,
+                now=now)
+        if mask is None:
+            return []
+        import numpy as np
+
+        return [interner.string(i) for i in np.flatnonzero(mask).tolist()
+                if i < len(interner)]
+
+    def lookup_resources_mask(self, resource_type: str, permission: str,
+                              subject_type: str, subject_id: str,
+                              subject_relation: Optional[str] = None,
+                              now: Optional[float] = None):
+        """(bool mask over the type's object index space, id view) — the
+        same vectorized surface the in-process engine exposes
+        (engine.py lookup_resources_mask), over the binary wire."""
+        import numpy as np
+
+        for _ in range(3):
+            r = self._call_any(
+                "lookup_mask", resource_type=resource_type,
+                permission=permission, subject_type=subject_type,
+                subject_id=subject_id, subject_relation=subject_relation,
+                now=now)
+            if not isinstance(r, tuple):
+                return None, None  # {"found": False}
+            meta, payload = r
+            mask = np.unpackbits(
+                np.frombuffer(payload, dtype=np.uint8),
+                count=meta["n"]).astype(bool)
+            interner = self._sync_ids(resource_type, meta["gen"],
+                                      meta["epoch"])
+            if interner is not None:
+                return mask, interner
+            # epoch changed between the mask and the id sync (snapshot
+            # restore on the host): mask indices and table disagree —
+            # retry the whole query against the new epoch
+        raise RemoteEngineError(
+            "engine host epoch kept changing during lookup")
+
+    def _sync_ids(self, rtype: str, gen: int,
+                  epoch: str) -> Optional[RemoteInterner]:
+        """Bring the cached id table for ``rtype`` up to ``gen`` within
+        ``epoch``; None when the host reports a DIFFERENT epoch (caller
+        retries). Only the missing tail rides the wire."""
+        with self._ids_lock:
+            cached = self._ids.get(rtype)
+            strings = list(cached[1]) if cached and cached[0] == epoch \
+                else []
+        if len(strings) < gen:
+            r = self._call("object_ids", type=rtype, **{"from": len(strings)})
+            if r["epoch"] != epoch:
+                with self._ids_lock:
+                    # the delta we fetched belongs to ANOTHER epoch's
+                    # table; drop the cache so the retry resyncs from 0
+                    self._ids.pop(rtype, None)
+                return None
+            strings.extend(r["ids"])
+            with self._ids_lock:
+                have = self._ids.get(rtype)
+                if have is None or have[0] != epoch or \
+                        len(have[1]) < len(strings):
+                    self._ids[rtype] = (epoch, strings)
+        return RemoteInterner(strings)
 
     def write_relationships(self, ops: list,
                             preconditions: list = ()) -> int:
@@ -426,6 +717,12 @@ class RemoteEngine:
                        _rel_from_dict(d["rel"]))
             for d in self._call("watch_since", revision=revision)
         ]
+
+    def watch_push_stream(self, from_revision: int) -> RemoteWatchStream:
+        """Open a server-push event subscription (dedicated connection).
+        The watch hub prefers this over polling ``watch_since`` — zero
+        steady-state request traffic per engine (not per watcher)."""
+        return RemoteWatchStream(self, from_revision)
 
     def watch_gate(self, resource_type: str, name: str
                    ) -> tuple[Optional[frozenset], bool]:

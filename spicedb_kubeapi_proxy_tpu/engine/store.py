@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -257,6 +258,11 @@ class Store:
 
     def __init__(self):
         self._lock = threading.RLock()
+        # interner epoch: interners only ever APPEND within an epoch, so a
+        # remote client may cache id->string tables keyed on (epoch, len)
+        # and sync deltas; load() rebuilds the interners and MUST mint a
+        # new epoch or cached mappings would silently alias new ids
+        self.epoch = uuid.uuid4().hex
         self.types = Interner()
         # relation id 0 reserved for "no subject relation"
         self.relations = Interner(reserved=("",))
@@ -265,6 +271,9 @@ class Store:
         self._alive: list[np.ndarray] = []  # bool per chunk
         self._index = StoreIndex()
         self._prebuild_thread: Optional[threading.Thread] = None
+        # revision-advance signal: wait_since() blocks on this instead of
+        # polling, so watch consumers see writes at notify latency
+        self._watch_cond = threading.Condition(self._lock)
         self.revision = 0
         # highest revision whose changes are NOT in the watch log
         # (bulk_load / snapshot restore) — incremental graph updates can
@@ -464,6 +473,7 @@ class Store:
                 self._append_rows(cols)
             self._trim_watch_log()
             self.revision = rev
+            self._watch_cond.notify_all()
             return rev
 
     def bulk_load(self, rels_cols: dict) -> int:
@@ -504,6 +514,7 @@ class Store:
             self._append_rows(Columns(rt, rid, rl, st, sid, srl, exp))
             self.revision += 1
             self.unlogged_revision = self.revision
+            self._watch_cond.notify_all()
             self._start_index_prebuild()
             return self.revision
 
@@ -565,6 +576,7 @@ class Store:
             if count:
                 self._trim_watch_log()
                 self.revision = rev
+                self._watch_cond.notify_all()
             return count
 
     def _trim_watch_log(self) -> None:
@@ -573,6 +585,24 @@ class Store:
             drop = len(self._watch_log) // 2
             self._watch_oldest_rev = self._watch_log[drop - 1].revision
             del self._watch_log[:drop]
+
+    def wake_waiters(self) -> None:
+        """Release every thread parked in :meth:`wait_since` (they return
+        ``[]``). Shutdown paths call this so a drain never has to wait
+        out a wait timeout."""
+        with self._watch_cond:
+            self._watch_cond.notify_all()
+
+    def wait_since(self, revision: int, timeout: float) -> list[WatchRecord]:
+        """Block until events past ``revision`` exist (or ``timeout``
+        elapses — then ``[]``), and return them. Push-latency watch
+        consumption: one waiting thread per hub, zero polling."""
+        with self._watch_cond:
+            if self.revision <= revision:
+                self._watch_cond.wait(timeout)
+            if self.revision <= revision:
+                return []
+            return self.watch_since(revision)
 
     def watch_since(self, revision: int) -> list[WatchRecord]:
         """Watch events with revision > the given revision. Binary-searched
@@ -651,6 +681,7 @@ class Store:
                 z["exp"].astype(np.float64),
             )
         with self._lock:
+            self.epoch = uuid.uuid4().hex  # cached id maps are now invalid
             self.types = Interner()
             for s in meta["types"]:
                 self.types.intern(s)
@@ -670,8 +701,11 @@ class Store:
             self.revision = int(meta["revision"])
             self.unlogged_revision = self.revision
             self._watch_log = []
-            # watchers from before the snapshot must re-list
+            # watchers from before the restore must re-list + re-watch
+            # (their revisions describe a different store lineage) — make
+            # watch_since raise instead of silently returning no events
             self._watch_oldest_rev = self.revision
+            self._watch_cond.notify_all()
 
     def snapshot(self) -> Snapshot:
         """Immutable columnar view of all live tuples for the compiler.

@@ -126,6 +126,18 @@ class PreFilter:
     namespace_expr: Optional[CompiledExpr]
     rel: RelExpr
 
+    def mapping_shareable(self) -> bool:
+        """True when the id→(namespace, name) mapping depends on nothing
+        but the looked-up resourceId — then two watchers resolving the
+        SAME relationship produce identical allowed sets, and the watch
+        hub may compute once and fan out (exprs referencing user/headers/
+        request fields disable sharing; over-collected refs only cost the
+        optimization, never correctness)."""
+        refs = set(self.name_expr.refs)
+        if self.namespace_expr is not None:
+            refs |= self.namespace_expr.refs
+        return refs <= {"resourceId"}
+
 
 @dataclass
 class PostFilter:

@@ -136,6 +136,11 @@ class _Parser:
     def __init__(self, text: str):
         self.toks = _tokenize(text)
         self.i = 0
+        # root identifiers the compiled program may read from the DATA map
+        # (over-collection is fine — let-bound names land here too; callers
+        # use this to prove an expr depends on nothing but, say,
+        # resourceId, so extra names only disable an optimization)
+        self.refs: set = set()
 
     @property
     def cur(self) -> _Tok:
@@ -489,6 +494,7 @@ class _Parser:
             name = self.advance().value
             if self.cur.kind == "op" and self.cur.value == "(":
                 return self.parse_function(name)
+            self.refs.add(name)
 
             def ident(env):
                 if name in env.vars:
@@ -653,6 +659,11 @@ _FUNCTIONS: dict[str, Callable] = {
 class CompiledExpr:
     source: str
     _node: _Node
+    # root data-map identifiers the program may read (conservative
+    # over-approximation; literals have none). The watch hub uses this to
+    # share allowed-set recomputes across watchers when the id-mapping
+    # exprs provably depend only on resourceId.
+    refs: frozenset = frozenset()
 
     def evaluate(self, data: dict, this=None) -> Any:
         v = self._node(_Env(data, this=this))
@@ -676,11 +687,12 @@ class CompiledExpr:
 
 def compile_expr(text: str) -> CompiledExpr:
     """Compile a bare expression (tupleSets, `if` conditions)."""
+    p = _Parser(text)
     try:
-        node = _Parser(text).parse_program()
+        node = p.parse_program()
     except ExprError as e:
         raise ExprError(f"in expression {text!r}: {e}") from None
-    return CompiledExpr(text, node)
+    return CompiledExpr(text, node, frozenset(p.refs))
 
 
 def compile_template(text: str) -> CompiledExpr:

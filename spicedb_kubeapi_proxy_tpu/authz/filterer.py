@@ -75,9 +75,12 @@ def filter_body_proto(body: bytes, allowed: AllowedSet,
     try:
         _, kind, raw = kubeproto.decode_unknown(body)
         if kind == "Table":
-            raise FilterError(
-                "protobuf Table responses are not filterable; request "
-                "JSON Tables (kubectl default)")
+            # rows filtered at the wire level (kept rows byte-identical);
+            # an un-keyable row (includeObject=None) raises ProtoError ->
+            # a clean 401, never a 500 (reference decodes the full Table,
+            # responsefilterer.go:349-374)
+            new_raw = kubeproto.filter_table_raw(raw, allowed.allows)
+            return 200, kubeproto.replace_unknown_raw(body, new_raw)
         if kind.endswith("List"):
             new_raw = kubeproto.filter_list_raw(raw, allowed.allows)
             return 200, kubeproto.replace_unknown_raw(body, new_raw)

@@ -33,9 +33,7 @@ from ..rules.compile import PreFilter
 from ..rules.input import ResolveInput
 from ..proxy.types import ProxyRequest, ProxyResponse
 from .lookups import AllowedSet, run_prefilter
-from .watchhub import EXPIRY_RECOMPUTE_INTERVAL, WatchHub  # noqa: F401
-# (EXPIRY_RECOMPUTE_INTERVAL re-exported: tests and older callers patch it
-# through this module; the hub reads it at group creation)
+from .watchhub import WatchHub
 
 
 async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
@@ -73,8 +71,12 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
         # markers from the hub; same ordering the old per-watcher loop
         # got by draining events before frames)
         held: list[bytes] = []
-        waiting_for = 0  # highest pending seq seen
-        applied = 0  # highest seq a received allowed set covers
+        # anchored at the group's trigger counter when we registered:
+        # allowed sets covering an EARLIER seq were computed from state
+        # older than our initial prefilter snapshot (a recompute in
+        # flight across a revocation) and must not replace it
+        waiting_for = handle.reg_seq  # highest pending seq seen
+        applied = handle.reg_seq  # highest seq an applied set covers
         q = handle.queue  # hub updates AND upstream frames land here
 
         async def read_upstream():
@@ -109,6 +111,8 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                 elif kind == "pending":
                     waiting_for = max(waiting_for, item[1])
                 elif kind == "allowed":
+                    if item[2] < handle.reg_seq:
+                        continue  # predates our initial snapshot
                     fresh: AllowedSet = item[1]
                     for key in fresh.pairs - allowed.pairs:
                         frame = buffered.pop(key, None)

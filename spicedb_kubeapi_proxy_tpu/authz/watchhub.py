@@ -62,11 +62,17 @@ class WatcherHandle:
     additionally feeds its own upstream frames into the same queue so it
     can sleep on a single ``get()``."""
 
-    __slots__ = ("queue", "group")
+    __slots__ = ("queue", "group", "reg_seq")
 
     def __init__(self, group: "_Group"):
         self.queue: asyncio.Queue = asyncio.Queue()
         self.group = group
+        # the group's trigger counter at registration: allowed sets whose
+        # covering seq predates this may be OLDER than the watcher's own
+        # initial prefilter snapshot (a recompute in flight across a
+        # revocation) and must be ignored, or a just-revoked object's
+        # frames would transiently leak through
+        self.reg_seq = group.seq
 
 
 class _Group:
@@ -164,21 +170,44 @@ class WatchHub:
                 if group.task is not None:
                     group.task.cancel()
             if not self._groups and self._pump_task is not None:
-                self._pump_task.cancel()
-                self._pump_task = None
-                if self._source_task is not None:
-                    self._source_task.cancel()
-                    self._source_task = None
-                if self._push_stream is not None:
-                    # closing the socket unblocks the in-flight recv
-                    await asyncio.to_thread(self._push_stream.close)
-                    self._push_stream = None
-                store = getattr(self.engine, "store", None)
-                if hasattr(store, "wake_waiters"):
-                    # release any worker thread parked in wait_since so
-                    # loop shutdown never waits out the wait timeout
-                    store.wake_waiters()
-                self._q = None
+                await self._stop_pump_locked()
+
+    async def _stop_pump_locked(self) -> None:
+        """Cancel and null all pump state (caller holds _reg_lock)."""
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            self._pump_task = None
+        if self._source_task is not None:
+            self._source_task.cancel()
+            self._source_task = None
+        if self._push_stream is not None:
+            # closing the socket unblocks the in-flight recv
+            await asyncio.to_thread(self._push_stream.close)
+            self._push_stream = None
+        store = getattr(self.engine, "store", None)
+        if hasattr(store, "wake_waiters"):
+            # release any worker thread parked in wait_since so loop
+            # shutdown never waits out the wait timeout
+            store.wake_waiters()
+        self._q = None
+
+    async def _teardown_pump(self, dead_pump: asyncio.Task) -> None:
+        """Post-failure cleanup, scheduled by a dying pump: reset state so
+        register() can start fresh, and — if watchers remain or arrived in
+        the gap — restart the pump for them after a short backoff (an
+        engine host outage must not become a tight reconnect loop)."""
+        await asyncio.sleep(1.0)
+        async with self._reg_lock:
+            if self._pump_task is not dead_pump:
+                return  # someone already cleaned up / restarted
+            await self._stop_pump_locked()
+            if self._groups:
+                self._last_rev = await asyncio.to_thread(
+                    lambda: self.engine.revision)
+                loop = asyncio.get_running_loop()
+                self._q = asyncio.Queue()
+                self._source_task = loop.create_task(self._source_reader())
+                self._pump_task = loop.create_task(self._pump())
 
     # -- event pump ----------------------------------------------------------
 
@@ -266,11 +295,16 @@ class WatchHub:
                 except Exception as e:
                     # trimmed history / dead engine host: every watcher
                     # ends its stream (clients re-list + re-watch, kube
-                    # "resourceVersion too old" semantics)
+                    # "resourceVersion too old" semantics). Tear the pump
+                    # state down HERE — leaving _pump_task set would stop
+                    # register() from ever starting a fresh pump, silently
+                    # freezing every future watcher's allowed set
                     log.warning("watch pump ending: %s", e)
                     for g in list(self._groups.values()):
                         for w in list(g.watchers):
                             w.queue.put_nowait(("error", e))
+                    asyncio.get_running_loop().create_task(
+                        self._teardown_pump(asyncio.current_task()))
                     return
                 if events:
                     self._last_rev = max(e.revision for e in events)

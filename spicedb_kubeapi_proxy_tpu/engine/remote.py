@@ -669,25 +669,31 @@ class RemoteEngine:
                   epoch: str) -> Optional[RemoteInterner]:
         """Bring the cached id table for ``rtype`` up to ``gen`` within
         ``epoch``; None when the host reports a DIFFERENT epoch (caller
-        retries). Only the missing tail rides the wire."""
+        retries). Only the missing tail rides the wire, and the table is
+        SHARED (append-only within an epoch) — no per-lookup copy of a
+        100k-entry list on the hot path."""
         with self._ids_lock:
-            cached = self._ids.get(rtype)
-            strings = list(cached[1]) if cached and cached[0] == epoch \
-                else []
-        if len(strings) < gen:
-            r = self._call("object_ids", type=rtype, **{"from": len(strings)})
+            ent = self._ids.get(rtype)
+            if ent is None or ent[0] != epoch:
+                ent = (epoch, [])
+                self._ids[rtype] = ent
+            strings = ent[1]
+            have = len(strings)
+        if have < gen:
+            r = self._call("object_ids", type=rtype, **{"from": have})
             if r["epoch"] != epoch:
                 with self._ids_lock:
                     # the delta we fetched belongs to ANOTHER epoch's
                     # table; drop the cache so the retry resyncs from 0
-                    self._ids.pop(rtype, None)
+                    if self._ids.get(rtype) is ent:
+                        self._ids.pop(rtype, None)
                 return None
-            strings.extend(r["ids"])
             with self._ids_lock:
-                have = self._ids.get(rtype)
-                if have is None or have[0] != epoch or \
-                        len(have[1]) < len(strings):
-                    self._ids[rtype] = (epoch, strings)
+                # a concurrent fetcher may have extended past us: append
+                # only the part of our delta it hasn't already covered
+                cur = len(strings)
+                if cur < have + len(r["ids"]):
+                    strings.extend(r["ids"][cur - have:])
         return RemoteInterner(strings)
 
     def write_relationships(self, ops: list,

@@ -5,13 +5,22 @@ followed by a ``runtime.Unknown`` message whose ``raw`` field holds the
 serialized object (reference negotiates this alongside JSON,
 /root/reference/pkg/authz/responsefilterer.go:242-313).
 
-Filtering a *List response only needs three API-stable protobuf field
-numbers — no generated schemas:
+Filtering a *List or Table response only needs a handful of API-stable
+protobuf field numbers — no generated schemas:
 
 - ``runtime.Unknown``: typeMeta=1 (apiVersion=1, kind=2), raw=2,
   contentEncoding=3, contentType=4
 - every ``XList`` message: metadata(ListMeta)=1, repeated items=2
 - every item's ``metadata(ObjectMeta)``=1, within it name=1, namespace=3
+- ``meta.k8s.io/v1 Table``: metadata=1, columnDefinitions=2, rows=3;
+  ``TableRow``: cells=1, conditions=2, object(RawExtension)=3;
+  ``runtime.RawExtension``: raw=1. A row's object bytes are either a
+  nested magic-prefixed ``runtime.Unknown`` (how kube encodes nested
+  RawExtensions under proto negotiation) or a bare
+  ``PartialObjectMetadata`` — both resolve through the same
+  ObjectMeta-at-field-1 shape (reference filters Table rows after full
+  decode, pkg/authz/responsefilterer.go:349-374; here the kept rows stay
+  byte-identical)
 
 These numbers are frozen by the kube API compatibility contract (all
 generated.proto files), so splitting the repeated ``items`` field and
@@ -151,6 +160,41 @@ def item_meta(item: bytes) -> tuple[str, str]:
     namespace = _field(meta, 3)
     return ((namespace or b"").decode("utf-8", "replace"),
             (name or b"").decode("utf-8", "replace"))
+
+
+def table_row_meta(row: bytes) -> tuple[str, str]:
+    """(namespace, name) for a TableRow via its ``object`` RawExtension.
+    Raises ProtoError when the row carries no keyable object (e.g. the
+    client sent ``includeObject=None``) — the filterer turns that into a
+    clean 4xx rather than passing unjudgeable rows through."""
+    ext = _field(row, 3)  # optional RawExtension object
+    if ext is None:
+        raise ProtoError(
+            "table row has no object to authorize against (request "
+            "includeObject=Metadata, the kube default)")
+    raw_obj = _field(ext, 1)  # RawExtension.raw
+    if raw_obj is None:
+        raise ProtoError("table row object has no raw bytes")
+    if raw_obj.startswith(MAGIC):
+        _, _, raw_obj = decode_unknown(raw_obj)
+    ns, name = item_meta(raw_obj)
+    if not name:
+        raise ProtoError("table row object has no metadata.name")
+    return ns, name
+
+
+def filter_table_raw(raw: bytes, allows) -> bytes:
+    """Drop Table ``rows`` (repeated field 3) whose row object fails
+    ``allows(namespace, name)``; metadata, columnDefinitions, and kept
+    rows are copied byte-identically in order."""
+    out = bytearray()
+    for fno, wt, chunk, payload in fields(raw):
+        if fno == 3 and wt == 2:
+            ns, name = table_row_meta(payload)
+            if not allows(ns, name):
+                continue
+        out += chunk
+    return bytes(out)
 
 
 def filter_list_raw(raw: bytes, allows) -> bytes:

@@ -90,12 +90,11 @@ class HttpUpstream:
 
 def rewrite_accept(accept: str, watching: bool) -> str:
     """Accept rewriting for upstream requests: the filterer parses JSON
-    (incl. Table) and kube protobuf lists/objects (authz/filterer.py,
-    proxy/kubeproto.py) but NOT protobuf Tables or protobuf watch frames —
-    so protobuf ranges pass through except when they request Table form,
-    and watch requests stay JSON-only (the watch join decodes frames as
-    JSON). Anything else is stripped; an emptied Accept falls back to
-    JSON."""
+    (incl. Table) and kube protobuf objects/lists/Tables
+    (authz/filterer.py, proxy/kubeproto.py) but NOT protobuf watch
+    frames — so protobuf ranges pass through except on watches, which
+    stay JSON-only (the watch join decodes frames as JSON). Anything
+    else is stripped; an emptied Accept falls back to JSON."""
 
     from ..utils.features import features
 
@@ -105,8 +104,7 @@ def rewrite_accept(accept: str, watching: bool) -> str:
         low = r.lower()
         if "json" in low:
             return True
-        return (proto_ok and "protobuf" in low and not watching
-                and "as=table" not in low.replace(" ", ""))
+        return proto_ok and "protobuf" in low and not watching
 
     return ",".join(r for r in accept.split(",")
                     if keep(r)) or "application/json"

@@ -49,21 +49,23 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
     if hub is None:
         hub = WatchHub(engine, poll_interval)
 
-    # Register with the hub BEFORE the initial snapshot: the pump anchors
-    # at a revision <= the snapshot's, so a grant landing between the two
-    # is re-checked by a recompute (idempotent) instead of being lost.
-    # Running the prefilter eagerly (not inside the streaming generator)
-    # also lets PreFilterError surface as a 500 before the 200/chunked
-    # headers are committed.
-    handle = await hub.register(pf, input)
-    try:
-        allowed = await run_prefilter(engine, pf, input)
-    except BaseException:
-        await hub.unregister(handle)
-        raise
+    # The prefilter runs eagerly (not inside the streaming generator) so
+    # PreFilterError surfaces as a 500 before the 200/chunked headers are
+    # committed. Hub registration happens INSIDE the generator instead: a
+    # stream the client abandons before the first frame is a generator
+    # that never starts, and PEP 525 never runs its finally — an eager
+    # registration would leak the watcher (and its queue) forever. The
+    # snapshot→registration event gap is closed by hub.refresh() below.
+    allowed = await run_prefilter(engine, pf, input)
 
     async def frames() -> AsyncIterator[bytes]:
         nonlocal allowed
+        handle = await hub.register(pf, input)
+        # one forced, ordered recompute: initial frames are HELD until it
+        # lands, so grants/revocations that raced the initial snapshot
+        # (or tick recomputes in flight across registration) can never
+        # judge a frame with stale state
+        await hub.refresh(handle)
         buffered: dict[tuple, bytes] = {}
         # frames held while a recompute covering an earlier event batch is
         # in flight — a revoked object's frame must be judged against the
@@ -71,10 +73,6 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
         # markers from the hub; same ordering the old per-watcher loop
         # got by draining events before frames)
         held: list[bytes] = []
-        # anchored at the group's trigger counter when we registered:
-        # allowed sets covering an EARLIER seq were computed from state
-        # older than our initial prefilter snapshot (a recompute in
-        # flight across a revocation) and must not replace it
         waiting_for = handle.reg_seq  # highest pending seq seen
         applied = handle.reg_seq  # highest seq an applied set covers
         q = handle.queue  # hub updates AND upstream frames land here
@@ -111,8 +109,12 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                 elif kind == "pending":
                     waiting_for = max(waiting_for, item[1])
                 elif kind == "allowed":
-                    if item[2] < handle.reg_seq:
-                        continue  # predates our initial snapshot
+                    if item[2] <= handle.reg_seq:
+                        # strictly predates (or is concurrent with) our
+                        # initial snapshot — e.g. an expiry-tick recompute
+                        # already in flight at registration; our refresh's
+                        # covering set (seq > reg_seq) is on its way
+                        continue
                     fresh: AllowedSet = item[1]
                     for key in fresh.pairs - allowed.pairs:
                         frame = buffered.pop(key, None)
@@ -151,6 +153,11 @@ def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
     guessed from the resource name."""
     try:
         ev = json.loads(frame)
+        if ev.get("type") == "BOOKMARK":
+            # bookmarks carry only a resourceVersion (no object to
+            # authorize) and are progress markers every consumer may see:
+            # pass through rather than keying on an empty name
+            return None
         obj = ev.get("object") or {}
         # Table-format watch events wrap rows (responsefilterer.go:667-677)
         if obj.get("kind") == "Table":

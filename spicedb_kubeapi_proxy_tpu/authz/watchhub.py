@@ -67,11 +67,11 @@ class WatcherHandle:
     def __init__(self, group: "_Group"):
         self.queue: asyncio.Queue = asyncio.Queue()
         self.group = group
-        # the group's trigger counter at registration: allowed sets whose
-        # covering seq predates this may be OLDER than the watcher's own
+        # the group's trigger counter at registration: allowed sets
+        # covering seq <= this may be OLDER than the watcher's own
         # initial prefilter snapshot (a recompute in flight across a
-        # revocation) and must be ignored, or a just-revoked object's
-        # frames would transiently leak through
+        # revocation or expiry) and are ignored; the watch start's
+        # hub.refresh() guarantees a covering set with seq > reg_seq
         self.reg_seq = group.seq
 
 
@@ -160,6 +160,20 @@ class WatchHub:
             handle = WatcherHandle(group)
             group.watchers.add(handle)
             return handle
+
+    async def refresh(self, handle: WatcherHandle) -> None:
+        """Force one ordered recompute for the handle's group: bumps the
+        trigger counter (so members hold frames until it lands) and
+        kicks. Watch starts call this right after registering — it closes
+        any event gap between the caller's initial prefilter snapshot and
+        its registration, and guarantees the first applied set is newer
+        than reg_seq (tick recomputes in flight across registration are
+        ignored by the strict staleness guard)."""
+        group = handle.group
+        group.seq += 1
+        for w in list(group.watchers):
+            w.queue.put_nowait(("pending", group.seq))
+        self._kick(group)
 
     async def unregister(self, handle: WatcherHandle) -> None:
         async with self._reg_lock:

@@ -185,7 +185,26 @@ class EngineServer:
         would wait on forever on Python 3.12+)."""
         if self._server is None:
             return
-        await drain_server(self._server, self._conns, grace)
+        store = getattr(self.engine, "store", None)
+        waker = None
+        if hasattr(store, "wake_waiters"):
+            # repeatedly release push loops parked in wait_events during
+            # the drain (a cancelled to_thread only unblocks when the
+            # worker thread returns; a single wake can race a loop that
+            # re-parks before its cancellation lands) — without this,
+            # each active watch_subscribe stream holds the drain for up
+            # to PUSH_HEARTBEAT seconds
+            async def _wake_loop():
+                while True:
+                    store.wake_waiters()
+                    await asyncio.sleep(0.2)
+
+            waker = asyncio.get_running_loop().create_task(_wake_loop())
+        try:
+            await drain_server(self._server, self._conns, grace)
+        finally:
+            if waker is not None:
+                waker.cancel()
         self._server = None
 
     async def _serve(self, reader: asyncio.StreamReader,

@@ -68,7 +68,9 @@ class InMemoryKube:
             return json_response(200, obj)
         if info.verb == "list" or info.verb == "watch":
             if info.verb == "watch":
-                return self._start_watch(res, ns)
+                bookmarks = (req.query.get("allowWatchBookmarks") or
+                             ["false"])[0] in ("true", "1", "True")
+                return self._start_watch(res, ns, bookmarks=bookmarks)
             items = [o for (r, n_, _), o in sorted(self.objects.items())
                      if r == res and (not ns or n_ == ns)]
             return json_response(200, {
@@ -111,11 +113,26 @@ class InMemoryKube:
                 return kube_status(400, "invalid body")
             if not isinstance(obj, dict):
                 return kube_status(400, "body must be an object")
+            # optimistic concurrency: a stale resourceVersion in the body
+            # is a genuine 409 Conflict (real apiserver semantics — the
+            # dual-write path must cope with conflicts the FAKE detects,
+            # not only injected ones)
+            sent_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            cur_rv = (self.objects[key].get("metadata") or {}) \
+                .get("resourceVersion")
+            if sent_rv and cur_rv and sent_rv != cur_rv:
+                return kube_status(
+                    409,
+                    f'Operation cannot be fulfilled on {res} "{name}": '
+                    "the object has been modified; please apply your "
+                    "changes to the latest version and try again",
+                    "Conflict")
             self.rv += 1
             obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
             self.objects[key] = obj
             self._notify(res, ns, {"type": "MODIFIED", "object": obj})
-            return json_response(200, obj)
+            return self._finalize_if_cleared(key, obj) \
+                or json_response(200, obj)
         if info.verb == "patch":
             key = (res, ns, name)
             if key not in self.objects:
@@ -144,17 +161,49 @@ class InMemoryKube:
             obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
             self.objects[key] = obj
             self._notify(res, ns, {"type": "MODIFIED", "object": obj})
-            return json_response(200, obj)
+            return self._finalize_if_cleared(key, obj) \
+                or json_response(200, obj)
         if info.verb == "delete":
             key = (res, ns, name)
-            obj = self.objects.pop(key, None)
+            obj = self.objects.get(key)
             if obj is None:
                 return kube_status(404, f'{res} "{name}" not found', "NotFound")
+            meta = obj.setdefault("metadata", {})
+            if meta.get("finalizers"):
+                # kube finalizer semantics: the object is not removed —
+                # it gains deletionTimestamp and waits for controllers to
+                # clear the finalizers; DELETE returns the terminating
+                # object, not a Status
+                if not meta.get("deletionTimestamp"):
+                    import datetime
+
+                    meta["deletionTimestamp"] = datetime.datetime.now(
+                        datetime.timezone.utc).strftime(
+                            "%Y-%m-%dT%H:%M:%SZ")
+                    self.rv += 1
+                    meta["resourceVersion"] = str(self.rv)
+                    self._notify(res, ns,
+                                 {"type": "MODIFIED", "object": obj})
+                return json_response(200, obj)
+            self.objects.pop(key, None)
             self.rv += 1
             self._notify(res, ns, {"type": "DELETED", "object": obj})
             return json_response(200, {"kind": "Status", "status": "Success",
                                        "code": 200})
         return kube_status(405, f"verb {info.verb} not supported")
+
+    def _finalize_if_cleared(self, key: tuple, obj: dict):
+        """A terminating object whose last finalizer was just removed is
+        deleted for real (what the apiserver does when a controller
+        clears its finalizer)."""
+        meta = obj.get("metadata") or {}
+        if meta.get("deletionTimestamp") and not meta.get("finalizers"):
+            res, ns, _ = key
+            self.objects.pop(key, None)
+            self.rv += 1
+            self._notify(res, ns, {"type": "DELETED", "object": obj})
+            return json_response(200, obj)
+        return None
 
     # -- watch ---------------------------------------------------------------
 
@@ -163,13 +212,20 @@ class InMemoryKube:
             if r == res and (not n_ or n_ == ns):
                 q.put_nowait(event)
 
-    def _start_watch(self, res: str, ns: str) -> ProxyResponse:
+    def _start_watch(self, res: str, ns: str,
+                     bookmarks: bool = False) -> ProxyResponse:
         q: asyncio.Queue = asyncio.Queue()
         # emit existing objects as initial ADDED events (kube semantics with
         # resourceVersion=0 watches)
         for (r, n_, _), o in sorted(self.objects.items()):
             if r == res and (not ns or n_ == ns):
                 q.put_nowait({"type": "ADDED", "object": o})
+        if bookmarks:
+            # kube sends an initial-events-end bookmark carrying only a
+            # resourceVersion; clients use it to mark their sync point
+            q.put_nowait({"type": "BOOKMARK", "object": {
+                "kind": kind_for(res), "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(self.rv)}}})
         entry = (res, ns, q)
         self._watchers.append(entry)
 
@@ -206,6 +262,13 @@ class InMemoryKube:
         self.rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
         self._notify(res, ns, {"type": event_type, "object": obj})
+
+    def emit_bookmark(self, res: str, ns: str = "") -> None:
+        """Emit a BOOKMARK event to watchers (kube sends these
+        periodically; tests use this to exercise the passthrough)."""
+        self._notify(res, ns, {"type": "BOOKMARK", "object": {
+            "kind": kind_for(res), "apiVersion": "v1",
+            "metadata": {"resourceVersion": str(self.rv)}}})
 
     def stop_watches(self):
         for _, _, q in list(self._watchers):

@@ -113,6 +113,11 @@ class Engine:
         self._lock = threading.RLock()
         self._compiled: Optional[CompiledGraph] = None
         self._batcher = None
+        # host-side (q_slots, q_batch) arrays per (offset, size): a mask
+        # lookup's query arrays are a pure function of the slot layout, so
+        # rebuilding 2x400KB of arange/zeros per request is waste (their
+        # DEVICE copies are already cached per key in query_async)
+        self._q_host: dict[tuple, tuple] = {}
         # optional jax.sharding.Mesh ("data", "graph" axes): queries route
         # through a ShardedGraph pinned across it instead of one device
         self.mesh = mesh
@@ -471,8 +476,20 @@ class Engine:
             [cg.encode_subject(subject_type, subject_id, subject_relation, objs)],
             dtype=np.int32,
         )
-        q_slots = off + np.arange(n, dtype=np.int32)
-        q_batch = np.zeros(n, dtype=np.int32)
+        qk = (off, n)
+        ent = self._q_host.get(qk)
+        if ent is None:
+            if len(self._q_host) >= 64:
+                try:
+                    # pop-with-default: concurrent lookups may race the
+                    # same oldest key (no lock on this path by design)
+                    self._q_host.pop(next(iter(self._q_host)), None)
+                except StopIteration:
+                    pass
+            ent = (off + np.arange(n, dtype=np.int32),
+                   np.zeros(n, dtype=np.int32))
+            self._q_host[qk] = ent
+        q_slots, q_batch = ent
         t0 = time.perf_counter()
         # the query arrays are a pure function of (type, permission) slot
         # layout: cache their device copies across queries (the ~0.5MB
@@ -488,7 +505,12 @@ class Engine:
                 time.perf_counter() - t0)
             metrics.histogram("engine_fixpoint_iterations").observe(
                 fut.iterations())
-            return mask_pseudo_objects(np.array(out)), interner
+            # QueryFuture.result() already materialized a fresh host
+            # array; only copy again if it came back read-only
+            m = np.asarray(out)
+            if not m.flags.writeable:
+                m = m.copy()
+            return mask_pseudo_objects(m), interner
 
         return EngineFuture(fut, fin)
 

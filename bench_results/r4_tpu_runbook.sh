@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-4 TPU-window runbook: run EVERYTHING directive 1 needs the moment
+# the axon tunnel comes back, archiving as it goes (the tunnel has
+# multi-hour outages — front-load the valuable runs).
+#
+#   bash bench_results/r4_tpu_runbook.sh
+#
+# Produces, under bench_results/:
+#   r4_tpu_full.json        headline + suite configs (incl. post-closure
+#                           config 3) + remote-compare + tail diagnosis
+#   r4_tpu_profile/         jax profiler trace of the headline loop
+#                           (fixpoint annotated "sdbkp:fixpoint" — answers
+#                           the 150-vs-819 GB/s bandwidth question)
+#   r4_tpu_stderr.log       full methodology log
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probing tunnel (subprocess, hard timeout) =="
+timeout 150 python - <<'EOF'
+import subprocess, sys
+p = subprocess.run([sys.executable, "-c", "import jax; print(jax.devices())"],
+                   capture_output=True, text=True, timeout=130)
+sys.stdout.write(p.stdout)
+sys.exit(0 if "Tpu" in p.stdout or "axon" in p.stdout.lower() else 1)
+EOF
+if [ $? -ne 0 ]; then
+    echo "tunnel still down; not burning the window budget"; exit 1
+fi
+
+echo "== full suite + profile + remote-compare (one engine build) =="
+python bench.py --suite --remote-compare \
+    --profile-dir bench_results/r4_tpu_profile \
+    > bench_results/r4_tpu_full.json 2> bench_results/r4_tpu_stderr.log
+rc=$?
+echo "bench rc=$rc"
+tail -40 bench_results/r4_tpu_stderr.log
+cat bench_results/r4_tpu_full.json
+echo
+echo "== done; commit the artifacts =="

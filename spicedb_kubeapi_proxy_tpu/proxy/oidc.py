@@ -84,6 +84,7 @@ class OIDCAuthenticator:
                  groups_claim: Optional[str] = None,
                  groups_prefix: str = "",
                  ca_file: Optional[str] = None,
+                 required_claims: Optional[dict] = None,
                  signing_algs: tuple = DEFAULT_ALGS,
                  jwks_uri: Optional[str] = None,
                  skew: float = 10.0,
@@ -98,6 +99,9 @@ class OIDCAuthenticator:
         self.username_prefix = username_prefix
         self.groups_claim = groups_claim
         self.groups_prefix = groups_prefix
+        # kube --oidc-required-claim key=value pairs: every pair must be
+        # present with exactly that string value
+        self.required_claims = dict(required_claims or {})
         self.signing_algs = tuple(signing_algs)
         self.skew = skew
         self._jwks_uri = jwks_uri
@@ -202,6 +206,11 @@ class OIDCAuthenticator:
             raise OIDCError("signature verification failed")
         self._validate_time(claims)
         self._validate_audience(claims)
+        for k, v in self.required_claims.items():
+            if claims.get(k) != v:
+                raise OIDCError(
+                    f"required claim {k}={v!r} not satisfied "
+                    f"(got {claims.get(k)!r})")
         return self._map_identity(claims)
 
     def _validate_time(self, claims: dict) -> None:

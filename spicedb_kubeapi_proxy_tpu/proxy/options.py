@@ -94,6 +94,8 @@ class Options:
     oidc_groups_prefix: str = ""
     oidc_ca_file: Optional[str] = None
     oidc_signing_algs: str = "RS256"  # comma-separated
+    # repeatable key=value pairs every token must carry verbatim
+    oidc_required_claims: list = field(default_factory=list)
     # dual-write
     workflow_database_path: str = DEFAULT_WORKFLOW_DB
     lock_mode: str = LOCK_MODE_PESSIMISTIC
@@ -182,12 +184,17 @@ class Options:
                 "tls-client-ca-file")
         if self.oidc_issuer_url and not self.oidc_client_id:
             raise OptionsError("oidc-issuer-url requires oidc-client-id")
-        if not self.oidc_issuer_url and any(
-                x is not None for x in (
-                    self.oidc_client_id, self.oidc_username_prefix,
-                    self.oidc_groups_claim, self.oidc_ca_file)):
+        if not self.oidc_issuer_url and (
+                self.oidc_required_claims or any(
+                    x is not None for x in (
+                        self.oidc_client_id, self.oidc_username_prefix,
+                        self.oidc_groups_claim, self.oidc_ca_file))):
             raise OptionsError(
                 "oidc-* options require oidc-issuer-url")
+        for rc in self.oidc_required_claims:
+            if "=" not in rc:
+                raise OptionsError(
+                    f"oidc-required-claim {rc!r} must be key=value")
         if self.oidc_issuer_url:
             from .oidc import OIDCError, parse_signing_algs
 
@@ -322,6 +329,8 @@ class Options:
                 groups_claim=self.oidc_groups_claim,
                 groups_prefix=self.oidc_groups_prefix,
                 ca_file=self.oidc_ca_file,
+                required_claims=dict(
+                    rc.split("=", 1) for rc in self.oidc_required_claims),
                 signing_algs=parse_signing_algs(self.oidc_signing_algs),
             ))
         token_authenticator = None
@@ -435,6 +444,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="CA bundle for the issuer's HTTPS endpoints")
     parser.add_argument("--oidc-signing-algs", default="RS256",
                         help="comma-separated accepted JWS algorithms")
+    parser.add_argument("--oidc-required-claim", action="append",
+                        default=[], dest="oidc_required_claims",
+                        help="key=value a token must carry verbatim "
+                             "(repeatable)")
     parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
     parser.add_argument("--snapshot-path",
                         help="relationship-store snapshot file: loaded at "
@@ -490,6 +503,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         oidc_groups_prefix=args.oidc_groups_prefix,
         oidc_ca_file=args.oidc_ca_file,
         oidc_signing_algs=args.oidc_signing_algs,
+        oidc_required_claims=args.oidc_required_claims,
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,

@@ -459,3 +459,37 @@ check:
         Options(oidc_issuer_url=ISSUER, oidc_client_id="x",
                 oidc_signing_algs="HS256", **base).validate()
     Options(oidc_issuer_url=ISSUER, oidc_client_id="x", **base).validate()
+
+
+def test_required_claims():
+    """kube --oidc-required-claim semantics: every configured key=value
+    must appear verbatim in the token."""
+    a = make_auth(required_claims={"tenant": "acme"})
+    assert a.authenticate_token(
+        sign_jwt(std_claims(tenant="acme"))) is not None
+    assert a.authenticate_token(
+        sign_jwt(std_claims(tenant="evil"))) is None
+    assert a.authenticate_token(sign_jwt(std_claims())) is None
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options,
+        OptionsError,
+    )
+
+    base = dict(rule_content="""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+lock: Pessimistic
+match:
+- apiVersion: v1
+  resource: namespaces
+  verbs: ["get"]
+check:
+- tpl: "namespace:{{name}}#view@user:{{user.name}}"
+""", upstream=object())
+    with pytest.raises(OptionsError, match="key=value"):
+        Options(oidc_issuer_url=ISSUER, oidc_client_id="x",
+                oidc_required_claims=["noequals"], **base).validate()
+    with pytest.raises(OptionsError, match="require oidc-issuer-url"):
+        Options(oidc_required_claims=["a=b"], **base).validate()
+    Options(oidc_issuer_url=ISSUER, oidc_client_id="x",
+            oidc_required_claims=["tenant=acme"], **base).validate()

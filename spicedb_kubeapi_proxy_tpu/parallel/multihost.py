@@ -1,0 +1,63 @@
+"""Multi-host (DCN) execution of the sharded engine.
+
+The reference's distributed story is NCCL/MPI-free — gRPC between
+processes (SURVEY §2.5). The TPU-native analog has two tiers:
+
+- WITHIN a slice: XLA collectives over ICI inside the shard_map'd
+  fixpoint (`parallel/sharded.py`) — no host involvement per hop.
+- ACROSS hosts: the SAME shard_map over a global mesh spanning every
+  process's devices, with XLA routing the collectives over DCN.
+  JAX's multi-controller SPMD model requires every process to execute
+  the same program on the same inputs; :func:`init_distributed` wires a
+  process into the coordination service, and ``make_mesh`` (mesh.py)
+  builds over ``jax.devices()`` — the GLOBAL device list — when asked.
+
+`tests/test_multihost.py` validates the full engine query path (bulk
+load, dense blocks, collective joins, incremental writes) over two OS
+processes with Gloo carrying the cross-process collectives — the CPU
+stand-in for DCN.
+
+Serving integration (an engine host whose replicas span hosts) is the
+NEXT step, not yet wired: every process must apply the same writes and
+execute the same dispatches, so the TCP-serving process would broadcast
+(write-ops, query inputs) to follower processes — e.g. via
+``jax.experimental.multihost_utils.broadcast_one_to_all`` — before each
+step. The collective compute path that loop would execute is exactly
+what the validation harness proves out today.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class MultiHostError(RuntimeError):
+    pass
+
+
+def init_distributed(spec: str) -> None:
+    """Join the JAX distributed coordination service.
+
+    ``spec`` is ``coordinator_host:port,num_processes,process_id`` —
+    mirrors ``jax.distributed.initialize``'s required arguments as one
+    string. Called today by the multi-host validation harness
+    (tests/test_multihost.py); a multi-host serving engine host would
+    call it before building its mesh (see the module docstring for the
+    remaining serving-integration design)."""
+    parts = spec.split(",")
+    if len(parts) != 3:
+        raise MultiHostError(
+            f"--distributed {spec!r}: expected "
+            "coordinator_host:port,num_processes,process_id")
+    coordinator, num, pid = parts
+    try:
+        n, p = int(num), int(pid)
+    except ValueError:
+        raise MultiHostError(
+            f"--distributed {spec!r}: num_processes and process_id "
+            "must be integers") from None
+    if not (0 <= p < n):
+        raise MultiHostError(
+            f"--distributed {spec!r}: process_id must be in [0, {n})")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n, process_id=p)

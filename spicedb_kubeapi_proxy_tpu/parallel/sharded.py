@@ -150,6 +150,10 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
                 (0, off))
         V = _apply_program(meta, Vflat.reshape(B, rows, LANE), progs_k)
     out = V.reshape(B, Mp)[brange[:, None], q_slots].astype(jnp.bool_)
+    # replicate the (tiny, bool) result over the data axis so it is fully
+    # addressable on EVERY process — under a multi-host mesh a
+    # data-sharded output cannot be fetched by the serving process
+    out = jax.lax.all_gather(out, "data", axis=0, tiled=True)
     return out, (still_changing == 0), iters
 
 
@@ -245,7 +249,7 @@ class ShardedGraph:
                     P("graph"), P("graph"), P("graph"),
                     P("data", None), P("data", None), P(),
                 ),
-                out_specs=(P("data", None), P(), P()),
+                out_specs=(P(None, None), P(), P()),
                 check_vma=False,
             )
         )
@@ -372,6 +376,18 @@ class ShardedGraph:
             return self
         if cg.signature() != old.signature():
             return ShardedGraph(cg, self.mesh, self.max_iters)
+        # signature equality only proves JIT compatibility (shapes,
+        # layout, stratification) — delta-apply is valid ONLY for
+        # incremental descendants, which share their base edge arrays BY
+        # OBJECT (incremental_update builds the new graph with
+        # res_src=cg.res_src). A FULL recompile can coincidentally keep
+        # the signature (bucket padding absorbs small edge-count changes)
+        # while folding the delta into NEW base arrays — the resident
+        # shards would then silently miss those edges and answer stale
+        # denials.
+        if not (cg.res_src is old.res_src and cg.res_dst is old.res_dst
+                and cg.src is old.src and cg.dst is old.dst):
+            return ShardedGraph(cg, self.mesh, self.max_iters)
         reclosed_idx: list[int] = []
         if cg.blocks is not old.blocks:
             # a re-closed closured block (incremental membership delete)
@@ -454,10 +470,14 @@ class ShardedGraph:
         now_rel = np.float32(
             (time.time() if now is None else now) - self.cg.base_time
         )
+        # host numpy inputs stay UNCOMMITTED: jit shards them per the
+        # in_specs, which works identically whether the mesh spans one
+        # process or many (a committed local array would need a reshard
+        # from a non-global placement under multi-controller)
         out, converged, iters = self._run(
             self._level_edges, self._blocks,
             self._dsrc, self._ddst, self._dexp,
-            jnp.asarray(seeds_pad), jnp.asarray(grid), now_rel,
+            seeds_pad, grid, now_rel,
         )
         try:
             out.copy_to_host_async()
@@ -535,7 +555,11 @@ class ShardedGraph:
         if grid is None:
             grid_np = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
             grid_np[q_batch, cols] = q_slots
-            grid = jnp.asarray(grid_np)
+            # a GLOBAL device array (not a process-local jnp.asarray):
+            # identical on every process, sharded over the data axis —
+            # valid on single-process and multi-host meshes alike
+            grid = jax.device_put(
+                grid_np, NamedSharding(self.mesh, P("data", None)))
             if q_cache_key:
                 # bounded: grids pin device memory per distinct key
                 if len(self._qgrid) >= 32:

@@ -1,0 +1,117 @@
+"""Multi-host (multi-process) execution of the sharded engine: the full
+query path — bulk load, dense blocks, cross-process collective joins,
+incremental writes — over TWO OS processes whose collectives ride Gloo
+(the CPU stand-in for DCN). Mirrors SURVEY §2.5's requirement that the
+distributed backend scale to multi-host like the reference's gRPC tier.
+
+The worker script lives in this file (__MULTIHOST_WORKER__ guard) and is
+re-invoked per process, because jax.distributed can only be initialized
+once per process and must happen before the backend comes up.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+proc, n, port, repo = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, repo)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spicedb_kubeapi_proxy_tpu.parallel.multihost import init_distributed
+init_distributed(f"127.0.0.1:{port},{n},{proc}")
+import numpy as np
+from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp
+from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+from spicedb_kubeapi_proxy_tpu.parallel import make_mesh
+
+devs = jax.devices()
+assert len(devs) == 2 * n, (len(devs), n)
+mesh = make_mesh(len(devs), devices=devs)
+# identical store on every process (the SPMD contract; serving mirrors
+# writes the same way)
+rng = np.random.default_rng(7)
+rels = [f"namespace:n{i}#creator@user:u{int(rng.integers(50))}"
+        for i in range(300)]
+rels += [f"pod:n{i%30}/p{i}#namespace@namespace:n{i%30}"
+         for i in range(200)]
+em = Engine(mesh=mesh)
+em.write_relationships([WriteOp("touch", parse_relationship(r))
+                        for r in rels])
+e1 = Engine()
+e1.write_relationships([WriteOp("touch", parse_relationship(r))
+                        for r in rels])
+items = [CheckItem("namespace", f"n{int(i)}", "view", "user", f"u{int(u)}")
+         for i, u in zip(rng.integers(300, size=32),
+                         rng.integers(50, size=32))]
+assert em.check_bulk(items) == e1.check_bulk(items)
+lk = em.lookup_resources("namespace", "view", "user", "u3")
+assert sorted(lk) == sorted(
+    e1.lookup_resources("namespace", "view", "user", "u3"))
+# incremental write over the multi-host mesh, re-queried
+for eng in (em, e1):
+    eng.write_relationships([WriteOp("touch", parse_relationship(
+        "namespace:n1#viewer@user:u49"))])
+assert em.check_bulk(
+    [CheckItem("namespace", "n1", "view", "user", "u49")]) == [True]
+print(f"proc {proc}: MULTIHOST PARITY OK mesh={dict(mesh.shape)}",
+      flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_sharded_engine_parity(tmp_path):
+    """2 processes x 2 virtual devices: one global ('data','graph') mesh,
+    cross-process collectives over Gloo, engine parity vs single-device
+    incl. an incremental write."""
+    script = tmp_path / "mh_worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # the workers pin their own platform/device config; scrub any
+    # conftest leakage that would fight it
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", str(port), repo_root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo_root)
+        for i in range(2)
+    ]
+    # one SHARED deadline for both workers (sequential communicate()
+    # timeouts would stack), and always drain stdout after a kill so a
+    # flake leaves diagnostics instead of zombies + empty output
+    import time as _time
+
+    deadline = _time.monotonic() + 240
+    timed_out = False
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - _time.monotonic()))
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            p.kill()
+    outs = [p.communicate()[0] for p in procs]
+    if timed_out:
+        pytest.fail("multihost workers timed out; outputs:\n"
+                    + "\n---\n".join(o[-2000:] for o in outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+        assert "MULTIHOST PARITY OK" in out, out[-2000:]

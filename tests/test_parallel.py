@@ -319,3 +319,43 @@ def test_mesh_spec_parsing():
     with pytest.raises(OptionsError, match="engine-mesh applies"):
         Options(engine_endpoint="tcp://h:1", engine_mesh="auto",
                 rule_content="x", upstream_url="http://u").validate()
+
+
+def test_sharded_update_after_recompile_with_equal_signature():
+    """REGRESSION (found in round 4, present since round 3): a write that
+    forces a FULL recompile can leave the new graph with a signature
+    equal to the old one (bucket padding absorbs small edge-count
+    changes) while folding the delta into NEW base arrays.
+    ShardedGraph.updated() used to treat signature equality as
+    incremental descent and kept the old resident shards — silently
+    answering stale DENIALS for the new edge. The guard is base-array
+    object identity."""
+    import numpy as np
+
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+    from spicedb_kubeapi_proxy_tpu.parallel import make_mesh
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    import jax
+
+    mesh = make_mesh(4, devices=jax.devices()[:4])
+    rng = np.random.default_rng(7)
+    rels = [f"namespace:n{i}#creator@user:u{int(rng.integers(50))}"
+            for i in range(300)]
+    em = Engine(mesh=mesh)
+    em.write_relationships(
+        [WriteOp("touch", parse_relationship(r)) for r in rels])
+    item = CheckItem("namespace", "n1", "view", "user", "u49")
+    assert em.check_bulk([item]) == [False]
+    upd0 = metrics.counter("engine_sharded_updates_total").value
+    # first-ever viewer edge: incremental_update declines (layout), the
+    # engine recompiles, and the recompiled graph's signature happens to
+    # equal the old one
+    em.write_relationships([WriteOp("touch", parse_relationship(
+        "namespace:n1#viewer@user:u49"))])
+    got = em.check_bulk([item])
+    assert got == [True], \
+        "stale sharded shards after an equal-signature recompile"
+    assert em.oracle().check("namespace", "n1", "view", "user", "u49")
+    assert metrics.counter("engine_sharded_updates_total").value > upd0

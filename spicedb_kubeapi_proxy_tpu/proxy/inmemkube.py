@@ -143,16 +143,38 @@ class InMemoryKube:
                 return kube_status(400, "invalid patch body", "BadRequest")
             if not isinstance(patch, dict):
                 return kube_status(
-                    415, "only merge-patch objects supported", "BadRequest")
+                    415, "only merge/strategic-merge patch objects "
+                         "supported", "BadRequest")
+            ctype = next((v for k, v in req.headers.items()
+                          if k.lower() == "content-type"), "")
+            strategic = "strategic-merge-patch" in ctype
             obj = json.loads(json.dumps(self.objects[key]))
 
             def merge(dst, src):
-                # JSON Merge Patch (RFC 7386): null deletes the key
+                # JSON Merge Patch (RFC 7386): null deletes the key.
+                # Strategic-merge additionally merges LISTS OF OBJECTS by
+                # their "name" key (the dominant patchMergeKey in kube
+                # schemas; the real apiserver consults the type's openapi
+                # — this fake approximates the common convention) and
+                # honors $patch: delete directives.
                 for k, v in src.items():
                     if v is None:
                         dst.pop(k, None)
                     elif isinstance(v, dict) and isinstance(dst.get(k), dict):
                         merge(dst[k], v)
+                    elif strategic and isinstance(v, list) \
+                            and isinstance(dst.get(k), list) \
+                            and all(isinstance(x, dict) and "name" in x
+                                    for x in v + dst[k]):
+                        by_name = {x["name"]: x for x in dst[k]}
+                        for x in v:
+                            if x.get("$patch") == "delete":
+                                by_name.pop(x["name"], None)
+                            elif x["name"] in by_name:
+                                merge(by_name[x["name"]], x)
+                            else:
+                                by_name[x["name"]] = x
+                        dst[k] = list(by_name.values())
                     else:
                         dst[k] = v
 

@@ -1289,3 +1289,42 @@ def test_watch_bookmarks_pass_through_filter():
         task.cancel()
         env.kube.stop_watches()
     run(go())
+
+
+def test_strategic_merge_patch_through_dual_write():
+    """Strategic-merge-patch fidelity in the fake upstream: lists of
+    named objects merge by name (the kube patchMergeKey convention) and
+    $patch: delete removes entries — exercised through the proxy's patch
+    dual-write path."""
+    async def go():
+        env = Env(rules_yaml=UPDATE_PATCH_RULES)
+        await env.create_ns("smp", user="alice")
+        await env.create_pod("smp", "api", user="alice")
+        key = ("pods", "smp", "api")
+        env.kube.objects[key]["spec"] = {"containers": [
+            {"name": "app", "image": "app:v1"},
+            {"name": "sidecar", "image": "sc:v1"},
+        ]}
+        resp = await env.request(
+            "PATCH", "/api/v1/namespaces/smp/pods/api", user="alice",
+            headers={"Content-Type":
+                     "application/strategic-merge-patch+json"},
+            body={"spec": {"containers": [
+                {"name": "app", "image": "app:v2"},
+                {"name": "sidecar", "$patch": "delete"},
+                {"name": "logger", "image": "log:v1"},
+            ]}})
+        assert resp.status == 200, resp.body
+        got = {c["name"]: c.get("image")
+               for c in env.kube.objects[key]["spec"]["containers"]}
+        assert got == {"app": "app:v2", "logger": "log:v1"}
+        # plain merge-patch still REPLACES lists wholesale
+        resp = await env.request(
+            "PATCH", "/api/v1/namespaces/smp/pods/api", user="alice",
+            headers={"Content-Type": "application/merge-patch+json"},
+            body={"spec": {"containers": [
+                {"name": "only", "image": "o:v1"}]}})
+        assert resp.status == 200
+        assert [c["name"] for c in
+                env.kube.objects[key]["spec"]["containers"]] == ["only"]
+    run(go())

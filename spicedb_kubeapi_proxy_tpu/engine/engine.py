@@ -60,6 +60,17 @@ class WatchEvent:
     relationship: Relationship
 
 
+def mask_to_ids(mask, interner) -> list:
+    """Materialize allowed id strings from a lookup mask: the ONE place
+    the padded-index guard lives (padding indices can never be true — no
+    edges — but the interner bound is guarded anyway). Shared by the
+    in-process, remote, and multi-host lookup paths."""
+    if mask is None:
+        return []
+    return [interner.string(i) for i in np.flatnonzero(mask).tolist()
+            if i < len(interner)]
+
+
 def mask_pseudo_objects(mask: np.ndarray) -> np.ndarray:
     """Clear the reserved per-type pseudo-object indices (0 = void,
     1 = the wildcard object '*') from a lookup mask — shared by the direct
@@ -429,12 +440,7 @@ class Engine:
         mask, interner = self.lookup_resources_mask(
             resource_type, permission, subject_type, subject_id,
             subject_relation, now=now)
-        if mask is None:
-            return []
-        # the mask covers the bucket-padded object space; padding indices
-        # can never be true (no edges) but guard the interner bound anyway
-        return [interner.string(i) for i in np.flatnonzero(mask).tolist()
-                if i < len(interner)]
+        return mask_to_ids(mask, interner)
 
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,

@@ -242,6 +242,12 @@ class EngineServer:
                     await self._push_events(writer,
                                             int(req["from_revision"]))
                     return
+                if not isinstance(resp, BinaryResult) and resp.get("ok") \
+                        and req.get("op") == "mirror_subscribe":
+                    # multi-host follower: stream every mirrored engine
+                    # action (parallel/multihost.py MirroredEngine)
+                    await self._push_mirror(writer)
+                    return
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         except Exception:
@@ -385,6 +391,34 @@ class EngineServer:
                     for e in events
                 ]}))
             await writer.drain()
+
+    def _op_mirror_subscribe(self, req: dict):
+        """Ack for a multi-host follower subscription; _serve_inner then
+        switches the connection into the mirror-push loop. Only valid
+        when the engine is a MirroredEngine leader."""
+        if not hasattr(self.engine, "subscribe"):
+            raise StoreError(
+                "engine host is not a multi-host leader "
+                "(no MirroredEngine)")
+        return {"subscribed": True}
+
+    async def _push_mirror(self, writer: asyncio.StreamWriter) -> None:
+        import queue as _queue
+
+        q = self.engine.subscribe()
+        try:
+            while True:
+                try:
+                    frame = await asyncio.to_thread(
+                        q.get, True, self.PUSH_HEARTBEAT)
+                except _queue.Empty:
+                    writer.write(_pack({"ok": True, "hb": True}))
+                    await writer.drain()
+                    continue
+                writer.write(_pack({"ok": True, "frame": frame}))
+                await writer.drain()
+        finally:
+            self.engine.unsubscribe(q)
 
     def _op_watch_since(self, req: dict):
         return [
@@ -648,12 +682,9 @@ class RemoteEngine:
                 permission=permission, subject_type=subject_type,
                 subject_id=subject_id, subject_relation=subject_relation,
                 now=now)
-        if mask is None:
-            return []
-        import numpy as np
+        from .engine import mask_to_ids
 
-        return [interner.string(i) for i in np.flatnonzero(mask).tolist()
-                if i < len(interner)]
+        return mask_to_ids(mask, interner)
 
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,
@@ -790,9 +821,33 @@ def main(argv=None) -> int:
                     help="device mesh for this host's chips: 'auto' or "
                          "'data=D,graph=G' (the engine host owns the mesh; "
                          "proxies connect with tcp://)")
+    ap.add_argument("--distributed",
+                    help="multi-host: coordinator_host:port,"
+                         "num_processes,process_id — joins "
+                         "jax.distributed; with --engine-mesh auto the "
+                         "mesh spans every process's devices. Process 0 "
+                         "serves; others follow its mirror stream")
+    ap.add_argument("--mirror-leader",
+                    help="(follower processes) host:port of process 0's "
+                         "engine endpoint to subscribe to")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
+    process_id = 0
+    if args.distributed:
+        from ..parallel.multihost import MultiHostError, init_distributed
+
+        try:
+            init_distributed(args.distributed)
+        except MultiHostError as e:
+            ap.error(str(e))
+        import jax as _jax
+
+        process_id = _jax.process_index()
+        log.info("distributed: process %d of %d", process_id,
+                 _jax.process_count())
+        if process_id > 0 and not args.mirror_leader:
+            ap.error("follower processes need --mirror-leader host:port")
     mesh = None
     if args.engine_mesh:
         from ..parallel import make_mesh
@@ -808,6 +863,21 @@ def main(argv=None) -> int:
     if engine.load_snapshot_if_exists(args.snapshot_path):
         log.info("loaded snapshot %s (revision %d)", args.snapshot_path,
                  engine.revision)
+    if args.distributed and process_id > 0:
+        # follower: replay the leader's mirror stream until it ends
+        from ..parallel.multihost import follower_loop
+
+        host, _, port = args.mirror_leader.rpartition(":")
+        log.info("following leader %s:%s", host, port)
+        follower_loop(engine, host, int(port), token=args.token)
+        return 0
+    if args.distributed:
+        from ..parallel.multihost import MirroredEngine
+
+        # the join barrier: refuse to execute anything until every
+        # follower has subscribed (n-1 of them)
+        engine = MirroredEngine(
+            engine, min_subscribers=_jax.process_count() - 1)
     server = EngineServer(engine, args.bind_host, args.bind_port,
                           token=args.token)
 

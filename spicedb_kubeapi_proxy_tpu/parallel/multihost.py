@@ -17,18 +17,36 @@ load, dense blocks, collective joins, incremental writes) over two OS
 processes with Gloo carrying the cross-process collectives — the CPU
 stand-in for DCN.
 
-Serving integration (an engine host whose replicas span hosts) is the
-NEXT step, not yet wired: every process must apply the same writes and
-execute the same dispatches, so the TCP-serving process would broadcast
-(write-ops, query inputs) to follower processes — e.g. via
-``jax.experimental.multihost_utils.broadcast_one_to_all`` — before each
-step. The collective compute path that loop would execute is exactly
-what the validation harness proves out today.
+Serving integration: a multi-host engine host is ONE TCP-serving leader
+process plus follower processes that execute the same program in
+lockstep (the SPMD contract). The leader wraps its engine in
+:class:`MirroredEngine`, which SERIALIZES every state mutation and
+device dispatch, publishes each action to subscribed followers over the
+ordinary engine protocol (``mirror_subscribe``, a server-push stream
+like watches), resolves wall clocks to concrete values before
+publishing, and only then executes locally; followers replay the stream
+1:1 (:func:`follower_loop`). XLA collectives synchronize the actual
+compute — a follower that falls behind simply makes the leader's next
+collective wait. Validated end-to-end by
+``tests/test_multihost.py::test_multihost_serving_leader_follower``:
+leader + follower processes, a client driving real traffic over TCP.
+
+Failure model: SPMD is all-or-nothing — a dead follower blocks the
+leader's next collective (deploy the process set as a unit; an
+orchestrator restart heals it). Reads that touch no device (store reads,
+watch_gate, revision) are served leader-locally without mirroring.
 """
 
 from __future__ import annotations
 
+import logging
+import queue
+import threading
+from typing import Optional
+
 import jax
+
+log = logging.getLogger("sdbkp.multihost")
 
 
 class MultiHostError(RuntimeError):
@@ -40,10 +58,7 @@ def init_distributed(spec: str) -> None:
 
     ``spec`` is ``coordinator_host:port,num_processes,process_id`` —
     mirrors ``jax.distributed.initialize``'s required arguments as one
-    string. Called today by the multi-host validation harness
-    (tests/test_multihost.py); a multi-host serving engine host would
-    call it before building its mesh (see the module docstring for the
-    remaining serving-integration design)."""
+    string (the engine-host CLI exposes it as ``--distributed``)."""
     parts = spec.split(",")
     if len(parts) != 3:
         raise MultiHostError(
@@ -61,3 +76,290 @@ def init_distributed(spec: str) -> None:
             f"--distributed {spec!r}: process_id must be in [0, {n})")
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=n, process_id=p)
+
+
+class MirroredEngine:
+    """Leader-side engine wrapper for multi-host serving.
+
+    Every state mutation and device-dispatching query is (a) serialized
+    under one lock — SPMD processes must execute identical dispatch
+    sequences, so concurrent request handlers are ordered here — and
+    (b) published to follower subscribers BEFORE executing locally, with
+    wall clocks resolved to concrete values (``now=None`` would read a
+    different clock on every process). Device-free reads pass straight
+    through to the inner engine.
+
+    The proxy-facing surface matches :class:`~..engine.engine.Engine`
+    closely enough for EngineServer and the authz layers (check_bulk,
+    lookup_resources[_mask], write/delete/read, watch, store, gate)."""
+
+    def __init__(self, engine, min_subscribers: int = 0,
+                 join_timeout: float = 300.0):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._subs: list[queue.Queue] = []
+        self._subs_lock = threading.Lock()
+        self._seq = 0
+        # JOIN BARRIER: a leader must not execute (or drop!) any action
+        # before every follower is subscribed — writes never touch the
+        # device, so nothing else would stop an early client write from
+        # silently missing a follower and desyncing the stores. _publish
+        # blocks until the expected follower count has joined.
+        self._min_subs = min_subscribers
+        self._join_timeout = join_timeout
+        self._joined = threading.Event()
+        if min_subscribers <= 0:
+            self._joined.set()
+
+    # -- follower stream -----------------------------------------------------
+
+    def subscribe(self) -> "queue.Queue[dict]":
+        q: queue.Queue = queue.Queue()
+        with self._subs_lock:
+            self._subs.append(q)
+            if len(self._subs) >= self._min_subs:
+                self._joined.set()
+        return q
+
+    def unsubscribe(self, q) -> None:
+        with self._subs_lock:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    def _publish(self, method: str, payload: dict) -> None:
+        if not self._joined.wait(self._join_timeout):
+            raise MultiHostError(
+                f"{self._min_subs} follower(s) did not subscribe within "
+                f"{self._join_timeout:.0f}s; refusing to serve (an "
+                "unmirrored action would silently desync the stores)")
+        with self._subs_lock:
+            subs = list(self._subs)
+            self._seq += 1
+            frame = {"seq": self._seq, "method": method, **payload}
+        for q in subs:
+            q.put(frame)
+
+    # -- mirrored mutations --------------------------------------------------
+
+    def write_relationships(self, ops, preconditions=()):
+        from ..engine.remote import _rel_to_dict
+        from dataclasses import asdict
+
+        with self._lock:
+            self._publish("write_relationships", {
+                "ops": [{"op": o.op, "rel": _rel_to_dict(o.rel)}
+                        for o in ops],
+                "preconditions": [
+                    {"filter": asdict(p.filter),
+                     "must_exist": p.must_exist}
+                    for p in preconditions],
+            })
+            return self.engine.write_relationships(
+                list(ops), list(preconditions))
+
+    def delete_relationships(self, f, preconditions=()):
+        from dataclasses import asdict
+
+        with self._lock:
+            self._publish("delete_relationships", {
+                "filter": asdict(f),
+                "preconditions": [
+                    {"filter": asdict(p.filter),
+                     "must_exist": p.must_exist}
+                    for p in preconditions],
+            })
+            return self.engine.delete_relationships(f, list(preconditions))
+
+    def bulk_load(self, rels_cols):
+        # columnar payloads can be huge; mirror them as plain lists (the
+        # one-time load path, not the hot path)
+        with self._lock:
+            self._publish("bulk_load", {
+                "cols": {k: [str(x) for x in v] if k != "expiration"
+                         else [None if x != x else float(x) for x in v]
+                         for k, v in rels_cols.items()},
+            })
+            return self.engine.bulk_load(rels_cols)
+
+    # -- mirrored queries ----------------------------------------------------
+
+    def check_bulk(self, items, now=None):
+        return self.check_bulk_async(items, now=now).result()
+
+    def check_bulk_async(self, items, now=None):
+        import time as _time
+
+        if now is None:
+            now = _time.time()  # concrete BEFORE publishing
+        with self._lock:
+            self._publish("check_bulk", {
+                "items": [[it.resource_type, it.resource_id,
+                           it.permission, it.subject_type, it.subject_id,
+                           it.subject_relation] for it in items],
+                "now": now,
+            })
+            # dispatch inside the lock (ordering), result read outside
+            return self.engine.check_bulk_async(items, now=now)
+
+    def check(self, item, now=None):
+        return self.check_bulk([item], now=now)[0]
+
+    def lookup_resources(self, resource_type, permission, subject_type,
+                         subject_id, subject_relation=None, now=None):
+        from ..engine.engine import mask_to_ids
+
+        mask, interner = self.lookup_resources_mask(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now)
+        return mask_to_ids(mask, interner)
+
+    def lookup_resources_mask(self, resource_type, permission,
+                              subject_type, subject_id,
+                              subject_relation=None, now=None):
+        return self.lookup_resources_mask_async(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now).result()
+
+    def lookup_resources_mask_async(self, resource_type, permission,
+                                    subject_type, subject_id,
+                                    subject_relation=None, now=None):
+        import time as _time
+
+        if now is None:
+            now = _time.time()
+        with self._lock:
+            self._publish("lookup_mask", {
+                "resource_type": resource_type, "permission": permission,
+                "subject_type": subject_type, "subject_id": subject_id,
+                "subject_relation": subject_relation, "now": now,
+            })
+            return self.engine.lookup_resources_mask_async(
+                resource_type, permission, subject_type, subject_id,
+                subject_relation, now=now)
+
+    # -- device-free passthrough ---------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+
+def apply_mirror_frame(engine, frame: dict) -> None:
+    """Execute one published action on a follower's local engine. The
+    caller guarantees in-order delivery (TCP stream)."""
+    from ..engine.engine import SchemaViolation
+    from ..engine.store import StoreError
+
+    m = frame["method"]
+    try:
+        _apply_one(engine, frame, m)
+    except (StoreError, SchemaViolation) as e:
+        # deterministic engine-level failures (precondition conflicts,
+        # schema violations, AlreadyExists) happen IDENTICALLY on the
+        # leader — its execution runs after publishing — so the stores
+        # stay in sync; a follower must keep replaying, not die and
+        # leave the leader's next collective hanging
+        log.debug("mirror frame %s failed identically to leader: %s",
+                  m, e)
+
+
+def _apply_one(engine, frame: dict, m: str) -> None:
+    from ..engine import CheckItem
+    from ..engine.remote import _filter_from_dict, _rel_from_dict
+    from ..engine.store import Precondition, WriteOp
+
+    if m == "write_relationships":
+        engine.write_relationships(
+            [WriteOp(o["op"], _rel_from_dict(o["rel"]))
+             for o in frame["ops"]],
+            [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
+             for p in frame.get("preconditions", [])])
+    elif m == "delete_relationships":
+        engine.delete_relationships(
+            _filter_from_dict(frame["filter"]),
+            [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
+             for p in frame.get("preconditions", [])])
+    elif m == "bulk_load":
+        import numpy as np
+
+        cols = {}
+        for k, v in frame["cols"].items():
+            if k == "expiration":
+                cols[k] = np.asarray(
+                    [np.nan if x is None else x for x in v],
+                    dtype=np.float64)
+            else:
+                cols[k] = np.asarray(v, dtype=object)
+        engine.bulk_load(cols)
+    elif m == "check_bulk":
+        engine.check_bulk(
+            [CheckItem(*it) for it in frame["items"]], now=frame["now"])
+    elif m == "lookup_mask":
+        engine.lookup_resources_mask(
+            frame["resource_type"], frame["permission"],
+            frame["subject_type"], frame["subject_id"],
+            frame.get("subject_relation"), now=frame["now"])
+    else:
+        raise MultiHostError(f"unknown mirror method {m!r}")
+
+
+def follower_loop(engine, leader_host: str, leader_port: int,
+                  token: Optional[str] = None) -> None:
+    """Blocking follower: subscribe to the leader's mirror stream and
+    replay every action on the local engine — the device dispatches then
+    meet the leader's inside the shard_map collectives. Returns when
+    the leader closes the connection; raises on protocol errors."""
+    import socket
+    import struct
+    import time as _time
+
+    from ..engine.remote import EngineServer, _pack, _read_frame_sync
+
+    # the leader binds its port AFTER the symmetric jax.distributed
+    # startup, so the follower may dial first: retry refusals briefly
+    deadline = _time.monotonic() + 120
+    while True:
+        try:
+            s = socket.create_connection((leader_host, leader_port),
+                                         timeout=5)
+            break
+        except OSError:
+            if _time.monotonic() > deadline:
+                raise MultiHostError(
+                    f"leader {leader_host}:{leader_port} never came up")
+            _time.sleep(0.25)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # heartbeats arrive every PUSH_HEARTBEAT on idle streams; anything
+    # slower means a dead leader, not an idle one (a None timeout would
+    # leave a partitioned follower blocked forever, invisible to its
+    # supervisor)
+    s.settimeout(EngineServer.PUSH_HEARTBEAT * 3 + 5.0)
+    msg = {"op": "mirror_subscribe"}
+    if token:
+        msg["token"] = token
+    try:
+        s.sendall(_pack(msg))
+        ack = _read_frame_sync(s)
+        if isinstance(ack, tuple) or not ack.get("ok"):
+            raise MultiHostError(f"mirror subscribe rejected: {ack}")
+        expect = None
+        while True:
+            frame = _read_frame_sync(s)
+            if isinstance(frame, tuple) or not frame.get("ok"):
+                raise MultiHostError(f"mirror stream error: {frame}")
+            if frame.get("hb"):
+                continue  # idle-stream liveness heartbeat
+            payload = frame["frame"]
+            # first frame sets the baseline (a leader cannot have served
+            # traffic before followers joined — its collectives would
+            # have blocked — so nothing real precedes it); after that the
+            # stream must be gap-free
+            expect = payload["seq"] if expect is None else expect + 1
+            if payload["seq"] != expect:
+                raise MultiHostError(
+                    f"mirror gap: expected seq {expect}, "
+                    f"got {payload['seq']}")
+            apply_mirror_frame(engine, payload)
+    except (ConnectionResetError, struct.error):
+        return  # leader went away: the process set restarts as a unit
+    finally:
+        s.close()

@@ -23,6 +23,19 @@ def kind_for(resource: str) -> str:
     return "".join(p.capitalize() for p in singular.split("-"))
 
 
+def _strip_directives(v):
+    """Remove strategic-merge $patch directives from a value being
+    stored verbatim (the replace fallback when a list isn't mergeable by
+    name) — the real apiserver never persists directives."""
+    if isinstance(v, dict):
+        return {k: _strip_directives(x) for k, x in v.items()
+                if k != "$patch"}
+    if isinstance(v, list):
+        return [_strip_directives(x) for x in v
+                if not (isinstance(x, dict) and x.get("$patch") == "delete")]
+    return v
+
+
 class InMemoryKube:
     def __init__(self):
         # (resource, namespace, name) -> object dict
@@ -176,7 +189,7 @@ class InMemoryKube:
                                 by_name[x["name"]] = x
                         dst[k] = list(by_name.values())
                     else:
-                        dst[k] = v
+                        dst[k] = _strip_directives(v) if strategic else v
 
             merge(obj, patch)
             self.rv += 1

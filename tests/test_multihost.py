@@ -115,3 +115,129 @@ def test_two_process_sharded_engine_parity(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert "MULTIHOST PARITY OK" in out, out[-2000:]
+
+
+SERVE_WORKER = r"""
+import os, sys
+role, port_coord, port_tcp, repo = (sys.argv[1], sys.argv[2], sys.argv[3],
+                                    sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, repo)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+pid = "0" if role == "leader" else "1"
+argv = ["--distributed", f"127.0.0.1:{port_coord},2,{pid}",
+        "--engine-mesh", "auto", "--token", "mh-tok"]
+if role == "leader":
+    argv += ["--bind-port", port_tcp]
+    print("LEADER STARTING", flush=True)
+else:
+    argv += ["--mirror-leader", f"127.0.0.1:{port_tcp}",
+             "--bind-port", "0"]
+    print("FOLLOWER STARTING", flush=True)
+sys.exit(main(argv))
+"""
+
+
+def test_multihost_serving_leader_follower():
+    """Full multi-host SERVING: the engine-host CLI as leader (process 0,
+    serving TCP, MirroredEngine) + follower (process 1, replaying the
+    mirror stream); a real client drives writes, bulk checks, and mask
+    lookups whose collectives span both processes."""
+    import time
+
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp
+    from spicedb_kubeapi_proxy_tpu.engine.remote import RemoteEngine
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo_root, ".pytest-mh-serve-worker.py")
+    with open(script, "w") as f:
+        f.write(SERVE_WORKER)
+    port_coord, port_tcp = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    client = None
+    try:
+        for role in ("leader", "follower"):
+            procs.append(subprocess.Popen(
+                [sys.executable, script, role, str(port_coord),
+                 str(port_tcp), repo_root],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo_root))
+        # wait for the leader's TCP port to accept
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                probe = socket.create_connection(
+                    ("127.0.0.1", port_tcp), timeout=1)
+                probe.close()
+                break
+            except OSError:
+                for p in procs:
+                    assert p.poll() is None, \
+                        p.communicate()[0][-2000:]
+                assert time.monotonic() < deadline, "leader never bound"
+                time.sleep(0.25)
+        client = RemoteEngine("127.0.0.1", port_tcp, token="mh-tok")
+        rels = [f"namespace:n{i}#creator@user:u{i % 7}" for i in range(40)]
+        client.write_relationships(
+            [WriteOp("touch", parse_relationship(r)) for r in rels])
+        # reference truth from a local single-device engine
+        ref = Engine()
+        ref.write_relationships(
+            [WriteOp("touch", parse_relationship(r)) for r in rels])
+        items = [CheckItem("namespace", f"n{i}", "view", "user",
+                           f"u{i % 5}") for i in range(25)]
+        assert client.check_bulk(items) == ref.check_bulk(items)
+        assert sorted(client.lookup_resources(
+            "namespace", "view", "user", "u3")) == \
+            sorted(ref.lookup_resources("namespace", "view", "user", "u3"))
+        # a second write + re-query: the incremental path in lockstep
+        for eng in (client, ref):
+            eng.write_relationships([WriteOp("touch", parse_relationship(
+                "namespace:n1#viewer@user:u6"))])
+        assert client.check_bulk(
+            [CheckItem("namespace", "n1", "view", "user", "u6")]) == [True]
+        # a DETERMINISTICALLY-FAILING write (bad precondition) must fail
+        # identically on leader and follower — the follower keeps
+        # replaying rather than dying and hanging the next collective
+        from spicedb_kubeapi_proxy_tpu.engine import RelationshipFilter
+        from spicedb_kubeapi_proxy_tpu.engine.store import (
+            Precondition,
+            PreconditionFailed,
+        )
+
+        try:
+            client.write_relationships(
+                [WriteOp("touch", parse_relationship(
+                    "namespace:nope#viewer@user:u0"))],
+                [Precondition(RelationshipFilter(
+                    resource_type="ghost-type"), must_exist=True)])
+            raise AssertionError("precondition should have failed")
+        except PreconditionFailed:
+            pass
+        # the set is still alive and consistent after the failure
+        assert client.check_bulk(
+            [CheckItem("namespace", "n1", "view", "user", "u6")]) == [True]
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs:
+            p.terminate()
+        deadline = time.monotonic() + 20
+        outs = []
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            outs.append(p.communicate()[0])
+        os.unlink(script)
+    for role, out in zip(("leader", "follower"), outs):
+        assert "STARTING" in out, (role, out[-1500:])
+        assert "Traceback" not in out, (role, out[-2500:])

@@ -1328,3 +1328,66 @@ def test_strategic_merge_patch_through_dual_write():
         assert [c["name"] for c in
                 env.kube.objects[key]["spec"]["containers"]] == ["only"]
     run(go())
+
+
+def test_watch_churn_no_leaked_hub_state():
+    """Rapid watcher churn under write load: watchers that come and go
+    must leave ZERO hub state behind (groups empty, pump stopped) and
+    never wedge registration for later watchers — the register/
+    unregister/teardown interleavings are all lock-ordered."""
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+        from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+
+        env = Env()
+        await env.create_ns("churn", user="alice")
+        env.engine.check_bulk([
+            CheckItem("namespace", "warm", "view", "user", "alice")])
+
+        async def one_watcher(i):
+            resp = await env.request(
+                "GET", "/api/v1/namespaces", user="alice",
+                query={"watch": ["true"]})
+            assert resp.status == 200
+            frames = 0
+            async for f in resp.stream:
+                frames += 1
+                if frames >= 1 + (i % 2 == 0):
+                    break  # churn: leave after 1-2 frames
+            await resp.stream.aclose()
+
+        async def writer():
+            for j in range(10):
+                env.engine.write_relationships([WriteOp(
+                    "touch", parse_relationship(
+                        f"namespace:churn#viewer@user:w{j}"))])
+                env.kube.emit_watch_event("namespaces", "MODIFIED",
+                                          "churn")
+                await asyncio.sleep(0.02)
+
+        for wave in range(3):
+            tasks = [asyncio.ensure_future(one_watcher(i))
+                     for i in range(12)]
+            wtask = asyncio.ensure_future(writer())
+            await asyncio.wait_for(
+                asyncio.gather(*tasks, wtask), timeout=30)
+        hub = env.deps.watch_hub
+        await asyncio.wait_for(_wait_for(
+            lambda: not hub._groups), timeout=10)
+        assert hub._pump_task is None, "pump must stop with no watchers"
+        assert hub._push_stream is None
+        # and a fresh watcher still works after all the churn
+        resp = await env.request("GET", "/api/v1/namespaces",
+                                 user="alice", query={"watch": ["true"]})
+        frames = []
+
+        async def consume():
+            async for f in resp.stream:
+                frames.append(f)
+
+        t = asyncio.ensure_future(consume())
+        await asyncio.wait_for(_wait_for(lambda: len(frames) >= 1),
+                               timeout=10)
+        t.cancel()
+        env.kube.stop_watches()
+    run(go())

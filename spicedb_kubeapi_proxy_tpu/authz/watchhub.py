@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from typing import Optional
 
 from ..rules.compile import PreFilter
@@ -247,9 +248,37 @@ class WatchHub:
         try:
             stream = None
             if hasattr(eng, "watch_push_stream"):
+                # the connect runs in a worker thread that outlives a task
+                # cancellation; park the stream in a holder the moment it
+                # exists so exactly one side (the thread, or the cancel
+                # handler below) closes it — otherwise a cancel mid-connect
+                # leaks the dedicated socket until GC
+                holder: dict = {}
+                cancelled = threading.Event()
+
+                def _connect():
+                    s = eng.watch_push_stream(self._last_rev)
+                    holder["stream"] = s
+                    if cancelled.is_set():
+                        late = holder.pop("stream", None)
+                        if late is not None:
+                            try:
+                                late.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                    return s
+
                 try:
-                    stream = await asyncio.to_thread(
-                        eng.watch_push_stream, self._last_rev)
+                    stream = await asyncio.to_thread(_connect)
+                except asyncio.CancelledError:
+                    cancelled.set()
+                    orphan = holder.pop("stream", None)
+                    if orphan is not None:
+                        try:
+                            orphan.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    raise
                 except Exception as e:
                     # an engine host predating the watch_subscribe op (or
                     # a flaky connect): fall back to polling rather than

@@ -488,9 +488,12 @@ class Engine:
             if len(self._q_host) >= 64:
                 try:
                     # pop-with-default: concurrent lookups may race the
-                    # same oldest key (no lock on this path by design)
+                    # same oldest key (no lock on this path by design);
+                    # RuntimeError = the dict mutated between iter() and
+                    # next() — skip this eviction, the cache is bounded
+                    # by whoever wins
                     self._q_host.pop(next(iter(self._q_host)), None)
-                except StopIteration:
+                except (StopIteration, RuntimeError):
                     pass
             ent = (off + np.arange(n, dtype=np.int32),
                    np.zeros(n, dtype=np.int32))

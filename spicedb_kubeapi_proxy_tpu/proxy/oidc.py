@@ -93,7 +93,11 @@ class OIDCAuthenticator:
         if not issuer_url or not client_id:
             raise OIDCError("issuer_url and client_id are required")
         signing_algs = parse_signing_algs(",".join(signing_algs))
-        self.issuer = issuer_url.rstrip("/")
+        # kube compares the token's iss claim to the configured issuer URL
+        # EXACTLY (a trailing-slash difference rejects); only the discovery
+        # URL construction normalizes the slash.
+        self.issuer = issuer_url
+        self._issuer_base = issuer_url.rstrip("/")
         self.client_id = client_id
         self.username_claim = username_claim
         self.username_prefix = username_prefix
@@ -107,7 +111,11 @@ class OIDCAuthenticator:
         self._jwks_uri = jwks_uri
         self._fetch = fetch or (
             lambda url: _default_fetch(url, ca_file, http_timeout))
+        # _lock guards the key map + refresh stamp only; the network fetch
+        # runs OUTSIDE it, serialized by _refresh_lock (single-flight), so
+        # a hung IDP socket never blocks validations whose kid is cached.
         self._lock = threading.Lock()
+        self._refresh_lock = threading.Lock()
         self._keys: Optional[dict[str, dict]] = None  # kid -> JWK
         self._keys_unnamed: list[dict] = []  # JWKs without a kid
         self._last_refresh = 0.0
@@ -115,9 +123,9 @@ class OIDCAuthenticator:
     # -- JWKS ----------------------------------------------------------------
 
     def _discover_jwks_uri(self) -> str:
-        url = self.issuer + DISCOVERY_PATH
+        url = self._issuer_base + DISCOVERY_PATH
         doc = json.loads(self._fetch(url))
-        if doc.get("issuer", "").rstrip("/") != self.issuer:
+        if doc.get("issuer", "").rstrip("/") != self._issuer_base:
             raise OIDCError(
                 f"discovery document issuer {doc.get('issuer')!r} does not "
                 f"match configured issuer {self.issuer!r}")
@@ -126,10 +134,10 @@ class OIDCAuthenticator:
             raise OIDCError("discovery document has no jwks_uri")
         return uri
 
-    def _refresh_keys_locked(self) -> None:
-        # stamp the ATTEMPT, not just success: with the IDP down, a storm
-        # of forged-kid tokens must not translate into a fetch per token
-        self._last_refresh = time.monotonic()
+    def _refresh(self) -> None:
+        """Network half of a JWKS refresh. Caller holds _refresh_lock (so
+        fetches are single-flight) but NOT _lock — concurrent validations
+        against the cached map proceed while this blocks on the IDP."""
         if self._jwks_uri is None:
             self._jwks_uri = self._discover_jwks_uri()
         doc = json.loads(self._fetch(self._jwks_uri))
@@ -142,27 +150,82 @@ class OIDCAuthenticator:
                 keys[k["kid"]] = k
             else:
                 unnamed.append(k)
-        self._keys = keys
-        self._keys_unnamed = unnamed
+        with self._lock:
+            self._keys = keys
+            self._keys_unnamed = unnamed
+
+    def _stamp_attempt(self) -> None:
+        # stamp the ATTEMPT, not just success: with the IDP down, a storm
+        # of forged-kid tokens must not translate into a fetch per token
+        with self._lock:
+            self._last_refresh = time.monotonic()
 
     def _candidate_keys(self, kid: Optional[str]) -> list[dict]:
         """JWKs to try for a token, refreshing on an unknown kid (key
-        rotation) no more than once per cooldown window."""
+        rotation) no more than once per cooldown window.
+
+        Stale-while-revalidate: a validation whose kid is in the cached
+        map never touches the network or waits on a fetch in flight; only
+        the request that actually triggers a refresh pays for it, and
+        concurrent would-be refreshers fail fast instead of queueing
+        behind one hung socket."""
         with self._lock:
-            if self._keys is None:
-                # the first fetch failed earlier: retry only past the
-                # cooldown, so an unreachable IDP costs one fetch per
-                # window rather than one per presented token
-                if self._last_refresh and time.monotonic() - \
-                        self._last_refresh <= REFRESH_COOLDOWN:
-                    raise OIDCError("JWKS unavailable (cooling down)")
-                self._refresh_keys_locked()
+            keys = self._keys
+            last = self._last_refresh
+        if keys is not None:
+            if kid is None:
+                with self._lock:
+                    return list(self._keys.values()) + \
+                        list(self._keys_unnamed)
+            k = keys.get(kid)
+            if k is not None:
+                return [k]
+            # unknown kid — plausible key rotation; at most one refetch
+            # per cooldown window, and only by whoever wins the try-lock
+            if time.monotonic() - last > REFRESH_COOLDOWN and \
+                    self._refresh_lock.acquire(blocking=False):
+                try:
+                    # re-check under the lock: another refresher may have
+                    # just finished while we read the stale stamp —
+                    # back-to-back fetches would defeat the cooldown's
+                    # forged-kid-storm defense
+                    with self._lock:
+                        last = self._last_refresh
+                    if time.monotonic() - last > REFRESH_COOLDOWN:
+                        self._stamp_attempt()
+                        self._refresh()
+                finally:
+                    self._refresh_lock.release()
+                with self._lock:
+                    k = (self._keys or {}).get(kid)
+                return [k] if k is not None else []
+            return []
+        # no key map yet (first token, or every earlier fetch failed):
+        # retry only past the cooldown, one fetcher at a time; losers of
+        # the try-lock reject rather than stack up on the IDP socket
+        if last and time.monotonic() - last <= REFRESH_COOLDOWN:
+            raise OIDCError("JWKS unavailable (cooling down)")
+        if not self._refresh_lock.acquire(blocking=False):
+            raise OIDCError("JWKS fetch already in flight")
+        try:
+            # re-check under the lock (see the rotation branch above): a
+            # just-finished fetch that still yielded no keys means the
+            # IDP is down — cool down instead of immediately refetching
+            with self._lock:
+                last = self._last_refresh
+                have_keys = self._keys is not None
+            if not have_keys and last and \
+                    time.monotonic() - last <= REFRESH_COOLDOWN:
+                raise OIDCError("JWKS unavailable (cooling down)")
+            if not have_keys:
+                self._stamp_attempt()
+                self._refresh()
+        finally:
+            self._refresh_lock.release()
+        with self._lock:
+            assert self._keys is not None  # _refresh raises on failure
             if kid is not None:
                 k = self._keys.get(kid)
-                if k is None and \
-                        time.monotonic() - self._last_refresh > REFRESH_COOLDOWN:
-                    self._refresh_keys_locked()
-                    k = self._keys.get(kid)
                 return [k] if k is not None else []
             return list(self._keys.values()) + list(self._keys_unnamed)
 
@@ -184,8 +247,9 @@ class OIDCAuthenticator:
         if alg not in self.signing_algs:
             raise OIDCError(f"alg {alg!r} not in accepted set "
                             f"{self.signing_algs}")
-        iss = str(claims.get("iss", "")).rstrip("/")
-        if iss != self.issuer:
+        # exact comparison, matching kube: a trailing-slash difference
+        # between the token's iss and the configured issuer REJECTS
+        if claims.get("iss") != self.issuer:
             raise OIDCError(f"issuer {claims.get('iss')!r} does not match "
                             f"{self.issuer!r}")
         keys = self._candidate_keys(header.get("kid"))

@@ -277,6 +277,103 @@ def test_jwks_fetch_failure_fails_closed_and_cools_down():
     assert len(calls) == 1
 
 
+def test_issuer_comparison_is_exact_including_trailing_slash():
+    """kube's OIDC authenticator compares iss to the configured issuer
+    exactly — a trailing-slash-only difference rejects (advisor finding:
+    normalizing both sides accepted tokens kube would refuse)."""
+    a = make_auth()  # configured issuer has no trailing slash
+    assert a.authenticate_token(
+        sign_jwt(std_claims(iss=ISSUER + "/"))) is None
+    # and the reverse: configured WITH slash only accepts iss with slash
+    b = make_auth(issuer_url=ISSUER + "/")
+    assert b.authenticate_token(
+        sign_jwt(std_claims(iss=ISSUER + "/"))) is not None
+    assert b.authenticate_token(sign_jwt(std_claims())) is None
+
+
+def test_hung_jwks_fetch_blocks_only_the_triggering_request(monkeypatch):
+    """Stale-while-revalidate: with the key map cached, a token bearing an
+    unknown kid may stall on a hung IDP fetch, but concurrent validations
+    whose kid IS cached must complete without waiting on that socket
+    (VERDICT r4 Weak #6 / directive #6)."""
+    release = threading.Event()
+    fetched_once = threading.Event()
+
+    def fetch(url):
+        if fetched_once.is_set():
+            # second fetch = the rotation refetch: hang until released
+            assert release.wait(30), "test released too late"
+            raise OSError("idp gone")
+        fetched_once.set()
+        return json.dumps({"keys": [rsa_jwk()]}).encode()
+
+    a = make_auth(fetch=fetch)
+    assert a.authenticate_token(sign_jwt(std_claims())) is not None  # prime
+    monkeypatch.setattr(
+        "spicedb_kubeapi_proxy_tpu.proxy.oidc.REFRESH_COOLDOWN", 0.0)
+
+    hung_done = threading.Event()
+
+    def hung_request():
+        a.authenticate_token(sign_jwt(std_claims(), kid="rotated"))
+        hung_done.set()
+
+    t = threading.Thread(target=hung_request, daemon=True)
+    t.start()
+    # wait until the refresher actually owns the refresh lock
+    deadline = time.monotonic() + 5
+    while not a._refresh_lock.locked() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert a._refresh_lock.locked(), "refresh never started"
+    # cached-kid validations proceed while the fetch hangs
+    t0 = time.monotonic()
+    assert a.authenticate_token(sign_jwt(std_claims())) is not None
+    assert time.monotonic() - t0 < 1.0, "cached-kid auth waited on fetch"
+    # a SECOND unknown-kid token must not queue behind the hung socket
+    t0 = time.monotonic()
+    assert a.authenticate_token(
+        sign_jwt(std_claims(), kid="rotated2")) is None
+    assert time.monotonic() - t0 < 1.0, "second refresher queued on fetch"
+    assert not hung_done.is_set()
+    release.set()
+    t.join(10)
+    assert hung_done.is_set()
+
+
+def test_initial_jwks_fetch_is_single_flight():
+    """Before any keys are cached, exactly one request performs the fetch;
+    concurrent first requests fail fast instead of stacking up on the
+    IDP socket."""
+    release = threading.Event()
+    calls = []
+
+    def fetch(url):
+        calls.append(url)
+        assert release.wait(30)
+        return json.dumps({"keys": [rsa_jwk()]}).encode()
+
+    a = make_auth(fetch=fetch)
+    results = {}
+
+    def first():
+        results["first"] = a.authenticate_token(sign_jwt(std_claims()))
+
+    t = threading.Thread(target=first, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not a._refresh_lock.locked() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    # a concurrent request while the initial fetch hangs: rejected fast
+    t0 = time.monotonic()
+    assert a.authenticate_token(sign_jwt(std_claims())) is None
+    assert time.monotonic() - t0 < 1.0
+    assert len(calls) == 1
+    release.set()
+    t.join(10)
+    # the request that performed the fetch succeeds once the IDP answers
+    assert results["first"] is not None
+
+
 def test_kidless_token_tries_all_candidate_keys():
     """A mixed-kty JWKS with kid-less keys: the EC key raising a
     key-type mismatch must not abort the scan before the RSA key verifies

@@ -16,8 +16,12 @@ Protocol: 4-byte big-endian length-prefixed frames.
             | binary: 0x00 byte + 4-byte meta length + meta JSON + payload
 Errors round-trip by kind so precondition failures and schema violations
 keep their meaning across the wire (the dual-write activities branch on
-them). Transport security is left to the surrounding infrastructure; a
-shared bearer token gates requests like the reference's token option.
+them). Transport security mirrors the reference's remote endpoint
+(TLS with CA verification plus bearer token, options.go:325-369): the
+host serves TLS from a cert/key pair (``--tls-cert-file``/``--tls-key-
+file``, optional ``--tls-client-ca-file`` for mutual TLS) and refuses to
+serve plaintext unless explicitly ``--engine-insecure``; clients verify
+against the system store or ``--engine-ca-file`` (utils/tlsconf.py).
 
 The binary response form exists for the list-filter hot path: the
 ``lookup_mask`` op returns the allowed set as a PACKED BITMASK over the
@@ -38,6 +42,7 @@ import hmac
 import json
 import logging
 import socket
+import ssl
 import struct
 import threading
 from dataclasses import asdict
@@ -165,19 +170,26 @@ class EngineServer:
     same way in-process callers do."""
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
-                 port: int = 0, token: Optional[str] = None):
+                 port: int = 0, token: Optional[str] = None,
+                 ssl_context=None):
         self.engine = engine
         self.host = host
         self.port = port
         self.token = token
+        # an ssl.SSLContext makes every connection TLS (utils/tlsconf.py:
+        # the reference's remote endpoint is TLS-by-default,
+        # options.go:325-369); None serves plaintext — the standalone CLI
+        # refuses that combination unless --engine-insecure is explicit
+        self.ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()  # live connection-handler tasks
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._serve, self.host, self.port)
+            self._serve, self.host, self.port, ssl=self.ssl_context)
         self.port = self._server.sockets[0].getsockname()[1]
-        log.info("engine listening on %s:%d", self.host, self.port)
+        log.info("engine listening on %s:%d%s", self.host, self.port,
+                 " (TLS)" if self.ssl_context else "")
         return self.port
 
     async def stop(self, grace: float = 2.0) -> None:
@@ -532,10 +544,16 @@ class RemoteEngine:
 
     def __init__(self, host: str, port: int, token: Optional[str] = None,
                  timeout: float = 300.0, connect_timeout: float = 10.0,
-                 pool_size: int = 8):
+                 pool_size: int = 8, ssl_context=None,
+                 server_hostname: Optional[str] = None):
         self.host = host
         self.port = port
         self.token = token
+        # TLS to the engine host (utils/tlsconf.client_ssl_context);
+        # server_hostname overrides the SNI/verification name when the
+        # dialed address is not the certificate's name (e.g. an IP)
+        self.ssl_context = ssl_context
+        self.server_hostname = server_hostname or host
         # response wait: generous — the first query after a snapshot
         # refresh pays an XLA compile measured in tens of seconds at the
         # 10M-relationship scale, and a timed-out-but-completing server op
@@ -556,8 +574,15 @@ class RemoteEngine:
     def _connect(self) -> socket.socket:
         s = socket.create_connection((self.host, self.port),
                                      timeout=self.connect_timeout)
-        s.settimeout(self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self.ssl_context is not None:
+            try:
+                s = self.ssl_context.wrap_socket(
+                    s, server_hostname=self.server_hostname)
+            except Exception:
+                s.close()
+                raise
+        s.settimeout(self.timeout)
         return s
 
     def _acquire(self) -> tuple[socket.socket, bool]:
@@ -578,6 +603,11 @@ class RemoteEngine:
                     probe = s.recv(1)
                     alive = False  # b'' (FIN) or stray data: discard
                 except (BlockingIOError, InterruptedError):
+                    alive = True
+                    probe = None
+                except ssl.SSLWantReadError:
+                    # TLS socket with no buffered record: alive (the
+                    # plaintext path surfaces this case as BlockingIOError)
                     alive = True
                     probe = None
                 if alive:
@@ -814,6 +844,26 @@ def main(argv=None) -> int:
     ap.add_argument("--bind-host", default="127.0.0.1")
     ap.add_argument("--bind-port", type=int, default=50051)
     ap.add_argument("--token", help="shared bearer token")
+    # transport security (reference remote-endpoint flag shape,
+    # options.go:325-369): TLS is the default posture — serving requires
+    # a cert/key pair, and plaintext requires an explicit opt-out
+    ap.add_argument("--tls-cert-file",
+                    help="serving certificate (PEM); enables TLS")
+    ap.add_argument("--tls-key-file",
+                    help="serving private key (PEM)")
+    ap.add_argument("--tls-client-ca-file",
+                    help="require client certificates signed by this CA "
+                         "(mutual TLS, on top of the token)")
+    ap.add_argument("--engine-insecure", action="store_true",
+                    help="serve PLAINTEXT TCP (and dial the mirror "
+                         "leader plaintext) — tokens and relationships "
+                         "transit in the clear; never use across hosts")
+    ap.add_argument("--mirror-ca-file",
+                    help="(follower) CA bundle for verifying the mirror "
+                         "leader's certificate (default: system store)")
+    ap.add_argument("--mirror-skip-verify-ca", action="store_true",
+                    help="(follower) TLS to the leader without "
+                         "certificate verification")
     ap.add_argument("--snapshot-path",
                     help="relationship-store snapshot: loaded at boot if "
                          "present, saved on graceful shutdown")
@@ -832,6 +882,52 @@ def main(argv=None) -> int:
                          "engine endpoint to subscribe to")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+
+    from ..utils.tlsconf import (
+        TLSConfigError,
+        client_ssl_context,
+        server_ssl_context,
+    )
+
+    if bool(args.tls_cert_file) != bool(args.tls_key_file):
+        ap.error("--tls-cert-file and --tls-key-file go together")
+    if args.engine_insecure and args.tls_cert_file:
+        ap.error("--engine-insecure and --tls-cert-file are mutually "
+                 "exclusive")
+    # a mirror FOLLOWER never serves — it only dials the leader — so the
+    # refuse-plaintext-serving check must not force cert/key on it
+    is_follower = False
+    if args.distributed:
+        from ..parallel.multihost import (
+            MultiHostError,
+            parse_distributed_spec,
+        )
+
+        try:
+            _, _, _spec_pid = parse_distributed_spec(args.distributed)
+        except MultiHostError as e:
+            ap.error(str(e))
+        is_follower = _spec_pid > 0 and bool(args.mirror_leader)
+    server_ssl = None
+    if args.tls_cert_file:
+        try:
+            server_ssl = server_ssl_context(args.tls_cert_file,
+                                            args.tls_key_file,
+                                            args.tls_client_ca_file)
+        except TLSConfigError as e:
+            ap.error(str(e))
+    elif not args.engine_insecure and not is_follower:
+        ap.error("refusing to serve plaintext TCP: pass --tls-cert-file/"
+                 "--tls-key-file, or --engine-insecure to opt out "
+                 "explicitly (the token and every relationship would "
+                 "transit in the clear)")
+    mirror_ssl = None
+    if not args.engine_insecure:
+        try:
+            mirror_ssl = client_ssl_context(
+                args.mirror_ca_file, args.mirror_skip_verify_ca)
+        except TLSConfigError as e:
+            ap.error(str(e))
 
     process_id = 0
     if args.distributed:
@@ -868,8 +964,10 @@ def main(argv=None) -> int:
         from ..parallel.multihost import follower_loop
 
         host, _, port = args.mirror_leader.rpartition(":")
-        log.info("following leader %s:%s", host, port)
-        follower_loop(engine, host, int(port), token=args.token)
+        log.info("following leader %s:%s%s", host, port,
+                 " (TLS)" if mirror_ssl else "")
+        follower_loop(engine, host, int(port), token=args.token,
+                      ssl_context=mirror_ssl)
         return 0
     if args.distributed:
         from ..parallel.multihost import MirroredEngine
@@ -879,7 +977,7 @@ def main(argv=None) -> int:
         engine = MirroredEngine(
             engine, min_subscribers=_jax.process_count() - 1)
     server = EngineServer(engine, args.bind_host, args.bind_port,
-                          token=args.token)
+                          token=args.token, ssl_context=server_ssl)
 
     async def serve():
         stop = asyncio.Event()

@@ -53,12 +53,10 @@ class MultiHostError(RuntimeError):
     pass
 
 
-def init_distributed(spec: str) -> None:
-    """Join the JAX distributed coordination service.
-
-    ``spec`` is ``coordinator_host:port,num_processes,process_id`` —
-    mirrors ``jax.distributed.initialize``'s required arguments as one
-    string (the engine-host CLI exposes it as ``--distributed``)."""
+def parse_distributed_spec(spec: str) -> tuple[str, int, int]:
+    """``coordinator_host:port,num_processes,process_id`` -> parsed
+    triple. The ONE owner of this format — the engine-host CLI also
+    consults it (follower detection) before initializing anything."""
     parts = spec.split(",")
     if len(parts) != 3:
         raise MultiHostError(
@@ -74,6 +72,14 @@ def init_distributed(spec: str) -> None:
     if not (0 <= p < n):
         raise MultiHostError(
             f"--distributed {spec!r}: process_id must be in [0, {n})")
+    return coordinator, n, p
+
+
+def init_distributed(spec: str) -> None:
+    """Join the JAX distributed coordination service (spec format:
+    :func:`parse_distributed_spec`; the engine-host CLI exposes it as
+    ``--distributed``)."""
+    coordinator, n, p = parse_distributed_spec(spec)
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=n, process_id=p)
 
@@ -303,11 +309,15 @@ def _apply_one(engine, frame: dict, m: str) -> None:
 
 
 def follower_loop(engine, leader_host: str, leader_port: int,
-                  token: Optional[str] = None) -> None:
+                  token: Optional[str] = None,
+                  ssl_context=None,
+                  server_hostname: Optional[str] = None) -> None:
     """Blocking follower: subscribe to the leader's mirror stream and
     replay every action on the local engine — the device dispatches then
     meet the leader's inside the shard_map collectives. Returns when
-    the leader closes the connection; raises on protocol errors."""
+    the leader closes the connection; raises on protocol errors.
+    ``ssl_context`` wraps the subscription in TLS (the leader serves the
+    ordinary engine endpoint, which is TLS unless --engine-insecure)."""
     import socket
     import struct
     import time as _time
@@ -328,6 +338,13 @@ def follower_loop(engine, leader_host: str, leader_port: int,
                     f"leader {leader_host}:{leader_port} never came up")
             _time.sleep(0.25)
     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if ssl_context is not None:
+        try:
+            s = ssl_context.wrap_socket(
+                s, server_hostname=server_hostname or leader_host)
+        except Exception:
+            s.close()
+            raise
     # heartbeats arrive every PUSH_HEARTBEAT on idle streams; anything
     # slower means a dead leader, not an idle one (a None timeout would
     # leave a partitioned follower blocked forever, invisible to its

@@ -51,6 +51,18 @@ class Options:
     # the reference's remote-SpiceDB deployment shape, options.go:325-369)
     engine_endpoint: str = TPU_ENDPOINT
     engine_token: Optional[str] = None  # bearer token for tcp:// endpoints
+    # tcp:// transport security (reference remote-endpoint flag shape:
+    # --spicedb-insecure / --spicedb-skip-verify-ca / --spicedb-ca-path,
+    # options.go:325-369): TLS with full verification is the DEFAULT;
+    # plaintext requires the explicit opt-out
+    engine_insecure: bool = False
+    engine_ca_file: Optional[str] = None  # custom CA (default: system)
+    engine_skip_verify_ca: bool = False
+    engine_client_cert_file: Optional[str] = None  # mutual-TLS client pair
+    engine_client_key_file: Optional[str] = None
+    # verification/SNI name when dialing an address that isn't the cert's
+    # name (e.g. tcp://10.0.0.5:50051 with a DNS-named certificate)
+    engine_server_name: Optional[str] = None
     bootstrap_files: list = field(default_factory=list)
     bootstrap_content: Optional[str] = None  # yaml text
     rule_files: list = field(default_factory=list)
@@ -160,6 +172,24 @@ class Options:
             raise OptionsError(
                 "engine-mesh applies to in-process engines; configure the "
                 "mesh on the tcp:// engine host instead")
+        if remote is None and (
+                self.engine_insecure or self.engine_ca_file or
+                self.engine_skip_verify_ca or self.engine_client_cert_file
+                or self.engine_server_name):
+            raise OptionsError(
+                "engine-insecure/ca-file/skip-verify-ca/client-cert/"
+                "server-name apply only to tcp:// engine endpoints")
+        if self.engine_insecure and (
+                self.engine_ca_file or self.engine_skip_verify_ca or
+                self.engine_client_cert_file):
+            raise OptionsError(
+                "engine-insecure (plaintext) excludes the TLS options "
+                "(engine-ca-file/skip-verify-ca/client-cert)")
+        if bool(self.engine_client_cert_file) != \
+                bool(self.engine_client_key_file):
+            raise OptionsError(
+                "engine-client-cert-file and engine-client-key-file "
+                "must be set together")
         if self.engine_mesh:
             _parse_mesh_spec(self.engine_mesh)  # raises OptionsError
         if self.feature_gates:
@@ -240,7 +270,23 @@ class Options:
         if remote is not None:
             from ..engine.remote import RemoteEngine
 
-            engine = RemoteEngine(*remote, token=self.engine_token)
+            ssl_context = None
+            if not self.engine_insecure:
+                from ..utils.tlsconf import (
+                    TLSConfigError,
+                    client_ssl_context,
+                )
+
+                try:
+                    ssl_context = client_ssl_context(
+                        self.engine_ca_file, self.engine_skip_verify_ca,
+                        self.engine_client_cert_file,
+                        self.engine_client_key_file)
+                except TLSConfigError as e:
+                    raise OptionsError(str(e)) from None
+            engine = RemoteEngine(*remote, token=self.engine_token,
+                                  ssl_context=ssl_context,
+                                  server_hostname=self.engine_server_name)
         else:
             bootstrap = "\n---\n".join(
                 [open(f).read() for f in self.bootstrap_files]
@@ -393,6 +439,24 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "| tcp://host:port (remote engine host)")
     parser.add_argument("--engine-token",
                         help="bearer token for tcp:// engine endpoints")
+    parser.add_argument("--engine-insecure", action="store_true",
+                        help="PLAINTEXT TCP to the engine host (token and "
+                             "relationships in the clear); TLS with full "
+                             "verification is the default")
+    parser.add_argument("--engine-ca-file",
+                        help="CA bundle for verifying the engine host's "
+                             "certificate (default: system trust store)")
+    parser.add_argument("--engine-skip-verify-ca", action="store_true",
+                        help="TLS to the engine host without certificate "
+                             "verification")
+    parser.add_argument("--engine-client-cert-file",
+                        help="client certificate for mutual TLS to the "
+                             "engine host")
+    parser.add_argument("--engine-client-key-file",
+                        help="client key for mutual TLS to the engine host")
+    parser.add_argument("--engine-server-name",
+                        help="expected certificate name when dialing an "
+                             "address that is not the cert's name")
     parser.add_argument("--bootstrap", action="append", default=[],
                         help="schema/relationships bootstrap YAML (repeatable)")
     parser.add_argument("--rule-file", action="append", default=[],
@@ -478,6 +542,12 @@ def options_from_args(args: argparse.Namespace) -> Options:
     return Options(
         engine_endpoint=args.engine_endpoint,
         engine_token=args.engine_token,
+        engine_insecure=args.engine_insecure,
+        engine_ca_file=args.engine_ca_file,
+        engine_skip_verify_ca=args.engine_skip_verify_ca,
+        engine_client_cert_file=args.engine_client_cert_file,
+        engine_client_key_file=args.engine_client_key_file,
+        engine_server_name=args.engine_server_name,
         bootstrap_files=args.bootstrap,
         rule_files=args.rule_file,
         upstream_url=args.upstream_url,

@@ -1,0 +1,561 @@
+"""TLS on the ``tcp://`` engine protocol.
+
+The reference's remote backend endpoint is TLS with CA verification plus
+token by default, plaintext only behind --spicedb-insecure
+(/root/reference/pkg/proxy/options.go:325-369). These tests run a real
+self-signed CA: request path (JSON + binary mask frames), server-push
+watch stream, mirror stream, mutual TLS, and the refuse-plaintext
+postures on both the engine-host CLI and the proxy options."""
+
+import asyncio
+import datetime
+import ipaddress
+import socket
+import ssl
+import threading
+
+import pytest
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp
+from spicedb_kubeapi_proxy_tpu.engine.remote import (
+    EngineServer,
+    RemoteEngine,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+from spicedb_kubeapi_proxy_tpu.utils.tlsconf import (
+    TLSConfigError,
+    client_ssl_context,
+    server_ssl_context,
+)
+
+
+def _key():
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _name(cn):
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def _cert(subject, issuer, pub, signer, *, ca=False, san=None):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (x509.CertificateBuilder()
+         .subject_name(subject)
+         .issuer_name(issuer)
+         .public_key(pub)
+         .serial_number(x509.random_serial_number())
+         .not_valid_before(now - datetime.timedelta(minutes=5))
+         .not_valid_after(now + datetime.timedelta(days=1))
+         .add_extension(x509.BasicConstraints(ca=ca, path_length=None),
+                        critical=True))
+    if san:
+        b = b.add_extension(x509.SubjectAlternativeName(san), critical=False)
+    return b.sign(signer, hashes.SHA256())
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """CA + engine-host server cert + a client cert, and a SECOND
+    independent CA for negative tests."""
+    d = tmp_path_factory.mktemp("engine-pki")
+
+    def write(path, *objs):
+        data = b"".join(
+            o.private_bytes(serialization.Encoding.PEM,
+                            serialization.PrivateFormat.PKCS8,
+                            serialization.NoEncryption())
+            if hasattr(o, "private_bytes")
+            else o.public_bytes(serialization.Encoding.PEM)
+            for o in objs)
+        p = d / path
+        p.write_bytes(data)
+        return str(p)
+
+    files = {}
+    for prefix in ("ca", "otherca"):
+        ca_key = _key()
+        ca_name = _name(f"engine-{prefix}")
+        ca_cert = _cert(ca_name, ca_name, ca_key.public_key(), ca_key,
+                        ca=True)
+        files[prefix] = write(f"{prefix}.pem", ca_cert)
+        srv_key = _key()
+        srv_cert = _cert(
+            _name("engine-host"), ca_name, srv_key.public_key(), ca_key,
+            san=[x509.DNSName("localhost"),
+                 x509.IPAddress(ipaddress.ip_address("127.0.0.1"))])
+        files[f"{prefix}_server_cert"] = write(f"{prefix}-server.pem",
+                                               srv_cert)
+        files[f"{prefix}_server_key"] = write(f"{prefix}-server-key.pem",
+                                              srv_key)
+        cl_key = _key()
+        cl_cert = _cert(_name("proxy-client"), ca_name,
+                        cl_key.public_key(), ca_key)
+        files[f"{prefix}_client"] = write(f"{prefix}-client.pem",
+                                          cl_cert, cl_key)
+    return files
+
+
+def _seed_engine() -> Engine:
+    e = Engine()
+    e.write_relationships([
+        WriteOp("touch", parse_relationship(
+            f"namespace:n{i}#creator@user:u{i % 3}"))
+        for i in range(10)
+    ])
+    return e
+
+
+def run_with_tls_server(engine, fn, pki, client_ca=None, token="tls-tok"):
+    """Start a TLS EngineServer and run ``fn(client_kwargs, port)`` in a
+    worker thread on a live event loop."""
+    server_ssl = server_ssl_context(pki["ca_server_cert"],
+                                    pki["ca_server_key"],
+                                    client_ca_file=client_ca)
+
+    async def go():
+        srv = EngineServer(engine, port=0, token=token,
+                           ssl_context=server_ssl)
+        port = await srv.start()
+        try:
+            await asyncio.to_thread(fn, port)
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_tls_request_path_json_and_binary_frames(pki):
+    """check_bulk (JSON frames) and lookup_resources (binary mask frame +
+    id sync) round-trip over TLS with CA verification."""
+    e = _seed_engine()
+
+    def fn(port):
+        ctx = client_ssl_context(ca_file=pki["ca"])
+        c = RemoteEngine("127.0.0.1", port, token="tls-tok",
+                         ssl_context=ctx, server_hostname="localhost")
+        try:
+            got = c.check_bulk([
+                CheckItem("namespace", "n1", "view", "user", "u1"),
+                CheckItem("namespace", "n1", "view", "user", "u2"),
+            ])
+            assert got == [True, False]
+            ids = c.lookup_resources("namespace", "view", "user", "u0")
+            assert sorted(ids) == ["n0", "n3", "n6", "n9"]
+            # writes and pooled-socket reuse (the TLS liveness probe must
+            # treat an idle TLS socket as alive, not discard it)
+            c.write_relationships([WriteOp("touch", parse_relationship(
+                "namespace:fresh#creator@user:u1"))])
+            assert c.check_bulk([CheckItem(
+                "namespace", "fresh", "view", "user", "u1")]) == [True]
+        finally:
+            c.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_tls_pooled_sockets_are_reused(pki):
+    """The pool's pre-send liveness probe must keep idle TLS sockets —
+    re-handshaking per request would tank the remote hot path."""
+    e = _seed_engine()
+
+    def fn(port):
+        ctx = client_ssl_context(ca_file=pki["ca"])
+        c = RemoteEngine("127.0.0.1", port, token="tls-tok",
+                         ssl_context=ctx, server_hostname="localhost")
+        try:
+            for _ in range(3):
+                c.check_bulk([CheckItem("namespace", "n1", "view",
+                                        "user", "u1")])
+            with c._pool_lock:
+                pooled = list(c._pool)
+            assert len(pooled) == 1  # sequential calls rode ONE socket
+            sock_before = pooled[0]
+            c.check_bulk([CheckItem("namespace", "n1", "view",
+                                    "user", "u1")])
+            with c._pool_lock:
+                assert c._pool and c._pool[0] is sock_before
+        finally:
+            c.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_plaintext_client_rejected_by_tls_server(pki):
+    e = _seed_engine()
+
+    def fn(port):
+        c = RemoteEngine("127.0.0.1", port, token="tls-tok")  # no TLS
+        try:
+            with pytest.raises(Exception):
+                c.check_bulk([CheckItem("namespace", "n1", "view",
+                                        "user", "u1")])
+        finally:
+            c.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_wrong_ca_rejected(pki):
+    e = _seed_engine()
+
+    def fn(port):
+        ctx = client_ssl_context(ca_file=pki["otherca"])
+        c = RemoteEngine("127.0.0.1", port, token="tls-tok",
+                         ssl_context=ctx, server_hostname="localhost")
+        try:
+            with pytest.raises(ssl.SSLError):
+                c.check_bulk([CheckItem("namespace", "n1", "view",
+                                        "user", "u1")])
+        finally:
+            c.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_skip_verify_ca_still_encrypts(pki):
+    """The reference's SkipVerifyCA mode: TLS without cert verification
+    still completes the handshake and carries traffic."""
+    e = _seed_engine()
+
+    def fn(port):
+        ctx = client_ssl_context(skip_verify=True)
+        c = RemoteEngine("127.0.0.1", port, token="tls-tok",
+                         ssl_context=ctx, server_hostname="localhost")
+        try:
+            assert c.check_bulk([CheckItem(
+                "namespace", "n1", "view", "user", "u1")]) == [True]
+        finally:
+            c.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_mutual_tls_requires_client_cert(pki):
+    """With a client CA configured, cert-less clients fail the handshake
+    and cert-bearing ones proceed (mTLS on top of the token)."""
+    e = _seed_engine()
+
+    def fn(port):
+        bare = RemoteEngine(
+            "127.0.0.1", port, token="tls-tok",
+            ssl_context=client_ssl_context(ca_file=pki["ca"]),
+            server_hostname="localhost")
+        try:
+            # TLS 1.3 delivers the missing-client-cert rejection after the
+            # client's handshake completes: either an SSLError (alert) or
+            # a reset on first read, depending on timing — both OSError
+            with pytest.raises(OSError):
+                bare.check_bulk([CheckItem("namespace", "n1", "view",
+                                           "user", "u1")])
+        finally:
+            bare.close()
+        withcert = RemoteEngine(
+            "127.0.0.1", port, token="tls-tok",
+            ssl_context=client_ssl_context(
+                ca_file=pki["ca"], client_cert_file=pki["ca_client"]),
+            server_hostname="localhost")
+        try:
+            assert withcert.check_bulk([CheckItem(
+                "namespace", "n1", "view", "user", "u1")]) == [True]
+        finally:
+            withcert.close()
+
+    run_with_tls_server(e, fn, pki, client_ca=pki["ca"])
+
+
+def test_push_watch_stream_over_tls(pki):
+    """The server-push watch subscription (dedicated socket) rides TLS:
+    subscribe, receive a pushed grant, close."""
+    e = _seed_engine()
+
+    def fn(port):
+        ctx = client_ssl_context(ca_file=pki["ca"])
+        c = RemoteEngine("127.0.0.1", port, token="tls-tok",
+                         ssl_context=ctx, server_hostname="localhost")
+        try:
+            stream = c.watch_push_stream(c.revision)
+            try:
+                t = threading.Thread(
+                    target=lambda: e.write_relationships(
+                        [WriteOp("touch", parse_relationship(
+                            "namespace:pushed#viewer@user:u9"))]),
+                    daemon=True)
+                t.start()
+                got = []
+                while not got:
+                    got = stream.next_batch()  # [] = heartbeat
+                assert any(
+                    ev.relationship.resource_id == "pushed"
+                    for ev in got)
+                t.join(5)
+            finally:
+                stream.close()
+        finally:
+            c.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_mirror_stream_over_tls(pki):
+    """A follower subscribes to a MirroredEngine leader over TLS and
+    replays its writes (multi-host serving path, parallel/multihost.py)."""
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        follower_loop,
+    )
+
+    leader_inner = _seed_engine()
+    leader = MirroredEngine(leader_inner, min_subscribers=1,
+                            join_timeout=30.0)
+    follower_engine = _seed_engine()
+    server_ssl = server_ssl_context(pki["ca_server_cert"],
+                                    pki["ca_server_key"])
+
+    async def go():
+        srv = EngineServer(leader, port=0, token="tls-tok",
+                           ssl_context=server_ssl)
+        port = await srv.start()
+        ctx = client_ssl_context(ca_file=pki["ca"])
+        ft = threading.Thread(
+            target=follower_loop,
+            args=(follower_engine, "127.0.0.1", port),
+            kwargs={"token": "tls-tok", "ssl_context": ctx,
+                    "server_hostname": "localhost"},
+            daemon=True)
+        ft.start()
+        try:
+            # leader write blocks on the join barrier until the follower's
+            # TLS subscription lands, then mirrors to it
+            await asyncio.to_thread(
+                leader.write_relationships,
+                [WriteOp("touch", parse_relationship(
+                    "namespace:mirrored#creator@user:u5"))])
+            deadline = asyncio.get_running_loop().time() + 20
+            item = CheckItem("namespace", "mirrored", "view", "user", "u5")
+            while True:
+                ok = await asyncio.to_thread(
+                    follower_engine.check_bulk, [item])
+                if ok == [True]:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "follower never replayed the mirrored write"
+                await asyncio.sleep(0.05)
+        finally:
+            await srv.stop()
+            ft.join(10)
+
+    asyncio.run(go())
+
+
+# -- flag-surface postures ---------------------------------------------------
+
+
+def test_engine_host_cli_serves_tls(pki, tmp_path):
+    """The standalone CLI actually wires its TLS context into the server
+    (regression: the context was built but not passed — the host served
+    plaintext and every TLS client saw a handshake EOF)."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    boot = tmp_path / "boot.yaml"
+    boot.write_text("schema: |\n  definition user {}\n")
+    script = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from spicedb_kubeapi_proxy_tpu.engine.remote import main\n"
+        "sys.exit(main(sys.argv[1:]))\n")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-c", script,
+         "--bootstrap", str(boot), "--bind-port", str(port),
+         "--token", "cli-tok",
+         "--tls-cert-file", pki["ca_server_cert"],
+         "--tls-key-file", pki["ca_server_key"]],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                probe = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=1)
+                probe.close()
+                break
+            except OSError:
+                assert p.poll() is None, p.communicate()[0][-2000:]
+                assert time.monotonic() < deadline, "host never bound"
+                time.sleep(0.2)
+        c = RemoteEngine(
+            "127.0.0.1", port, token="cli-tok",
+            ssl_context=client_ssl_context(ca_file=pki["ca"]),
+            server_hostname="localhost")
+        try:
+            assert isinstance(c.revision, int)
+        finally:
+            c.close()
+    finally:
+        p.terminate()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_engine_host_cli_refuses_plaintext_without_opt_out(tmp_path):
+    from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--bind-port", "0"])
+    assert exc.value.code == 2  # argparse error, not a crash
+
+
+def test_engine_host_cli_follower_needs_no_serving_certs(tmp_path):
+    """A mirror follower never serves TCP — the refuse-plaintext check
+    must not demand cert/key from it (review finding). It proceeds past
+    flag validation (blocking on the coordinator, which proves argparse
+    accepted it) instead of exiting 2."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from spicedb_kubeapi_proxy_tpu.engine.remote import main\n"
+        "sys.exit(main(sys.argv[1:]))\n")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-c", script,
+         "--distributed", f"127.0.0.1:{port},2,1",
+         "--mirror-leader", f"127.0.0.1:{port}",
+         "--mirror-skip-verify-ca"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        time.sleep(3)
+        # still alive = past argparse (blocked joining the coordinator);
+        # an exit means flag validation rejected the follower
+        if p.poll() is not None:
+            out = p.communicate()[0]
+            assert "refusing to serve plaintext" not in out, out[-1500:]
+            assert p.returncode != 2, out[-1500:]
+    finally:
+        p.kill()
+        p.wait(timeout=10)
+    # and a malformed spec still fails fast with a clean argparse error
+    from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--distributed", "not-a-spec", "--mirror-leader", "h:1"])
+    assert exc.value.code == 2
+
+
+def test_engine_host_cli_rejects_half_tls_and_conflicts(pki):
+    from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+    with pytest.raises(SystemExit):
+        main(["--tls-cert-file", pki["ca_server_cert"]])  # no key
+    with pytest.raises(SystemExit):
+        main(["--engine-insecure",
+              "--tls-cert-file", pki["ca_server_cert"],
+              "--tls-key-file", pki["ca_server_key"]])
+
+
+def test_proxy_options_tls_validation(pki):
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options,
+        OptionsError,
+    )
+
+    base = dict(rule_content="x", upstream_url="https://k")
+    # engine TLS flags demand a tcp:// endpoint
+    with pytest.raises(OptionsError):
+        Options(engine_ca_file=pki["ca"], **base).validate()
+    with pytest.raises(OptionsError):
+        Options(engine_insecure=True, **base).validate()
+    # plaintext excludes the TLS options
+    with pytest.raises(OptionsError):
+        Options(engine_endpoint="tcp://h:1", engine_insecure=True,
+                engine_ca_file=pki["ca"], **base).validate()
+    # client cert/key go together
+    with pytest.raises(OptionsError):
+        Options(engine_endpoint="tcp://h:1",
+                engine_client_cert_file=pki["ca_client"],
+                **base).validate()
+    # well-formed TLS config validates
+    Options(engine_endpoint="tcp://h:1", engine_ca_file=pki["ca"],
+            engine_server_name="localhost", **base).validate()
+    Options(engine_endpoint="tcp://h:1", engine_insecure=True,
+            **base).validate()
+
+
+def test_proxy_completes_with_tls_engine_client(pki):
+    """Options.complete() against a tcp:// endpoint builds a RemoteEngine
+    whose connections are TLS — verified against a live TLS server."""
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    e = _seed_engine()
+
+    def fn(port):
+        opts = Options(
+            engine_endpoint=f"tcp://127.0.0.1:{port}",
+            engine_token="tls-tok",
+            engine_ca_file=pki["ca"],
+            engine_server_name="localhost",
+            rule_content="""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+lock: Pessimistic
+match:
+- apiVersion: v1
+  resource: namespaces
+  verbs: ["get"]
+check:
+- tpl: "namespace:{{name}}#view@user:{{user.name}}"
+""",
+            upstream_url="https://unused.test",
+            workflow_database_path=":memory:")
+        cfg = opts.complete()
+        try:
+            assert cfg.engine.check_bulk([CheckItem(
+                "namespace", "n1", "view", "user", "u1")]) == [True]
+        finally:
+            cfg.engine.close()
+
+    run_with_tls_server(e, fn, pki)
+
+
+def test_tlsconf_error_surfaces(tmp_path):
+    with pytest.raises(TLSConfigError):
+        server_ssl_context(str(tmp_path / "no.pem"),
+                           str(tmp_path / "no-key.pem"))
+    with pytest.raises(TLSConfigError):
+        client_ssl_context(ca_file=str(tmp_path / "no-ca.pem"))

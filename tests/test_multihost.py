@@ -130,7 +130,8 @@ from spicedb_kubeapi_proxy_tpu.engine.remote import main
 
 pid = "0" if role == "leader" else "1"
 argv = ["--distributed", f"127.0.0.1:{port_coord},2,{pid}",
-        "--engine-mesh", "auto", "--token", "mh-tok"]
+        "--engine-mesh", "auto", "--token", "mh-tok",
+        "--engine-insecure"]  # loopback-only test fixture
 if role == "leader":
     argv += ["--bind-port", port_tcp]
     print("LEADER STARTING", flush=True)

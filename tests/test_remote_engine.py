@@ -337,6 +337,7 @@ def test_proxy_against_remote_engine(tmp_path, mesh_spec):
         fake = FakeKube()
         cfg = Options(
             engine_endpoint=f"tcp://127.0.0.1:{port}",
+            engine_insecure=True,  # plaintext test server on loopback
             rule_content=RULES,
             upstream=fake,
             workflow_database_path=str(tmp_path / "dtx.sqlite"),
@@ -465,6 +466,61 @@ def test_remote_watch_push_zero_steady_state_polls():
         assert "watch_since" not in calls
         await hub.unregister(handle)
     run_with_server(e, fn)
+
+
+def test_pump_cancel_during_push_connect_closes_stream():
+    """A hub torn down while watch_push_stream is still connecting must
+    close the stream the worker thread eventually produces — a cancel
+    mid-connect previously leaked the dedicated socket until GC
+    (advisor finding, watchhub._source_reader)."""
+    import threading
+
+    from spicedb_kubeapi_proxy_tpu.authz.watchhub import WatchHub
+
+    pf, input = _watch_fixture()
+    connect_entered = threading.Event()
+    release_connect = threading.Event()
+
+    class SlowStream:
+        def __init__(self):
+            self.closed = threading.Event()
+
+        def next_batch(self):
+            return []
+
+        def close(self):
+            self.closed.set()
+
+    stream = SlowStream()
+
+    class FakeEngine:
+        revision = 0
+
+        def watch_push_stream(self, since):
+            connect_entered.set()
+            assert release_connect.wait(30)
+            return stream
+
+    async def go():
+        hub = WatchHub(FakeEngine())
+        h = await hub.register(pf, input)
+        # wait until the source reader's worker thread is inside connect
+        deadline = asyncio.get_running_loop().time() + 5
+        while not connect_entered.is_set():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        # teardown races the connect: the reader task is cancelled while
+        # the thread still hasn't produced the stream
+        await hub.unregister(h)
+        release_connect.set()
+        # the late-arriving stream must get closed by SOMEONE
+        deadline = asyncio.get_running_loop().time() + 5
+        while not stream.closed.is_set():
+            assert asyncio.get_running_loop().time() < deadline, \
+                "stream leaked after cancel-during-connect"
+            await asyncio.sleep(0.01)
+
+    asyncio.run(go())
 
 
 def test_remote_watch_pump_restarts_after_host_restart():

@@ -163,15 +163,15 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
         # object — protobuf list bodies can't feed it, so strip non-JSON
         # ranges from the Accept (keeping JSON ;as=Table form: the
         # postfilter handles Tables). Prefilter paths negotiate protobuf
-        # fine (authz/filterer.py). watching=True gives exactly the
-        # JSON-only rewrite.
+        # fine (authz/filterer.py).
         from ..proxy.upstream import rewrite_accept
 
         accept = next((v for k, v in req.headers.items()
                        if k.lower() == "accept"), "")
         req.headers = {k: v for k, v in req.headers.items()
                        if k.lower() != "accept"}
-        req.headers["Accept"] = rewrite_accept(accept, watching=True)
+        req.headers["Accept"] = rewrite_accept(accept, watching=False,
+                                               json_only=True)
     try:
         resp = await deps.upstream(req)
     except Exception:

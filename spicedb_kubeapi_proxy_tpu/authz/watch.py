@@ -28,6 +28,7 @@ import json
 from typing import AsyncIterator, Optional
 
 from ..engine import Engine
+from ..proxy import kubeproto
 from ..rules.compile import PreFilter
 
 from ..rules.input import ResolveInput
@@ -103,7 +104,13 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                     if waiting_for > applied:
                         held.append(frame)
                         continue
-                    out = emit(frame)
+                    try:
+                        out = emit(frame)
+                    except kubeproto.ProtoError:
+                        # a proto frame we cannot judge (no keyable
+                        # object): end the stream instead of leaking it —
+                        # the client re-lists and re-watches
+                        return
                     if out is not None:
                         yield out
                 elif kind == "pending":
@@ -126,7 +133,10 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
                     applied = max(applied, item[2])
                     if applied >= waiting_for and held:
                         for frame in held:
-                            out = emit(frame)
+                            try:
+                                out = emit(frame)
+                            except kubeproto.ProtoError:
+                                return  # as above: never leak unjudged
                             if out is not None:
                                 yield out
                         held = []
@@ -143,14 +153,34 @@ async def filtered_watch(engine: Engine, upstream_resp: ProxyResponse,
 def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
     """Extract (namespace, name) from a watch frame WITHOUT altering the
     frame bytes (the reference keeps raw bytes via a frame-capturing
-    reader, pkg/authz/frames.go:13-68).
+    reader, pkg/authz/frames.go:13-68). Handles both JSON frames
+    (newline-delimited WatchEvent documents) and kube-protobuf frames
+    (4-byte length prefix + raw WatchEvent; reference negotiates the
+    streaming serializer per content type, responsefilterer.go:557-626).
 
     The key space is defined by the PREFILTER's expressions: the grant
     side maps object ids through ``name_expr``/``namespace_expr``
     (run_prefilter_sync mapping), so the frame side must key identically — a prefilter
     with no namespace expression produces cluster-scoped ("", name) keys,
     and the frame's metadata.namespace must then be ignored rather than
-    guessed from the resource name."""
+    guessed from the resource name.
+
+    FAIL CLOSED: raises :class:`~..proxy.kubeproto.ProtoError` for ANY
+    frame carrying no judgeable object — truncated proto, unparseable
+    JSON, rows without objects — and the join ends the stream rather
+    than leaking bytes it cannot authorize. The only unjudged frames
+    that pass are explicit progress/terminal markers (BOOKMARK, ERROR/
+    Status) and bare whitespace keepalives."""
+    stripped = frame.lstrip()
+    if not stripped:
+        return None  # newline keepalive: carries nothing
+    if stripped[:1] != b"{" and len(frame) >= 4 and \
+            int.from_bytes(frame[:4], "big") == len(frame) - 4:
+        key = kubeproto.watch_frame_key(frame)  # may raise ProtoError
+        if key is None:
+            return None  # BOOKMARK / terminal Status: every consumer sees
+        ns, name = key
+        return (ns if pf.namespace_expr else "", name)
     try:
         ev = json.loads(frame)
         if ev.get("type") == "BOOKMARK":
@@ -159,6 +189,12 @@ def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
             # pass through rather than keying on an empty name
             return None
         obj = ev.get("object") or {}
+        if ev.get("type") == "ERROR" or obj.get("kind") == "Status":
+            # a terminal Status (watch expiry, 410 Gone): no object to
+            # judge, and suppressing it would leave the client hanging on
+            # a dead watch instead of re-listing — pass it through (same
+            # semantics as the proto path above)
+            return None
         # Table-format watch events wrap rows (responsefilterer.go:667-677)
         if obj.get("kind") == "Table":
             rows = obj.get("rows") or []
@@ -171,4 +207,7 @@ def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
         ns = (meta.get("namespace") or "") if pf.namespace_expr else ""
         return (ns, meta.get("name") or "")
     except ValueError:
-        return None
+        # not JSON and not a well-formed proto frame (e.g. a frame
+        # truncated by a dying upstream): unjudgeable — fail closed
+        raise kubeproto.ProtoError(
+            "unparseable watch frame (truncated or unknown encoding)")

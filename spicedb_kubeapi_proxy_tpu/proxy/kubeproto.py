@@ -27,6 +27,15 @@ generated.proto files), so splitting the repeated ``items`` field and
 peeking each item's ObjectMeta is exact, and every byte we keep is
 byte-identical to what the apiserver sent — the same passthrough property
 the JSON/watch paths maintain (pkg/authz/frames.go:13-68).
+
+WATCH streams (reference negotiates the streaming serializer per content
+type, responsefilterer.go:557-626) add one more frozen layer: each frame
+is a 4-byte big-endian length followed by a RAW-serialized (no magic, no
+Unknown envelope) ``meta.k8s.io/v1 WatchEvent`` — type=1 (string),
+object(RawExtension)=2 — whose ``object.raw`` bytes hold the event's
+object with the FULL magic-prefixed Unknown envelope. The watch join
+needs only (event type, namespace, name) per frame; kept frames pass
+through byte-identically, length prefix and all.
 """
 
 from __future__ import annotations
@@ -35,6 +44,8 @@ from typing import Iterator, Optional
 
 MAGIC = b"k8s\x00"
 CONTENT_TYPE = "application/vnd.kubernetes.protobuf"
+# the streaming variant the apiserver stamps on proto watch responses
+WATCH_CONTENT_TYPE = CONTENT_TYPE + ";stream=watch"
 
 
 class ProtoError(ValueError):
@@ -180,6 +191,85 @@ def table_row_meta(row: bytes) -> tuple[str, str]:
     ns, name = item_meta(raw_obj)
     if not name:
         raise ProtoError("table row object has no metadata.name")
+    return ns, name
+
+
+# -- encoders (in-memory upstream fidelity + tests) --------------------------
+
+
+def encode_unknown(api_version: str, kind: str, raw: bytes) -> bytes:
+    """Magic-prefixed ``runtime.Unknown`` envelope — the inverse of
+    :func:`decode_unknown` (the in-memory upstream uses it to serve
+    protobuf the way a real apiserver would)."""
+    tm = _ld_field(1, api_version.encode()) + _ld_field(2, kind.encode())
+    return MAGIC + _ld_field(1, tm) + _ld_field(2, raw)
+
+
+def encode_object_meta_only(name: str, namespace: str = "") -> bytes:
+    """A message whose field 1 is an ObjectMeta carrying name/namespace —
+    the minimal shape every keying path here reads."""
+    meta = b""
+    if name:
+        meta += _ld_field(1, name.encode())
+    if namespace:
+        meta += _ld_field(3, namespace.encode())
+    return _ld_field(1, meta)
+
+
+def encode_watch_frame(event_type: str, object_bytes: bytes) -> bytes:
+    """One length-prefixed raw-serialized WatchEvent frame (the shape
+    :func:`watch_frame_key` reads): type=1, object RawExtension=2 whose
+    raw=1 holds ``object_bytes`` (normally an :func:`encode_unknown`
+    envelope)."""
+    we = _ld_field(1, event_type.encode()) \
+        + _ld_field(2, _ld_field(1, object_bytes))
+    return len(we).to_bytes(4, "big") + we
+
+
+def decode_watch_event(body: bytes) -> tuple[str, bytes]:
+    """(event type, object bytes) from a raw-serialized WatchEvent (the
+    frame body AFTER the 4-byte length prefix). ``object bytes`` are the
+    RawExtension's raw field — normally a magic-prefixed Unknown."""
+    typ = ""
+    raw = b""
+    for fno, wt, _, payload in fields(body):
+        if fno == 1 and wt == 2:
+            typ = payload.decode("utf-8", "replace")
+        elif fno == 2 and wt == 2:
+            raw = _field(payload, 1) or b""
+    return typ, raw
+
+
+def watch_frame_key(frame: bytes) -> Optional[tuple[str, str]]:
+    """(namespace, name) of the object a length-prefixed proto watch frame
+    carries, or None for frames every consumer may see (BOOKMARKs). The
+    frame bytes are never altered — the caller passes kept frames through
+    verbatim (reference frame-capturing reader, pkg/authz/frames.go).
+
+    Raises ProtoError for frames carrying no keyable object (an ERROR
+    frame's Status, a Table row without an object) — the watch join must
+    not silently pass unjudgeable objects."""
+    if len(frame) < 4:
+        raise ProtoError("proto watch frame shorter than its length prefix")
+    body = frame[4:]
+    typ, raw = decode_watch_event(body)
+    if typ == "BOOKMARK":
+        return None  # progress marker, carries only a resourceVersion
+    kind = ""
+    if raw.startswith(MAGIC):
+        _, kind, raw = decode_unknown(raw)
+    if typ == "ERROR" or kind == "Status":
+        # a terminal Status (watch expiry etc.): no object to judge,
+        # every consumer is entitled to see it
+        return None
+    if kind == "Table":
+        for fno, wt, _, payload in fields(raw):
+            if fno == 3 and wt == 2:  # first row keys the event
+                return table_row_meta(payload)
+        raise ProtoError("Table watch event has no rows")
+    ns, name = item_meta(raw)
+    if not name:
+        raise ProtoError("watch event object has no metadata.name")
     return ns, name
 
 

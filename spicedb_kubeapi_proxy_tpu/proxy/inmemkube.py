@@ -7,6 +7,14 @@ demo (`proxy/demo.py`, the reference's `mage dev:up` flow without a kind
 cluster). CRUD + list + merge-patch + watch over JSON resources; content
 shape follows kube conventions (kind lists, Status errors,
 resourceVersion).
+
+ownerReference garbage collection (reference e2e exercises a REAL kube
+GC controller over cascading deletes, e2e/e2e_test.go:156-186): objects
+get a uid at create; deleting an owner schedules a BACKGROUND cascade —
+dependents whose ownerReferences all dangle are deleted (recursively,
+honoring finalizers); ``propagationPolicy=Orphan`` strips the deleted
+owner's references instead. Foreground propagation is approximated as
+background (the fake has no blocking foreground finalizer).
 """
 
 from __future__ import annotations
@@ -14,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 
+from . import kubeproto
 from .requestinfo import parse_request_info
 from .types import ProxyRequest, ProxyResponse, json_response, kube_status
 
@@ -42,6 +51,8 @@ class InMemoryKube:
         self.objects: dict[tuple, dict] = {}
         self.rv = 0
         self._watchers: list[tuple[str, str, asyncio.Queue]] = []
+        # deletion propagation intent remembered across a finalizer wait
+        self._pending_gc_policy: dict[tuple, str] = {}
 
     # -- seeding -------------------------------------------------------------
 
@@ -83,7 +94,11 @@ class InMemoryKube:
             if info.verb == "watch":
                 bookmarks = (req.query.get("allowWatchBookmarks") or
                              ["false"])[0] in ("true", "1", "True")
-                return self._start_watch(res, ns, bookmarks=bookmarks)
+                accept = next((v for k, v in req.headers.items()
+                               if k.lower() == "accept"), "")
+                return self._start_watch(
+                    res, ns, bookmarks=bookmarks,
+                    proto="protobuf" in accept.lower())
             items = [o for (r, n_, _), o in sorted(self.objects.items())
                      if r == res and (not ns or n_ == ns)]
             return json_response(200, {
@@ -110,6 +125,8 @@ class InMemoryKube:
             if not isinstance(obj.get("metadata"), dict):
                 obj["metadata"] = {"name": name}
             obj["metadata"]["resourceVersion"] = str(self.rv)
+            # kube stamps a uid at create; the GC matches owner refs on it
+            obj["metadata"].setdefault("uid", f"uid-{self.rv}")
             if ns:
                 obj["metadata"]["namespace"] = ns
             obj.setdefault("kind", kind_for(res))
@@ -215,6 +232,11 @@ class InMemoryKube:
                     meta["deletionTimestamp"] = datetime.datetime.now(
                         datetime.timezone.utc).strftime(
                             "%Y-%m-%dT%H:%M:%SZ")
+                    # remember the propagation intent across the
+                    # finalizer wait (kube records it as an orphan/
+                    # foreground finalizer) so the eventual GC honors it
+                    self._pending_gc_policy[key] = \
+                        self._propagation_policy(req)
                     self.rv += 1
                     meta["resourceVersion"] = str(self.rv)
                     self._notify(res, ns,
@@ -223,6 +245,7 @@ class InMemoryKube:
             self.objects.pop(key, None)
             self.rv += 1
             self._notify(res, ns, {"type": "DELETED", "object": obj})
+            self._schedule_gc(obj, self._propagation_policy(req))
             return json_response(200, {"kind": "Status", "status": "Success",
                                        "code": 200})
         return kube_status(405, f"verb {info.verb} not supported")
@@ -237,8 +260,129 @@ class InMemoryKube:
             self.objects.pop(key, None)
             self.rv += 1
             self._notify(res, ns, {"type": "DELETED", "object": obj})
+            self._schedule_gc(obj,
+                              self._pending_gc_policy.pop(key, "Background"))
             return json_response(200, obj)
         return None
+
+    # -- ownerReference garbage collection -----------------------------------
+
+    @staticmethod
+    def _propagation_policy(req: ProxyRequest) -> str:
+        """DeleteOptions propagationPolicy, from the query or the DELETE
+        body (both places kube accepts it); default Background."""
+        q = (req.query.get("propagationPolicy") or [None])[0]
+        if q:
+            return q
+        if req.body:
+            try:
+                opts = json.loads(req.body)
+                if isinstance(opts, dict) and opts.get("propagationPolicy"):
+                    return opts["propagationPolicy"]
+            except ValueError:
+                pass
+        return "Background"
+
+    def _schedule_gc(self, owner: dict, policy: str = "Background") -> None:
+        """Run the GC pass for a just-removed owner in the BACKGROUND
+        (kube's GC is a controller, not part of the DELETE request);
+        without a running loop (direct sync use) it runs inline."""
+        try:
+            asyncio.get_running_loop().create_task(
+                self._gc_cascade(owner, policy))
+        except RuntimeError:
+            # no event loop: degenerate to synchronous collection
+            for step in self._gc_steps(owner, policy):
+                step()
+
+    async def _gc_cascade(self, owner: dict, policy: str) -> None:
+        await asyncio.sleep(0)  # after the DELETE response is written
+        for step in self._gc_steps(owner, policy):
+            step()
+            await asyncio.sleep(0)  # one watch-visible step at a time
+
+    def _gc_steps(self, owner: dict, policy: str):
+        """Yield thunks, one per dependent action. A dependent is
+        collected only when ALL of its ownerReferences dangle (kube GC
+        semantics); Orphan strips the deleted owner's reference
+        instead of deleting."""
+        okind = owner.get("kind") or ""
+        ometa = owner.get("metadata") or {}
+        oname, ouid = ometa.get("name") or "", ometa.get("uid")
+        ons = ometa.get("namespace") or ""
+        for key, obj in list(self.objects.items()):
+            if self.objects.get(key) is not obj:
+                continue  # already collected by a recursive step
+            res, ns, name = key
+            meta = obj.get("metadata") or {}
+            refs = meta.get("ownerReferences") or []
+            mine = [r for r in refs
+                    if r.get("kind") == okind and r.get("name") == oname
+                    and (not r.get("uid") or not ouid
+                         or r.get("uid") == ouid)
+                    # namespaced dependents reference same-namespace or
+                    # cluster-scoped owners (kube invariant)
+                    and (not ons or ns == ons)]
+            if not mine:
+                continue
+            if policy == "Orphan":
+                yield self._gc_orphan_step(key, obj, mine)
+                continue
+            others = [r for r in refs if r not in mine]
+            if any(self._owner_exists(r, ns) for r in others):
+                continue  # a living owner still holds it
+            yield self._gc_delete_step(key, obj)
+
+    def _owner_exists(self, ref: dict, dependent_ns: str) -> bool:
+        kind, name = ref.get("kind") or "", ref.get("name") or ""
+        for (res, ns, n), o in self.objects.items():
+            if n == name and o.get("kind") == kind \
+                    and ns in ("", dependent_ns):
+                if ref.get("uid") and (o.get("metadata") or {}).get("uid") \
+                        and ref["uid"] != o["metadata"]["uid"]:
+                    continue
+                return True
+        return False
+
+    def _gc_orphan_step(self, key, obj, refs_to_strip):
+        def step():
+            if self.objects.get(key) is not obj:
+                return
+            meta = obj.setdefault("metadata", {})
+            meta["ownerReferences"] = [
+                r for r in meta.get("ownerReferences") or []
+                if r not in refs_to_strip]
+            if not meta["ownerReferences"]:
+                del meta["ownerReferences"]
+            self.rv += 1
+            meta["resourceVersion"] = str(self.rv)
+            self._notify(key[0], key[1], {"type": "MODIFIED", "object": obj})
+        return step
+
+    def _gc_delete_step(self, key, obj):
+        def step():
+            if self.objects.get(key) is not obj:
+                return
+            res, ns, _ = key
+            meta = obj.setdefault("metadata", {})
+            if meta.get("finalizers"):
+                # finalized dependents terminate, they don't vanish
+                if not meta.get("deletionTimestamp"):
+                    import datetime
+
+                    meta["deletionTimestamp"] = datetime.datetime.now(
+                        datetime.timezone.utc).strftime(
+                            "%Y-%m-%dT%H:%M:%SZ")
+                    self.rv += 1
+                    meta["resourceVersion"] = str(self.rv)
+                    self._notify(res, ns,
+                                 {"type": "MODIFIED", "object": obj})
+                return
+            self.objects.pop(key, None)
+            self.rv += 1
+            self._notify(res, ns, {"type": "DELETED", "object": obj})
+            self._schedule_gc(obj)  # recurse: grandchildren
+        return step
 
     # -- watch ---------------------------------------------------------------
 
@@ -247,8 +391,8 @@ class InMemoryKube:
             if r == res and (not n_ or n_ == ns):
                 q.put_nowait(event)
 
-    def _start_watch(self, res: str, ns: str,
-                     bookmarks: bool = False) -> ProxyResponse:
+    def _start_watch(self, res: str, ns: str, bookmarks: bool = False,
+                     proto: bool = False) -> ProxyResponse:
         q: asyncio.Queue = asyncio.Queue()
         # emit existing objects as initial ADDED events (kube semantics with
         # resourceVersion=0 watches)
@@ -264,13 +408,29 @@ class InMemoryKube:
         entry = (res, ns, q)
         self._watchers.append(entry)
 
+        def encode(ev: dict) -> bytes:
+            if not proto:
+                return (json.dumps(ev) + "\n").encode()
+            # protobuf negotiation: length-prefixed raw WatchEvent whose
+            # object rides a magic-prefixed Unknown (what a real apiserver
+            # sends for Accept: application/vnd.kubernetes.protobuf);
+            # the fake's object payload carries the ObjectMeta shape every
+            # keying path reads (proxy/kubeproto.py)
+            obj = ev.get("object") or {}
+            meta = obj.get("metadata") or {}
+            body = kubeproto.encode_object_meta_only(
+                meta.get("name", ""), meta.get("namespace", ""))
+            env = kubeproto.encode_unknown(
+                obj.get("apiVersion", "v1"), obj.get("kind", ""), body)
+            return kubeproto.encode_watch_frame(ev["type"], env)
+
         async def frames():
             try:
                 while True:
                     ev = await q.get()
                     if ev is None:
                         return
-                    yield (json.dumps(ev) + "\n").encode()
+                    yield encode(ev)
             finally:
                 # client disconnect / generator close: stop fanning events
                 # into a dead queue (long-running demos would leak)
@@ -279,7 +439,8 @@ class InMemoryKube:
 
         return ProxyResponse(
             status=200,
-            headers={"Content-Type": "application/json",
+            headers={"Content-Type": kubeproto.WATCH_CONTENT_TYPE if proto
+                     else "application/json",
                      "Transfer-Encoding": "chunked"},
             stream=frames(),
         )

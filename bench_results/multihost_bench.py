@@ -1,0 +1,209 @@
+"""Multi-host serving bench (VERDICT r4 directive 4 / Weak #3).
+
+Measures, on CPU with real TCP mirror subscribers on loopback:
+
+- leader check_bulk throughput with 0/1/3 followers subscribed
+  (follower replay cost lands on the follower's OWN host in production,
+  so subscribers here count bytes and discard — the measurement isolates
+  the leader-side publish + wire cost);
+- mirror-wire bytes per 512-check frame and bytes/sec at a follower;
+- the leader-lock ceiling: aggregate throughput from 1/4/8 concurrent
+  request threads against the serialized MirroredEngine, vs the plain
+  engine's own concurrency.
+
+    python bench_results/multihost_bench.py [trials]
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import build_engine  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine import CheckItem  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine.remote import (  # noqa: E402
+    EngineServer,
+    _pack,
+)
+from spicedb_kubeapi_proxy_tpu.engine.store import WriteOp  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.models.tuples import (  # noqa: E402
+    Relationship,
+)
+from spicedb_kubeapi_proxy_tpu.parallel.multihost import (  # noqa: E402
+    MirroredEngine,
+)
+
+
+class ByteCountingSubscriber:
+    """Raw mirror subscriber: reads and discards frames, counting bytes
+    and frames (a production follower replays on its own host's CPU)."""
+
+    def __init__(self, port: int):
+        self.bytes = 0
+        self.frames = 0
+        self._s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self._s.sendall(_pack({"op": "mirror_subscribe"}))
+        self._read_frame()  # ack
+        self.bytes = 0  # don't count the ack
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _read_frame(self) -> bytes:
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = self._s.recv(4 - len(hdr))
+            if not chunk:
+                raise ConnectionResetError
+            hdr += chunk
+        (n,) = struct.unpack(">I", hdr)
+        body = b""
+        while len(body) < n:
+            chunk = self._s.recv(n - len(body))
+            if not chunk:
+                raise ConnectionResetError
+            body += chunk
+        self.bytes += 4 + n
+        return body
+
+    def _drain(self):
+        try:
+            while True:
+                self._read_frame()
+                self.frames += 1
+        except OSError:
+            pass
+
+    def close(self):
+        try:
+            self._s.close()
+        except OSError:
+            pass
+
+
+def seq_throughput(engine, items, trials) -> float:
+    engine.check_bulk(items)  # warm
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        engine.check_bulk(items)
+        rates.append(len(items) / (time.perf_counter() - t0))
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
+def threaded_throughput(engine, items, n_threads, seconds=2.0) -> float:
+    stop = time.perf_counter() + seconds
+    counts = [0] * n_threads
+
+    def worker(i):
+        while time.perf_counter() < stop:
+            engine.check_bulk(items)
+            counts[i] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    return sum(counts) * len(items) / dt
+
+
+def main() -> None:
+    trials = int(sys.argv[1]) if len(sys.argv) > 1 else 9
+    n_pods, n_users = 2000, 500
+    inner, _ = build_engine(n_pods, n_users, 20, 50, 50000)
+    rng = np.random.default_rng(11)
+    # same mix as bench.py's bulk-check section (8 subjects x 64 objects)
+    # so the plain-engine figure is comparable with BENCH_r*.json
+    items = [
+        CheckItem("pod", f"ns/p{rng.integers(n_pods)}", "view",
+                  "user", f"u{b}")
+        for b in rng.integers(n_users, size=8)
+        for _ in range(64)
+    ]
+
+    out: dict = {"checks_per_batch": len(items), "trials": trials}
+    out["plain_seq_checks_per_s"] = round(seq_throughput(
+        inner, items, trials))
+
+    leader = MirroredEngine(inner)
+    loop_holder = {}
+
+    async def serve():
+        srv = EngineServer(leader, port=0)
+        port = await srv.start()
+        loop_holder["port"] = port
+        loop_holder["srv"] = srv
+        loop_holder["loop"] = asyncio.get_running_loop()
+        loop_holder["stop"] = asyncio.Event()
+        loop_holder["ready"].set()
+        await loop_holder["stop"].wait()
+        await srv.stop()
+
+    loop_holder["ready"] = threading.Event()
+    st = threading.Thread(target=lambda: asyncio.run(serve()), daemon=True)
+    st.start()
+    assert loop_holder["ready"].wait(30)
+    port = loop_holder["port"]
+
+    subs: list[ByteCountingSubscriber] = []
+    out["mirror_seq_checks_per_s"] = {}
+    for n_followers in (0, 1, 3):
+        while len(subs) < n_followers:
+            subs.append(ByteCountingSubscriber(port))
+            time.sleep(0.2)
+        rate = seq_throughput(leader, items, trials)
+        out["mirror_seq_checks_per_s"][str(n_followers)] = round(rate)
+        if n_followers == 1:
+            # wire cost at a realistic mix: checks + occasional writes
+            s0 = subs[0]
+            b0, f0 = s0.bytes, s0.frames
+            t0 = time.perf_counter()
+            for i in range(trials):
+                leader.check_bulk(items)
+                if i % 3 == 0:
+                    leader.write_relationships([WriteOp(
+                        "touch", Relationship(
+                            "pod", f"ns/p{i}", "viewer", "user", "u1"))])
+            dt = time.perf_counter() - t0
+            time.sleep(0.3)  # let the stream drain
+            out["wire_bytes_per_s"] = round((s0.bytes - b0) / dt)
+            out["wire_frames"] = s0.frames - f0
+            out["wire_bytes_per_frame"] = round(
+                (s0.bytes - b0) / max(1, s0.frames - f0))
+    out["lock_ceiling_checks_per_s"] = {}
+    for n_threads in (1, 4, 8):
+        out["lock_ceiling_checks_per_s"][str(n_threads)] = round(
+            threaded_throughput(leader, items, n_threads))
+    out["plain_threads8_checks_per_s"] = round(
+        threaded_throughput(inner, items, 8))
+
+    for s in subs:
+        s.close()
+    loop_holder["loop"].call_soon_threadsafe(loop_holder["stop"].set)
+    st.join(15)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

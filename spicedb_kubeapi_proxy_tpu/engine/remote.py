@@ -421,13 +421,15 @@ class EngineServer:
         try:
             while True:
                 try:
-                    frame = await asyncio.to_thread(
+                    wire = await asyncio.to_thread(
                         q.get, True, self.PUSH_HEARTBEAT)
                 except _queue.Empty:
                     writer.write(_pack({"ok": True, "hb": True}))
                     await writer.drain()
                     continue
-                writer.write(_pack({"ok": True, "frame": frame}))
+                # pre-packed once by MirroredEngine._publish: the same
+                # bytes object fans out to every follower
+                writer.write(wire)
                 await writer.drain()
         finally:
             self.engine.unsubscribe(q)

@@ -242,3 +242,168 @@ def test_multihost_serving_leader_follower():
     for role, out in zip(("leader", "follower"), outs):
         assert "STARTING" in out, (role, out[-1500:])
         assert "Traceback" not in out, (role, out[-2500:])
+
+
+def test_mirror_check_item_codec_round_trip():
+    """The compact mirror codec must be injective for ANY client-supplied
+    field content (review findings: separator-based encoding let crafted
+    ids kill or desync followers) and keep '' distinct from None — the
+    engine groups device dispatches by subject key, so a lossy codec
+    desyncs SPMD dispatch shapes."""
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MultiHostError,
+        decode_check_items,
+        encode_check_items,
+        normalize_check_item,
+    )
+
+    items = [
+        CheckItem("pod", "ns/p1", "view", "user", "alice", None),
+        CheckItem("pod", "a\x1fb", "view", "user", "c\x1ed", None),
+        CheckItem("group", "g\nx", "member", "group", "inner", "member"),
+        CheckItem("ns", "", "view", "user", "u", ""),  # '' != None
+        CheckItem("t", "名前", "view", "user", "ünïcode", None),
+    ]
+    got = decode_check_items(encode_check_items(items))
+    assert got == items
+    # '' and None subject relations survive distinctly
+    assert got[3].subject_relation == "" and got[0].subject_relation is None
+    # non-str fields (legal JSON from a token-holding client) normalize to
+    # the SAME value the leader executes
+    n = normalize_check_item(CheckItem("pod", 123, "view", "user", 7, None))
+    assert n.resource_id == "123" and n.subject_id == "7"
+    assert decode_check_items(encode_check_items([n])) == [n]
+    # malformed payloads fail loudly, not with a silent partial batch
+    blob = encode_check_items(items)
+    import pytest as _pytest
+
+    with _pytest.raises(MultiHostError):
+        decode_check_items(blob[:-3])
+
+
+def test_multihost_follower_death_blocks_leader_restart_heals():
+    """The documented failure model (parallel/multihost.py): SPMD is
+    all-or-nothing — with a dead follower the leader's next device
+    collective fails or blocks depending on the transport (Gloo errors
+    fast; DCN may stall), but NEVER answers, and the leader process
+    survives; restarting the process set as a unit heals serving on the
+    same endpoint."""
+    import time
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo_root, ".pytest-mh-death-worker.py")
+    with open(script, "w") as f:
+        f.write(SERVE_WORKER)
+    port_tcp = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    def boot_pair(port_coord):
+        procs = []
+        for role in ("leader", "follower"):
+            procs.append(subprocess.Popen(
+                [sys.executable, script, role, str(port_coord),
+                 str(port_tcp), repo_root],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=repo_root))
+        try:
+            deadline = time.monotonic() + 120
+            while True:
+                try:
+                    probe = socket.create_connection(
+                        ("127.0.0.1", port_tcp), timeout=1)
+                    probe.close()
+                    return procs
+                except OSError:
+                    for p in procs:
+                        assert p.poll() is None, p.communicate()[0][-2000:]
+                    assert time.monotonic() < deadline, "leader never bound"
+                    time.sleep(0.25)
+        except BaseException:
+            # boot failed: reap HERE — a surviving leader would hold
+            # port_tcp and poison the restart phase
+            reap(procs)
+            raise
+
+    def reap(procs):
+        for p in procs:
+            p.terminate()
+        deadline = time.monotonic() + 20
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            p.communicate()
+
+    try:
+        _death_and_restart_phases(boot_pair, reap, port_tcp)
+    finally:
+        if os.path.exists(script):
+            os.unlink(script)
+
+
+def _death_and_restart_phases(boot_pair, reap, port_tcp):
+    import threading
+
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, WriteOp
+    from spicedb_kubeapi_proxy_tpu.engine.remote import RemoteEngine
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+
+    procs = boot_pair(_free_port())
+    client = None
+    try:
+        client = RemoteEngine("127.0.0.1", port_tcp, token="mh-tok",
+                              timeout=30.0)
+        client.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:alive#creator@user:u1"))])
+        item = CheckItem("namespace", "alive", "view", "user", "u1")
+        assert client.check_bulk([item]) == [True]
+
+        # kill the follower: the leader's NEXT collective must fail or
+        # block — never ANSWER — and the leader process must survive
+        procs[1].kill()
+        procs[1].wait(timeout=10)
+        result: dict = {}
+
+        def doomed_check():
+            c2 = RemoteEngine("127.0.0.1", port_tcp, token="mh-tok",
+                              timeout=60.0)
+            try:
+                result["got"] = c2.check_bulk([item])
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+            finally:
+                c2.close()
+
+        t = threading.Thread(target=doomed_check, daemon=True)
+        t.start()
+        t.join(20.0)
+        if t.is_alive():
+            pass  # blocked: the DCN-like stall mode
+        else:
+            # errored: the Gloo fast-fail mode — still no answer
+            assert "got" not in result, \
+                f"leader ANSWERED with a dead follower: {result}"
+            assert "err" in result
+        assert procs[0].poll() is None, "leader process died"
+    finally:
+        if client is not None:
+            client.close()
+        reap(procs)
+
+    # orchestrator restart: a FRESH process set on the same serving port
+    procs = boot_pair(_free_port())
+    client = None
+    try:
+        client = RemoteEngine("127.0.0.1", port_tcp, token="mh-tok",
+                              timeout=60.0)
+        client.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:healed#creator@user:u2"))])
+        assert client.check_bulk([CheckItem(
+            "namespace", "healed", "view", "user", "u2")]) == [True]
+    finally:
+        if client is not None:
+            client.close()
+        reap(procs)

@@ -83,8 +83,10 @@ def sign_jwt(claims: dict, alg: str = "RS256", kid: str = "rsa-1",
 
 
 def std_claims(**over) -> dict:
+    # wide margins: parametrize lists evaluate these at IMPORT time, and
+    # a full-suite run can put hours between collection and execution
     c = {"iss": ISSUER, "aud": CLIENT_ID, "sub": "alice",
-         "exp": time.time() + 300}
+         "exp": time.time() + 6 * 3600}
     c.update(over)
     return c
 
@@ -195,7 +197,7 @@ def test_email_claim_requires_verified():
     (std_claims(exp=time.time() - 120), "expired"),
     (std_claims(aud="other-client"), "wrong audience"),
     (std_claims(aud=["a", "b"]), "aud list without client id"),
-    (std_claims(nbf=time.time() + 300), "not yet valid"),
+    (std_claims(nbf=time.time() + 6 * 3600), "not yet valid"),
     ({k: v for k, v in std_claims().items() if k != "exp"}, "no exp"),
     ({k: v for k, v in std_claims().items() if k != "sub"}, "no username"),
 ])

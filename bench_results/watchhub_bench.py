@@ -1,0 +1,195 @@
+"""Watch-hub scale bench (VERDICT r4 directive 8).
+
+W watchers across G (prefilter rule, subject) groups on ONE engine,
+driven through the real middleware watch path (authorize -> filtered
+stream) against the in-memory upstream. Measures, per relevant write:
+
+- device recomputes (engine_lookups_total delta) — the O(groups) claim;
+- frames/sec delivered across all watchers;
+- event->frame latency (grant write -> flushed frame at every watcher
+  of the granted subject), p50/p99 over E events;
+
+both with the in-process engine and over a tcp:// engine host (one
+server-push subscription per proxy, binary mask wire recomputes).
+
+    python bench_results/watchhub_bench.py [watchers] [groups] [events]
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+from spicedb_kubeapi_proxy_tpu.authz import AuthzDeps, authorize  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine import Engine, WriteOp  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine.remote import (  # noqa: E402
+    EngineServer,
+    RemoteEngine,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import (  # noqa: E402
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.inmemkube import InMemoryKube  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import (  # noqa: E402
+    parse_request_info,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.rules import MapMatcher  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.rules.input import UserInfo  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics  # noqa: E402
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+lock: Pessimistic
+match:
+- apiVersion: v1
+  resource: namespaces
+  verbs: ["list", "watch"]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources:
+    tpl: "namespace:$#view@user:{{user.name}}"
+"""
+
+
+async def run_mode(engine_for_proxy, inner: Engine, kube: InMemoryKube,
+                   n_watchers: int, n_groups: int, n_events: int) -> dict:
+    deps = AuthzDeps(matcher=MapMatcher.from_yaml(RULES),
+                     engine=engine_for_proxy, upstream=kube,
+                     watch_poll_interval=0.05)
+    frames_per: list[int] = [0] * n_watchers
+    seen_per: list[set] = [set() for _ in range(n_watchers)]
+    tasks = []
+    streams = []
+
+    async def consume(i, stream):
+        async for f in stream:
+            frames_per[i] += 1
+            try:
+                ev = json.loads(f)
+                seen_per[i].add(ev["object"]["metadata"]["name"])
+            except ValueError:
+                pass
+
+    for i in range(n_watchers):
+        user = f"u{i % n_groups}"
+        info = parse_request_info("GET", "/api/v1/namespaces",
+                                  {"watch": ["true"]})
+        req = ProxyRequest(method="GET", path="/api/v1/namespaces",
+                           query={"watch": ["true"]}, headers={},
+                           body=b"", user=UserInfo(name=user),
+                           request_info=info)
+        resp = await authorize(req, deps)
+        assert resp.status == 200 and resp.stream is not None, resp.status
+        streams.append(resp.stream)
+        tasks.append(asyncio.ensure_future(consume(i, resp.stream)))
+    # let registrations land (one hub group per distinct user)
+    hub = deps.watch_hub
+    deadline = time.monotonic() + 60
+    while sum(len(g.watchers) for g in hub._groups.values()) < n_watchers:
+        assert time.monotonic() < deadline, "watchers never registered"
+        await asyncio.sleep(0.05)
+    n_hub_groups = len(hub._groups)
+    await asyncio.sleep(0.5)  # drain initial recomputes/frames
+
+    lookups0 = metrics.counter("engine_lookups_total").value
+    frames0 = sum(frames_per)
+    lat = []
+    t_all0 = time.monotonic()
+    for e in range(n_events):
+        g = e % n_groups
+        name = f"ev{e}"
+        watchers_of_g = [i for i in range(n_watchers)
+                         if i % n_groups == g]
+        # upstream object appears first (buffered: nobody allowed yet),
+        # then the grant write flushes it — event->frame latency covers
+        # write -> recompute -> flush at EVERY watcher of the group
+        kube.put("namespaces", name)
+        await asyncio.sleep(0)
+        t0 = time.monotonic()
+        await asyncio.to_thread(
+            inner.write_relationships,
+            [WriteOp("touch", parse_relationship(
+                f"namespace:{name}#viewer@user:u{g}"))])
+        deadline = time.monotonic() + 30
+        while not all(name in seen_per[i] for i in watchers_of_g):
+            assert time.monotonic() < deadline, \
+                f"event {e} never reached all watchers of group {g}"
+            await asyncio.sleep(0.002)
+        lat.append(time.monotonic() - t0)
+    dt_all = time.monotonic() - t_all0
+    await asyncio.sleep(0.3)
+    recomputes = metrics.counter("engine_lookups_total").value - lookups0
+    frames = sum(frames_per) - frames0
+    lat.sort()
+    for t in tasks:
+        t.cancel()
+    kube.stop_watches()
+    await asyncio.sleep(0.2)
+    return {
+        "hub_groups": n_hub_groups,
+        "events": n_events,
+        "recomputes": recomputes,
+        "recomputes_per_event": round(recomputes / n_events, 2),
+        "frames_delivered": frames,
+        "frames_per_s": round(frames / dt_all),
+        "events_per_s": round(n_events / dt_all, 1),
+        "latency_ms_p50": round(lat[len(lat) // 2] * 1e3, 1),
+        "latency_ms_p99": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))] * 1e3, 1),
+    }
+
+
+async def main() -> None:
+    # the tcp mode runs CLIENT AND SERVER on one loop sharing one default
+    # executor; with hundreds of watchers the client-side to_thread calls
+    # can occupy every worker while the server needs one to answer — a
+    # same-pool deadlock impossible across real processes. Size the pool
+    # past the watcher count so the bench measures the hub, not the pool.
+    from concurrent.futures import ThreadPoolExecutor
+
+    n_watchers = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    n_groups = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    n_events = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+    asyncio.get_running_loop().set_default_executor(
+        ThreadPoolExecutor(max_workers=n_watchers + 64))
+    out = {"watchers": n_watchers, "groups": n_groups}
+
+    # -- in-process engine ------------------------------------------------
+    inner = Engine()
+    inner.write_relationships([WriteOp("touch", parse_relationship(
+        "namespace:seed#creator@user:u0"))])
+    out["in_process"] = await run_mode(
+        inner, inner, InMemoryKube(), n_watchers, n_groups, n_events)
+
+    # -- tcp:// engine host (push watch, mask wire) -----------------------
+    inner2 = Engine()
+    inner2.write_relationships([WriteOp("touch", parse_relationship(
+        "namespace:seed#creator@user:u0"))])
+    srv = EngineServer(inner2, port=0)
+    port = await srv.start()
+    remote = RemoteEngine("127.0.0.1", port)
+    out["tcp_push"] = await run_mode(
+        remote, inner2, InMemoryKube(), n_watchers, n_groups, n_events)
+    remote.close()
+    await srv.stop()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,17 +1,17 @@
 #!/bin/bash
-# Round-4 TPU-window runbook: run EVERYTHING directive 1 needs the moment
+# Round-5 TPU-window runbook: run EVERYTHING directive 1 needs the moment
 # the axon tunnel comes back, archiving as it goes (the tunnel has
 # multi-hour outages — front-load the valuable runs).
 #
-#   bash bench_results/r4_tpu_runbook.sh
+#   bash bench_results/r5_tpu_runbook.sh
 #
 # Produces, under bench_results/:
-#   r4_tpu_full.json        headline + suite configs (incl. post-closure
+#   r5_tpu_full.json        headline + suite configs (incl. post-closure
 #                           config 3) + remote-compare + tail diagnosis
-#   r4_tpu_profile/         jax profiler trace of the headline loop
+#   r5_tpu_profile/         jax profiler trace of the headline loop
 #                           (fixpoint annotated "sdbkp:fixpoint" — answers
 #                           the 150-vs-819 GB/s bandwidth question)
-#   r4_tpu_stderr.log       full methodology log
+#   r5_tpu_stderr.log       full methodology log
 set -u
 cd "$(dirname "$0")/.."
 
@@ -29,11 +29,11 @@ fi
 
 echo "== full suite + profile + remote-compare (one engine build) =="
 python bench.py --suite --remote-compare \
-    --profile-dir bench_results/r4_tpu_profile \
-    > bench_results/r4_tpu_full.json 2> bench_results/r4_tpu_stderr.log
+    --profile-dir bench_results/r5_tpu_profile \
+    > bench_results/r5_tpu_full.json 2> bench_results/r5_tpu_stderr.log
 rc=$?
 echo "bench rc=$rc"
-tail -40 bench_results/r4_tpu_stderr.log
-cat bench_results/r4_tpu_full.json
+tail -40 bench_results/r5_tpu_stderr.log
+cat bench_results/r5_tpu_full.json
 echo
 echo "== done; commit the artifacts =="

@@ -27,7 +27,7 @@ class HttpClient:
         self.user = user
 
     async def request(self, method: str, target: str, body=None,
-                      stream: bool = False):
+                      stream: bool = False, extra_headers=()):
         reader, writer = await asyncio.open_connection("127.0.0.1", self.port)
         data = json.dumps(body).encode() if body is not None else b""
         headers = [f"{method} {target} HTTP/1.1",
@@ -35,6 +35,7 @@ class HttpClient:
                    f"X-Remote-User: {self.user}",
                    "Content-Type: application/json",
                    f"Content-Length: {len(data)}",
+                   *extra_headers,
                    "Connection: close", "", ""]
         writer.write("\r\n".join(headers).encode() + data)
         await writer.drain()
@@ -149,6 +150,61 @@ def test_demo_stack_end_to_end():
         finally:
             await cfg.server.stop()
             await cfg.workflow.shutdown()
+    asyncio.run(go())
+
+
+def test_proto_watch_over_real_server(env):
+    """A protobuf watch through the FULL stack — real client socket ->
+    proxy server -> HttpUpstream -> real-HTTP fake upstream: the stream
+    content-type is the proto streaming variant and frames arrive
+    length-prefixed, filtered, and byte-parseable (VERDICT r4 dir. 5)."""
+    from spicedb_kubeapi_proxy_tpu.proxy import kubeproto
+
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+        ).complete()
+        await cfg.run()
+        try:
+            alice = HttpClient(cfg.server.port, "alice")
+            status, _, _ = await alice.request(
+                "POST", "/api/v1/namespaces",
+                body={"apiVersion": "v1", "kind": "Namespace",
+                      "metadata": {"name": "proto-a"}})
+            assert status == 201
+            status, headers, (reader, writer) = await alice.request(
+                "GET", "/api/v1/namespaces?watch=true", stream=True,
+                extra_headers=[f"Accept: {kubeproto.CONTENT_TYPE}"])
+            assert status == 200
+            assert headers.get("content-type") == \
+                kubeproto.WATCH_CONTENT_TYPE, headers
+            buf = b""
+            frame = None
+            deadline = asyncio.get_running_loop().time() + 10
+            while frame is None:
+                assert asyncio.get_running_loop().time() < deadline
+                chunk = await asyncio.wait_for(
+                    alice.read_chunk(reader), timeout=5)
+                assert chunk is not None
+                buf += chunk
+                if len(buf) >= 4:
+                    n = int.from_bytes(buf[:4], "big")
+                    if len(buf) >= 4 + n:
+                        frame, buf = buf[:4 + n], buf[4 + n:]
+            assert kubeproto.watch_frame_key(frame) == ("", "proto-a")
+            typ, _ = kubeproto.decode_watch_event(frame[4:])
+            assert typ == "ADDED"
+            writer.close()
+            fake.stop_watches()
+        finally:
+            await cfg.server.stop()
+            await cfg.workflow.shutdown()
+            upstream_server.close()
     asyncio.run(go())
 
 

@@ -84,15 +84,46 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
         rel.subject_type, rel.subject_id, rel.subject_relation or None,
     )
     allowed = AllowedSet()
+    pairs = allowed.pairs
+    # Vectorized fast paths for the dominant mapping forms (the
+    # deploy/rules.yaml shapes): at 100k allowed ids the general loop's
+    # per-id expression evaluation is the proxy-side cost of a big list
+    # filter, and these forms compute the same pairs with plain string
+    # ops. Semantics match expr.py's split_name/split_namespace exactly
+    # (first '/' splits; no '/' => cluster-scoped).
+    # getattr: tests substitute duck-typed expr fakes without .source.
+    # The refs check distinguishes the EXPRESSION form from a braceless
+    # LITERAL template that merely spells "resourceId" (legal per the
+    # {{ }}/literal duality; literals compile with empty refs and mean a
+    # constant name — matching it here would fail OPEN).
+    def _expr_src(e) -> Optional[str]:
+        if e is None or "resourceId" not in getattr(e, "refs", ()):
+            return None
+        return getattr(e, "source", "").strip()
+
+    name_src = _expr_src(pf.name_expr)
+    ns_src = _expr_src(pf.namespace_expr)
+    if name_src == "resourceId" and pf.namespace_expr is None:
+        pairs.update(("", obj_id) for obj_id in ids)
+        return allowed
+    if name_src == "split_name(resourceId)" and \
+            ns_src == "split_namespace(resourceId)":
+        for obj_id in ids:
+            ns, sep, nm = obj_id.partition("/")
+            pairs.add((ns, nm) if sep else ("", obj_id))
+        return allowed
     base = input.template_data()
+    # one mutable data map, not a copy per id: the exprs only read it,
+    # and only resourceId changes between iterations
+    data = dict(base)
+    name_eval = pf.name_expr.evaluate_str
+    ns_eval = pf.namespace_expr.evaluate_str if pf.namespace_expr else None
     skipped = 0
     for obj_id in ids:
-        data = dict(base)
         data["resourceId"] = obj_id
         try:
-            name = pf.name_expr.evaluate_str(data)
-            ns = (pf.namespace_expr.evaluate_str(data)
-                  if pf.namespace_expr else "")
+            name = name_eval(data)
+            ns = ns_eval(data) if ns_eval else ""
         except ExprError as e:
             if strict:
                 raise PreFilterError(
@@ -105,7 +136,7 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
                 log.warning("prefilter id mapping failed for %r "
                             "(skipping; fails closed): %s", obj_id, e)
             continue
-        allowed.add(ns, name)
+        pairs.add((ns or "", name))
     if skipped > 1:
         log.warning("prefilter id mapping skipped %d more ids", skipped - 1)
     return allowed

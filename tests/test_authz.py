@@ -1382,6 +1382,90 @@ def test_watch_error_status_frames_pass_through():
     run(go())
 
 
+def test_prefilter_mapping_fast_paths_match_general_evaluation():
+    """run_prefilter_sync short-circuits the two deploy/rules.yaml
+    mapping shapes (identity, split_name/split_namespace) into plain
+    string ops; they must produce byte-for-byte the same allowed pairs
+    as general expression evaluation, including slashless (cluster-
+    scoped) and multi-slash ids."""
+    from spicedb_kubeapi_proxy_tpu.authz.lookups import run_prefilter_sync
+    from spicedb_kubeapi_proxy_tpu.engine import Engine, WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+    from spicedb_kubeapi_proxy_tpu.rules.matcher import (
+        MapMatcher,
+        RequestMeta,
+    )
+    from spicedb_kubeapi_proxy_tpu.rules.input import (
+        RequestInfo,
+        ResolveInput,
+        UserInfo,
+    )
+
+    engine = Engine()
+    ids = ["plain", "ns1/pod-a", "ns2/pod/with/slashes"]
+    engine.write_relationships([
+        WriteOp("touch", parse_relationship(f"pod:{i}#viewer@user:alice"))
+        for i in ids
+    ])
+    input = ResolveInput.create(
+        RequestInfo(verb="list", api_version="v1", resource="pods",
+                    path="/api/v1/pods"),
+        UserInfo(name="alice"))
+
+    def pf_for(mapping_yaml: str):
+        rules = MapMatcher.from_yaml(f"""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+lock: Pessimistic
+match:
+- apiVersion: v1
+  resource: pods
+  verbs: ["list"]
+prefilter:
+{mapping_yaml}
+""")
+        return rules.match(RequestMeta(
+            verb="list", api_group="", api_version="v1",
+            resource="pods"))[0].pre_filters[0]
+
+    # identity fast path == a general expr forced off the fast path by
+    # an equivalent-but-differently-spelled source
+    fast = run_prefilter_sync(engine, pf_for(
+        '- fromObjectIDNameExpr: "{{resourceId}}"\n'
+        '  lookupMatchingResources:\n'
+        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+    general = run_prefilter_sync(engine, pf_for(
+        '- fromObjectIDNameExpr: "{{string(resourceId)}}"\n'
+        '  lookupMatchingResources:\n'
+        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+    assert fast.pairs == general.pairs == {("", i) for i in ids}
+
+    # a braceless LITERAL template that merely spells "resourceId" means
+    # a CONSTANT name (the {{ }}/literal duality) — it must NOT take the
+    # identity fast path (review finding: matching it fails open)
+    literal = run_prefilter_sync(engine, pf_for(
+        '- fromObjectIDNameExpr: "resourceId"\n'
+        '  lookupMatchingResources:\n'
+        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+    assert literal.pairs == {("", "resourceId")}
+
+    # split fast path == general split evaluation (name-only spelling
+    # avoids the fast path; add the ns expr separately)
+    fast = run_prefilter_sync(engine, pf_for(
+        '- fromObjectIDNameExpr: "{{split_name(resourceId)}}"\n'
+        '  fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"\n'
+        '  lookupMatchingResources:\n'
+        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+    general = run_prefilter_sync(engine, pf_for(
+        '- fromObjectIDNameExpr: "{{string(split_name(resourceId))}}"\n'
+        '  fromObjectIDNamespaceExpr: '
+        '"{{string(split_namespace(resourceId))}}"\n'
+        '  lookupMatchingResources:\n'
+        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+    assert fast.pairs == general.pairs == {
+        ("", "plain"), ("ns1", "pod-a"), ("ns2", "pod/with/slashes")}
+
+
 def test_gc_cascade_background_semantics():
     """Fake GC fidelity (reference runs a REAL kube GC controller,
     e2e/e2e_test.go:156-186): deleting an owner background-deletes

@@ -1,0 +1,131 @@
+"""Full proxy-path list filter (VERDICT 'real HTTP round trip' milestone
+context): the end-to-end cost of GET /api/v1/pods for 100k pods through
+the REAL middleware — authorize() -> concurrent prefilter (device query
++ id->name mapping) -> upstream JSON body -> response filtering — vs the
+engine-only figure bench.py reports.
+
+    python bench_results/proxy_path_bench.py [n_pods] [trials]
+
+Prints one JSON line with the stage breakdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import build_engine  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.authz import AuthzDeps, authorize  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.authz.lookups import (  # noqa: E402
+    run_prefilter_sync,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import (  # noqa: E402
+    parse_request_info,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.types import (  # noqa: E402
+    ProxyRequest,
+    ProxyResponse,
+)
+from spicedb_kubeapi_proxy_tpu.rules import MapMatcher  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.rules.input import UserInfo  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.rules.matcher import RequestMeta  # noqa: E402
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+lock: Pessimistic
+match:
+- apiVersion: v1
+  resource: pods
+  verbs: ["list"]
+prefilter:
+- fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  lookupMatchingResources:
+    tpl: "pod:$#view@user:{{user.name}}"
+"""
+
+
+async def main() -> None:
+    n_pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    trials = int(sys.argv[2]) if len(sys.argv) > 2 else 7
+    # quick-config density scaled up: enough rels that a user sees a
+    # meaningful slice of the list
+    engine, n_rels = build_engine(n_pods, 500, 20, 50,
+                                  max(50_000, 5 * n_pods))
+
+    # upstream body: the full pod list, built once (the fake apiserver's
+    # own serialization cost is out of scope — kube pays it upstream)
+    items = [{"apiVersion": "v1", "kind": "Pod",
+              "metadata": {"name": f"p{i}", "namespace": "ns"}}
+             for i in range(n_pods)]
+    body = json.dumps({"kind": "PodList", "apiVersion": "v1",
+                       "items": items}).encode()
+
+    async def upstream(req):
+        return ProxyResponse(
+            status=200, headers={"Content-Type": "application/json"},
+            body=body)
+
+    matcher = MapMatcher.from_yaml(RULES)
+    deps = AuthzDeps(matcher=matcher, engine=engine, upstream=upstream)
+    info = parse_request_info("GET", "/api/v1/pods", {})
+    req = ProxyRequest(method="GET", path="/api/v1/pods", query={},
+                       headers={}, body=b"",
+                       user=UserInfo(name="u7"), request_info=info)
+
+    # warm (jit compile + caches)
+    resp = await authorize(req, deps)
+    assert resp.status == 200, resp.status
+    kept = len(json.loads(resp.body)["items"])
+
+    walls = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        resp = await authorize(req, deps)
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+
+    # stage attribution (sequential, outside the overlap): prefilter
+    # alone, and the body filter alone
+    pf = matcher.match(RequestMeta(
+        verb="list", api_group="", api_version="v1",
+        resource="pods"))[0].pre_filters[0]
+    from spicedb_kubeapi_proxy_tpu.rules.input import ResolveInput
+    from spicedb_kubeapi_proxy_tpu.rules.input import RequestInfo as RI
+
+    input = ResolveInput.create(
+        RI(verb="list", api_version="v1", resource="pods",
+           path="/api/v1/pods"), UserInfo(name="u7"))
+    t0 = time.perf_counter()
+    allowed = run_prefilter_sync(engine, pf, input)
+    t_prefilter = time.perf_counter() - t0
+    from spicedb_kubeapi_proxy_tpu.authz.filterer import filter_body
+
+    t0 = time.perf_counter()
+    filter_body(body, allowed, input)
+    t_filter = time.perf_counter() - t0
+
+    print(json.dumps({
+        "n_pods": n_pods, "n_rels": int(n_rels), "kept": kept,
+        "allowed": len(allowed), "trials": trials,
+        "proxy_path_p50_ms": round(walls[len(walls) // 2] * 1e3, 1),
+        "proxy_path_min_ms": round(walls[0] * 1e3, 1),
+        "prefilter_ms": round(t_prefilter * 1e3, 1),
+        "json_body_filter_ms": round(t_filter * 1e3, 1),
+    }))
+
+
+asyncio.run(main())

@@ -85,29 +85,18 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
     )
     allowed = AllowedSet()
     pairs = allowed.pairs
-    # Vectorized fast paths for the dominant mapping forms (the
+    # Vectorized fast paths for the dominant mapping forms, classified
+    # ONCE at rule compile time (rules/compile.py _mapping_kind — the
     # deploy/rules.yaml shapes): at 100k allowed ids the general loop's
     # per-id expression evaluation is the proxy-side cost of a big list
     # filter, and these forms compute the same pairs with plain string
-    # ops. Semantics match expr.py's split_name/split_namespace exactly
-    # (first '/' splits; no '/' => cluster-scoped).
-    # getattr: tests substitute duck-typed expr fakes without .source.
-    # The refs check distinguishes the EXPRESSION form from a braceless
-    # LITERAL template that merely spells "resourceId" (legal per the
-    # {{ }}/literal duality; literals compile with empty refs and mean a
-    # constant name — matching it here would fail OPEN).
-    def _expr_src(e) -> Optional[str]:
-        if e is None or "resourceId" not in getattr(e, "refs", ()):
-            return None
-        return getattr(e, "source", "").strip()
-
-    name_src = _expr_src(pf.name_expr)
-    ns_src = _expr_src(pf.namespace_expr)
-    if name_src == "resourceId" and pf.namespace_expr is None:
+    # ops. Split semantics match expr.py's split_name/split_namespace
+    # exactly (first '/' splits; no '/' => cluster-scoped).
+    kind = getattr(pf, "mapping_kind", "general")
+    if kind == "identity":
         pairs.update(("", obj_id) for obj_id in ids)
         return allowed
-    if name_src == "split_name(resourceId)" and \
-            ns_src == "split_namespace(resourceId)":
+    if kind == "split":
         for obj_id in ids:
             ns, sep, nm = obj_id.partition("/")
             pairs.add((ns, nm) if sep else ("", obj_id))

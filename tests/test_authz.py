@@ -723,6 +723,9 @@ prefilter:
             return data["resourceId"]
 
     object.__setattr__(pf, "name_expr", FailsOnBad())
+    # the substituted fake must run the GENERAL loop, not the identity
+    # fast path the original {{resourceId}} classified into
+    object.__setattr__(pf, "mapping_kind", "general")
     with pytest.raises(PreFilterError, match="unmappable|mapping"):
         run_prefilter_sync(env.engine, pf, inp)  # strict default
     allowed = run_prefilter_sync(env.engine, pf, inp, strict=False)
@@ -1429,11 +1432,14 @@ prefilter:
             resource="pods"))[0].pre_filters[0]
 
     # identity fast path == a general expr forced off the fast path by
-    # an equivalent-but-differently-spelled source
-    fast = run_prefilter_sync(engine, pf_for(
-        '- fromObjectIDNameExpr: "{{resourceId}}"\n'
+    # an equivalent-but-differently-spelled source; interior whitespace
+    # must NOT defeat the compile-time classification
+    pf_id = pf_for(
+        '- fromObjectIDNameExpr: "{{ resourceId }}"\n'
         '  lookupMatchingResources:\n'
-        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+        '    tpl: "pod:$#view@user:{{user.name}}"')
+    assert pf_id.mapping_kind == "identity"
+    fast = run_prefilter_sync(engine, pf_id, input)
     general = run_prefilter_sync(engine, pf_for(
         '- fromObjectIDNameExpr: "{{string(resourceId)}}"\n'
         '  lookupMatchingResources:\n'
@@ -1443,19 +1449,25 @@ prefilter:
     # a braceless LITERAL template that merely spells "resourceId" means
     # a CONSTANT name (the {{ }}/literal duality) — it must NOT take the
     # identity fast path (review finding: matching it fails open)
-    literal = run_prefilter_sync(engine, pf_for(
+    pf_lit = pf_for(
         '- fromObjectIDNameExpr: "resourceId"\n'
         '  lookupMatchingResources:\n'
-        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+        '    tpl: "pod:$#view@user:{{user.name}}"')
+    assert pf_lit.mapping_kind == "general"
+    literal = run_prefilter_sync(engine, pf_lit, input)
     assert literal.pairs == {("", "resourceId")}
 
     # split fast path == general split evaluation (name-only spelling
-    # avoids the fast path; add the ns expr separately)
-    fast = run_prefilter_sync(engine, pf_for(
-        '- fromObjectIDNameExpr: "{{split_name(resourceId)}}"\n'
-        '  fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"\n'
+    # avoids the fast path; add the ns expr separately); whitespace
+    # variants classify too
+    pf_split = pf_for(
+        '- fromObjectIDNameExpr: "{{ split_name( resourceId ) }}"\n'
+        '  fromObjectIDNamespaceExpr: '
+        '"{{ split_namespace( resourceId ) }}"\n'
         '  lookupMatchingResources:\n'
-        '    tpl: "pod:$#view@user:{{user.name}}"'), input)
+        '    tpl: "pod:$#view@user:{{user.name}}"')
+    assert pf_split.mapping_kind == "split"
+    fast = run_prefilter_sync(engine, pf_split, input)
     general = run_prefilter_sync(engine, pf_for(
         '- fromObjectIDNameExpr: "{{string(split_name(resourceId))}}"\n'
         '  fromObjectIDNamespaceExpr: '

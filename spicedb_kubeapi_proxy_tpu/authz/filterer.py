@@ -50,11 +50,17 @@ def filter_body(body: bytes, allowed: AllowedSet,
             ns, name = _meta_pair(obj)
             if allowed.allows(ns, name):
                 kept.append(row)
+        if len(kept) == len(rows):
+            return 200, body  # nothing dropped: byte-identical
         doc["rows"] = kept
         return 200, json.dumps(doc).encode()
     if kind.endswith("List"):
         items = doc.get("items") or []
         kept = [o for o in items if allowed.allows(*_meta_pair(o))]
+        if len(kept) == len(items):
+            # nothing dropped — the common admin/owner case: skip the
+            # re-serialize of a multi-MB body and keep bytes identical
+            return 200, body
         doc["items"] = kept
         return 200, json.dumps(doc).encode()
     # single object

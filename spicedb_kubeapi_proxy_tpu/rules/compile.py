@@ -125,13 +125,31 @@ class PreFilter:
     name_expr: CompiledExpr
     namespace_expr: Optional[CompiledExpr]
     rel: RelExpr
-    # compile-time classification of the id->(ns, name) mapping so the
-    # hot prefilter loop can vectorize the dominant forms without
-    # request-time string matching: "identity" ({{resourceId}} name, no
-    # namespace expr), "split" (split_name/split_namespace pair), or
-    # "general" (anything else, incl. braceless literals — those have
-    # empty refs and mean a CONSTANT name, never the id)
-    mapping_kind: str = "general"
+
+    @property
+    def mapping_kind(self) -> str:
+        """Classification of the id->(ns, name) mapping so the hot
+        prefilter loop can vectorize the dominant forms: "identity"
+        ({{resourceId}} name, no namespace expr), "split"
+        (split_name/split_namespace pair), or "general" (anything else,
+        incl. braceless literals — those have empty refs and mean a
+        CONSTANT name, never the id). A property derived from the exprs
+        (not stored state) so tests substituting duck-typed expr fakes
+        can never leave a stale classification; whitespace inside the
+        expression is insignificant ('{{ split_name( resourceId ) }}'
+        still vectorizes)."""
+        def norm(e) -> Optional[str]:
+            if e is None or "resourceId" not in getattr(e, "refs", ()):
+                return None
+            return "".join(getattr(e, "source", "").split())
+
+        name_src = norm(self.name_expr)
+        if name_src == "resourceId" and self.namespace_expr is None:
+            return "identity"
+        if name_src == "split_name(resourceId)" and \
+                norm(self.namespace_expr) == "split_namespace(resourceId)":
+            return "split"
+        return "general"
 
     def mapping_shareable(self) -> bool:
         """True when the id→(namespace, name) mapping depends on nothing
@@ -232,27 +250,6 @@ def _compile_sot_rel(sot: StringOrTemplate, where: str) -> RelExpr:
     return e
 
 
-def _mapping_kind(name_expr: CompiledExpr,
-                  ns_expr: Optional[CompiledExpr]) -> str:
-    """Classify the mapping form ONCE at compile time. Whitespace inside
-    the expression is insignificant, so sources are compared with it
-    stripped ('{{ split_name( resourceId ) }}' still vectorizes); the
-    refs guard excludes braceless literals that merely SPELL resourceId
-    (they compile with empty refs and mean a constant name)."""
-    def norm(e: Optional[CompiledExpr]) -> Optional[str]:
-        if e is None or "resourceId" not in e.refs:
-            return None
-        return "".join(e.source.split())
-
-    name_src, ns_src = norm(name_expr), norm(ns_expr)
-    if name_src == "resourceId" and ns_expr is None:
-        return "identity"
-    if name_src == "split_name(resourceId)" and \
-            ns_src == "split_namespace(resourceId)":
-        return "split"
-    return "general"
-
-
 def _compile_prefilter(p: PreFilterSpec, where: str) -> PreFilter:
     try:
         name_expr = compile_template(p.from_object_id_name_expr)
@@ -261,8 +258,7 @@ def _compile_prefilter(p: PreFilterSpec, where: str) -> PreFilter:
     except ExprError as e:
         raise CompileError(f"{where}: {e}") from None
     rel = _compile_sot_rel(p.lookup_matching_resources, where)
-    return PreFilter(name_expr, ns_expr, rel,
-                     mapping_kind=_mapping_kind(name_expr, ns_expr))
+    return PreFilter(name_expr, ns_expr, rel)
 
 
 def compile_rule(cfg: RuleConfig) -> RunnableRule:

@@ -723,9 +723,10 @@ prefilter:
             return data["resourceId"]
 
     object.__setattr__(pf, "name_expr", FailsOnBad())
-    # the substituted fake must run the GENERAL loop, not the identity
-    # fast path the original {{resourceId}} classified into
-    object.__setattr__(pf, "mapping_kind", "general")
+    # mapping_kind is DERIVED from the exprs: the duck-typed fake (no
+    # refs/source) reclassifies the prefilter as "general" automatically,
+    # so the substituted expr actually runs
+    assert pf.mapping_kind == "general"
     with pytest.raises(PreFilterError, match="unmappable|mapping"):
         run_prefilter_sync(env.engine, pf, inp)  # strict default
     allowed = run_prefilter_sync(env.engine, pf, inp, strict=False)
@@ -1383,6 +1384,50 @@ def test_watch_error_status_frames_pass_through():
         task.cancel()
         env.kube.stop_watches()
     run(go())
+
+
+def test_list_filter_no_drop_is_byte_identical():
+    """When every list item / table row is allowed, the response body
+    passes through byte-identical — no decode/re-serialize artifacts
+    (key order, float formatting, unicode escapes) and no re-serialize
+    cost on multi-MB bodies."""
+    from spicedb_kubeapi_proxy_tpu.authz.filterer import filter_body
+    from spicedb_kubeapi_proxy_tpu.authz.lookups import AllowedSet
+    from spicedb_kubeapi_proxy_tpu.rules.input import (
+        RequestInfo,
+        ResolveInput,
+        UserInfo,
+    )
+
+    input = ResolveInput.create(
+        RequestInfo(verb="list", api_version="v1", resource="pods",
+                    path="/api/v1/pods"),
+        UserInfo(name="a"))
+    # deliberately quirky serialization a re-dump would normalize
+    body = (b'{"kind":"PodList",  "items":[\n'
+            b'  {"metadata":{"name":"p1","namespace":"ns"}},'
+            b'{"metadata":{"namespace":"ns","name":"p2"},"x":1.50}]}')
+    allowed = AllowedSet({("ns", "p1"), ("ns", "p2")})
+    status, out = filter_body(body, allowed, input)
+    assert (status, out) == (200, body)
+    # dropping one item still filters (and re-serializes)
+    partial = AllowedSet({("ns", "p1")})
+    status, out = filter_body(body, partial, input)
+    assert status == 200
+    names = [o["metadata"]["name"] for o in json.loads(out)["items"]]
+    assert names == ["p1"]
+    # Table branch: all rows kept -> byte-identical; a drop re-serializes
+    table = (b'{"kind":"Table", "rows":[\n'
+             b' {"cells":["p1"],"object":{"metadata":'
+             b'{"name":"p1","namespace":"ns"}}},'
+             b' {"cells":["p2"],"object":{"metadata":'
+             b'{"name":"p2","namespace":"ns"}}}]}')
+    status, out = filter_body(table, allowed, input)
+    assert (status, out) == (200, table)
+    status, out = filter_body(table, partial, input)
+    assert status == 200
+    kept_rows = json.loads(out)["rows"]
+    assert [r["object"]["metadata"]["name"] for r in kept_rows] == ["p1"]
 
 
 def test_prefilter_mapping_fast_paths_match_general_evaluation():

@@ -32,9 +32,72 @@ def _meta_pair(obj: dict) -> tuple[str, str]:
     return meta.get("namespace") or "", meta.get("name") or ""
 
 
+def _filter_list_wire(body: bytes, allowed: AllowedSet):
+    """Native wire-level JSON list filtering (graphcore.cpp
+    json_list_spans): drop disallowed items by byte span — kept items AND
+    the whole wrapper stay byte-identical, and a 15 MB 100k-item body
+    never goes through json.loads (~10x faster; numbers in
+    bench_results/proxy_path_r5_cpu.json). Returns (status, new_body) or
+    None to fall back to the Python path (scanner bailed, Table/single
+    kinds, native unavailable)."""
+    from .. import native
+
+    scan = native.json_list_spans(body)
+    if scan is None:
+        return None
+    kind_b, arr_span, item_spans, keys = scan
+    kind = kind_b.decode("utf-8", "replace")
+    if kind == "Table" or not kind.endswith("List"):
+        return None  # Table rows / single objects: Python path
+    # per-item records [esc] ns 0x1f name 0x1e, split in ONE C call; an
+    # unescaped item's WHOLE record compares against the precomputed
+    # record set — one set lookup, no per-item slicing or decoding
+    # (escaped names, rare, take the exact json.loads route)
+    recs = keys.split(b"\x1e")
+    pairs_rec = allowed.pairs_records()
+    pairs = allowed.pairs
+    loads = json.loads
+    kept_idx: list = []
+    dropped = False
+    idx = 0
+    for rec in recs[:len(recs) - 1]:
+        if rec in pairs_rec:
+            ok = True
+        elif rec[0] == 0x31:  # b'1': escapes present, decode exactly
+            ns_b, _, nm_b = rec[1:].partition(b"\x1f")
+            try:
+                ns = loads(b'"%s"' % ns_b) if b"\\" in ns_b \
+                    else ns_b.decode("utf-8")
+                nm = loads(b'"%s"' % nm_b) if b"\\" in nm_b \
+                    else nm_b.decode("utf-8")
+            except ValueError:
+                # invalid escape / invalid utf-8: json.loads would have
+                # rejected the whole body — fall back so the Python path
+                # produces its clean 401, not an unhandled 500
+                return None
+            ok = (ns, nm) in pairs
+        else:
+            ok = False
+        if ok:
+            kept_idx.append(idx)
+        else:
+            dropped = True
+        idx += 1
+    if not dropped:
+        return 200, body  # byte-identical passthrough
+    spans = item_spans[kept_idx].tolist() if kept_idx else []
+    parts = [body[:int(arr_span[0])],
+             b",".join(body[s:e] for s, e in spans),
+             body[int(arr_span[1]):]]
+    return 200, b"".join(parts)
+
+
 def filter_body(body: bytes, allowed: AllowedSet,
                 input: ResolveInput) -> tuple[int, bytes]:
     """Filter a JSON response body; returns (status, new_body)."""
+    wire = _filter_list_wire(body, allowed)
+    if wire is not None:
+        return wire
     try:
         doc = json.loads(body)
     except ValueError as e:

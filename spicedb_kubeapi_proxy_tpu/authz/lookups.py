@@ -39,12 +39,37 @@ class AllowedSet:
     """Allowed (namespace, name) pairs; namespace '' for cluster-scoped."""
 
     pairs: set = field(default_factory=set)
+    # lazy utf-8 view for the native wire filter (authz/filterer.py):
+    # comparing raw JSON string bytes against encoded pairs skips a
+    # per-item decode on the hot loop
+    _pairs_bytes: Optional[set] = field(default=None, repr=False,
+                                        compare=False)
 
     def add(self, namespace: str, name: str) -> None:
         self.pairs.add((namespace or "", name))
+        self._pairs_bytes = None
 
     def allows(self, namespace: str, name: str) -> bool:
         return (namespace or "", name) in self.pairs
+
+    def pairs_records(self) -> set:
+        """Packed ``b"0" + ns + 0x1f + name`` records, the native wire
+        filter's per-item key format — a kept item is ONE set lookup on
+        the already-materialized record bytes, no per-item slicing."""
+        if self._pairs_bytes is None:
+            out = set()
+            for ns, n in self.pairs:
+                try:
+                    out.add(b"0%s\x1f%s" % (ns.encode("utf-8"),
+                                            n.encode("utf-8")))
+                except UnicodeEncodeError:
+                    # lone surrogates cannot appear in an UNESCAPED
+                    # record (the scanner validates utf-8); items naming
+                    # them arrive escape-flagged and compare via the
+                    # decoded-str path against .pairs
+                    pass
+            self._pairs_bytes = out
+        return self._pairs_bytes
 
     def __len__(self) -> int:
         return len(self.pairs)
@@ -95,11 +120,13 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
     kind = getattr(pf, "mapping_kind", "general")
     if kind == "identity":
         pairs.update(("", obj_id) for obj_id in ids)
-        return allowed
+        allowed._pairs_bytes = None  # direct .pairs mutation: keep the
+        return allowed               # record cache coherent
     if kind == "split":
         for obj_id in ids:
             ns, sep, nm = obj_id.partition("/")
             pairs.add((ns, nm) if sep else ("", obj_id))
+        allowed._pairs_bytes = None
         return allowed
     base = input.template_data()
     # one mutable data map, not a copy per id: the exprs only read it,
@@ -126,6 +153,7 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
                             "(skipping; fails closed): %s", obj_id, e)
             continue
         pairs.add((ns or "", name))
+    allowed._pairs_bytes = None  # direct .pairs mutation (see fast paths)
     if skipped > 1:
         log.warning("prefilter id mapping skipped %d more ids", skipped - 1)
     return allowed

@@ -112,6 +112,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.json_list_spans.restype = ctypes.c_int64
+    lib.json_list_spans.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
     return lib
 
 
@@ -162,6 +169,39 @@ def index_build(rt, rid, rl, st, sid, srl):
         order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return hashes, order
+
+
+def json_list_spans(body: bytes, items_key: bytes = b"items"):
+    """One-pass scan of a kube List response body (graphcore.cpp
+    json_list_spans): returns ``(kind, arr_span, item_spans, keys)`` —
+    kind as bytes (b"" when absent), spans as int64 arrays of byte
+    offsets into ``body``, and ``keys`` as one packed bytes buffer of
+    per-item records ``[esc '0'|'1'] ns_raw 0x1f name_raw 0x1e`` (raw =
+    undecoded string content; JSON forbids unescaped control bytes, so
+    the separators cannot collide) — or None when the native path does
+    not apply or the scanner bailed (caller falls back to json.loads;
+    the scanner is strictly conservative)."""
+    lib = _load()
+    if lib is None or not isinstance(body, bytes) or not body:
+        return None
+    # every object item contains at least one '{': a cheap upper bound
+    max_items = body.count(b"{") + 1
+    kind_span = np.empty(2, dtype=np.int64)
+    arr_span = np.empty(2, dtype=np.int64)
+    item_spans = np.empty(2 * max_items, dtype=np.int64)
+    key_buf = ctypes.create_string_buffer(len(body) + 3 * max_items + 16)
+    key_len = ctypes.c_int64(0)
+    p64 = ctypes.POINTER(ctypes.c_int64)
+    count = lib.json_list_spans(
+        body, len(body), items_key,
+        kind_span.ctypes.data_as(p64), arr_span.ctypes.data_as(p64),
+        item_spans.ctypes.data_as(p64), key_buf,
+        ctypes.byref(key_len), max_items)
+    if count < 0:
+        return None
+    kind = body[kind_span[0]:kind_span[1]] if kind_span[0] >= 0 else b""
+    return (kind, arr_span, item_spans[:2 * count].reshape(-1, 2),
+            key_buf.raw[:key_len.value])
 
 
 def sort_perm(keys: np.ndarray) -> Optional[np.ndarray]:

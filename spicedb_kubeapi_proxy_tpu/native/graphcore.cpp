@@ -215,3 +215,352 @@ extern "C" void index_build_u64(
     std::memcpy(order_out, psrc, n * sizeof(int64_t));
 }
 
+
+// ---------------------------------------------------------------------------
+// JSON list scanner (authz/filterer.py): one pass over a kube List response
+// body locating the top-level "kind" value, the top-level `items_key` array,
+// every element's byte span, and each element's metadata.name /
+// metadata.namespace string-value spans (raw bytes between the quotes —
+// escape decoding, when needed, happens Python-side). Lets the filter keep
+// items BYTE-IDENTICAL and skip json.loads on multi-MB bodies.
+//
+// Returns the item count (>= 0) on success, or a negative bail code — the
+// caller then falls back to the Python json path, so this scanner is
+// conservative: anything structurally surprising (escaped keys,
+// non-object items, duplicate items keys, trailing garbage, malformed
+// strings or scalar tokens anywhere) bails rather than risking
+// semantics that differ from json.loads. Known disclosed laxity: the
+// comma/colon PLACEMENT inside skipped substructure is not re-validated
+// — a body like {"spec":{"a" "b"}} passes here where json.loads raises
+// (which the Python path turns into a 401); an apiserver never emits
+// such bodies, and no AUTHORIZATION decision depends on skipped bytes.
+
+namespace jsonscan {
+
+struct Scan {
+  const char* b;
+  int64_t n;
+  int64_t i = 0;
+  bool fail = false;
+
+  void ws() {
+    while (i < n) {
+      const char c = b[i];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r') ++i;
+      else break;
+    }
+  }
+  bool at(char c) { return i < n && b[i] == c; }
+  static bool hex(unsigned char c) {
+    return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'f') ||
+           (c >= 'A' && c <= 'F');
+  }
+  // raw string content span [s, e); has_esc set when a backslash occurs.
+  // Validates exactly what json.loads does at the string level: literal
+  // control bytes (< 0x20) fail (strict mode — which also guarantees
+  // raw spans never contain the 0x1f/0x1e record separators of the key
+  // buffer), escape sequences must be well-formed, and the bytes must
+  // be valid UTF-8 (no overlongs, no surrogates, <= U+10FFFF) so raw
+  // byte comparison is equivalent to decoded string comparison.
+  bool str_span(int64_t* s, int64_t* e, bool* has_esc) {
+    if (!at('"')) { fail = true; return false; }
+    ++i;
+    *s = i;
+    *has_esc = false;
+    while (i < n) {
+      const unsigned char c = b[i];
+      if (c < 0x20) { fail = true; return false; }
+      if (c == '\\') {
+        *has_esc = true;
+        if (i + 1 >= n) { fail = true; return false; }
+        const unsigned char esc = b[i + 1];
+        if (esc == 'u') {
+          if (i + 5 >= n || !hex(b[i + 2]) || !hex(b[i + 3]) ||
+              !hex(b[i + 4]) || !hex(b[i + 5])) {
+            fail = true;
+            return false;
+          }
+          i += 6;
+        } else if (esc == '"' || esc == '\\' || esc == '/' ||
+                   esc == 'b' || esc == 'f' || esc == 'n' ||
+                   esc == 'r' || esc == 't') {
+          i += 2;
+        } else {
+          fail = true;  // invalid escape: json.loads rejects
+          return false;
+        }
+        continue;
+      }
+      if (c == '"') { *e = i; ++i; return true; }
+      if (c < 0x80) { ++i; continue; }
+      // multi-byte UTF-8, validated like CPython's decoder
+      int need;
+      unsigned char lo = 0x80, hi = 0xBF;
+      if (c >= 0xC2 && c <= 0xDF) need = 1;
+      else if (c == 0xE0) { need = 2; lo = 0xA0; }
+      else if (c >= 0xE1 && c <= 0xEC) need = 2;
+      else if (c == 0xED) { need = 2; hi = 0x9F; }  // no surrogates
+      else if (c == 0xEE || c == 0xEF) need = 2;
+      else if (c == 0xF0) { need = 3; lo = 0x90; }
+      else if (c >= 0xF1 && c <= 0xF3) need = 3;
+      else if (c == 0xF4) { need = 3; hi = 0x8F; }  // <= U+10FFFF
+      else { fail = true; return false; }
+      if (i + need >= n) { fail = true; return false; }
+      unsigned char c1 = b[i + 1];
+      if (c1 < lo || c1 > hi) { fail = true; return false; }
+      for (int k = 2; k <= need; ++k) {
+        const unsigned char ck = b[i + k];
+        if (ck < 0x80 || ck > 0xBF) { fail = true; return false; }
+      }
+      i += need + 1;
+    }
+    fail = true;
+    return false;
+  }
+  bool key_is(int64_t s, int64_t e, const char* lit) {
+    const int64_t m = (int64_t)strlen(lit);
+    return e - s == m && memcmp(b + s, lit, (size_t)m) == 0;
+  }
+  // strict scalar token: number / true / false / null / NaN / ±Infinity
+  // — the exact forms json.loads accepts, number grammar included
+  // (leading zeros, '+' signs, dangling exponents all fail)
+  void scalar() {
+    const int64_t s = i;
+    while (i < n) {
+      const char c = b[i];
+      if (c == ',' || c == '}' || c == ']' || c == ':' || c == ' ' ||
+          c == '\t' || c == '\n' || c == '\r')
+        break;
+      ++i;
+    }
+    const int64_t m = i - s;
+    if (m <= 0) { fail = true; return; }
+    auto is = [&](const char* lit) {
+      return (int64_t)strlen(lit) == m && memcmp(b + s, lit, (size_t)m) == 0;
+    };
+    if (is("true") || is("false") || is("null") || is("NaN") ||
+        is("Infinity") || is("-Infinity"))
+      return;
+    // -?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?
+    const char* p = b + s;
+    int64_t k = 0;
+    auto dig = [&](int64_t j) {
+      return j < m && p[j] >= '0' && p[j] <= '9';
+    };
+    if (k < m && p[k] == '-') ++k;
+    if (!dig(k)) { fail = true; return; }
+    if (p[k] == '0') ++k;
+    else while (dig(k)) ++k;
+    if (k < m && p[k] == '.') {
+      ++k;
+      if (!dig(k)) { fail = true; return; }
+      while (dig(k)) ++k;
+    }
+    if (k < m && (p[k] == 'e' || p[k] == 'E')) {
+      ++k;
+      if (k < m && (p[k] == '+' || p[k] == '-')) ++k;
+      if (!dig(k)) { fail = true; return; }
+      while (dig(k)) ++k;
+    }
+    if (k != m) fail = true;
+  }
+  // Skip any value. Containers are walked iteratively with every string
+  // and scalar TOKEN validated (so `@@@` or `1e+e+5` anywhere bails);
+  // comma/colon PLACEMENT inside skipped substructure is not re-checked
+  // — that is the one laxity vs json.loads, disclosed in the entry
+  // point's contract comment.
+  void skip_value() {
+    ws();
+    if (fail || i >= n) { fail = true; return; }
+    const char c0 = b[i];
+    if (c0 == '"') {
+      int64_t s, e;
+      bool esc;
+      str_span(&s, &e, &esc);
+      return;
+    }
+    if (c0 == '{' || c0 == '[') {
+      int64_t depth = 0;
+      while (i < n) {
+        const char c = b[i];
+        if (c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+            c == ',' || c == ':') {
+          ++i;
+          continue;
+        }
+        if (c == '"') {
+          int64_t s, e;
+          bool esc;
+          if (!str_span(&s, &e, &esc)) return;
+          continue;
+        }
+        if (c == '{' || c == '[') { ++depth; ++i; continue; }
+        if (c == '}' || c == ']') {
+          --depth;
+          ++i;
+          if (depth == 0) return;
+          if (depth < 0) { fail = true; return; }
+          continue;
+        }
+        scalar();
+        if (fail) return;
+      }
+      fail = true;
+      return;
+    }
+    scalar();
+  }
+};
+
+}  // namespace jsonscan
+
+extern "C" int64_t json_list_spans(
+    const char* buf, int64_t n, const char* items_key,
+    int64_t* kind_span,   // [2] raw value span, -1,-1 when absent
+    int64_t* arr_span,    // [2] start = after '[', end = index of ']'
+    int64_t* item_spans,  // [2 * max_items]
+    char* key_buf,        // >= n + 3*max_items bytes; per item one record
+                          // [esc '0'|'1'] ns_raw 0x1f name_raw 0x1e (raw =
+                          // undecoded string content; missing -> empty)
+    int64_t* key_len,     // out: bytes written into key_buf
+    int64_t max_items) {
+  jsonscan::Scan sc{buf, n};
+  kind_span[0] = kind_span[1] = -1;
+  arr_span[0] = arr_span[1] = -1;
+  *key_len = 0;
+  int64_t count = 0;
+  bool items_seen = false;
+  // per-item metadata string spans (last-wins under duplicate keys, so
+  // the record is emitted only when the item closes)
+  int64_t nm_s, nm_e, ns_s, ns_e;
+  bool nm_esc, ns_esc;
+
+  // one object level: dispatch(key_s, key_e) -> true when it consumed the
+  // value itself; false means "skip it here"
+  auto walk_object = [&](auto&& on_key) -> bool {
+    sc.ws();
+    if (!sc.at('{')) { sc.fail = true; return false; }
+    ++sc.i;
+    sc.ws();
+    if (sc.at('}')) { ++sc.i; return true; }
+    while (true) {
+      sc.ws();
+      int64_t ks, ke;
+      bool kesc;
+      if (!sc.str_span(&ks, &ke, &kesc)) return false;
+      if (kesc) { sc.fail = true; return false; }  // escaped key: bail
+      sc.ws();
+      if (!sc.at(':')) { sc.fail = true; return false; }
+      ++sc.i;
+      if (!on_key(ks, ke)) sc.skip_value();
+      if (sc.fail) return false;
+      sc.ws();
+      if (sc.at(',')) { ++sc.i; continue; }
+      if (sc.at('}')) { ++sc.i; return true; }
+      sc.fail = true;
+      return false;
+    }
+  };
+
+  auto parse_metadata = [&]() -> bool {
+    // last-wins like dict construction: reset, then fill
+    nm_s = nm_e = ns_s = ns_e = -1;
+    nm_esc = ns_esc = false;
+    sc.ws();
+    if (!sc.at('{')) { sc.fail = true; return false; }
+    return walk_object([&](int64_t ks, int64_t ke) -> bool {
+      const bool is_name = sc.key_is(ks, ke, "name");
+      const bool is_ns = !is_name && sc.key_is(ks, ke, "namespace");
+      if (!is_name && !is_ns) return false;
+      sc.ws();
+      if (!sc.at('"')) {
+        // non-string name/namespace: Python's or-coercion semantics
+        // differ from treat-as-missing — bail to the json path
+        sc.fail = true;
+        return true;
+      }
+      int64_t vs, ve;
+      bool vesc;
+      if (!sc.str_span(&vs, &ve, &vesc)) return true;
+      if (is_name) { nm_s = vs; nm_e = ve; nm_esc = vesc; }
+      else { ns_s = vs; ns_e = ve; ns_esc = vesc; }
+      return true;
+    });
+  };
+
+  auto parse_item = [&]() -> bool {
+    if (count >= max_items) { sc.fail = true; return false; }
+    const int64_t idx = count;
+    nm_s = nm_e = ns_s = ns_e = -1;
+    nm_esc = ns_esc = false;
+    sc.ws();
+    const int64_t start = sc.i;
+    if (!sc.at('{')) { sc.fail = true; return false; }  // non-object item
+    if (!walk_object([&](int64_t ks, int64_t ke) -> bool {
+          if (!sc.key_is(ks, ke, "metadata")) return false;
+          return parse_metadata();
+        }))
+      return false;
+    item_spans[2 * idx] = start;
+    item_spans[2 * idx + 1] = sc.i;  // exclusive, after the closing '}'
+    char* kb = key_buf + *key_len;
+    *kb++ = (nm_esc || ns_esc) ? '1' : '0';
+    if (ns_s >= 0) {
+      memcpy(kb, buf + ns_s, (size_t)(ns_e - ns_s));
+      kb += ns_e - ns_s;
+    }
+    *kb++ = '\x1f';
+    if (nm_s >= 0) {
+      memcpy(kb, buf + nm_s, (size_t)(nm_e - nm_s));
+      kb += nm_e - nm_s;
+    }
+    *kb++ = '\x1e';
+    *key_len = kb - key_buf;
+    ++count;
+    return true;
+  };
+
+  auto parse_items_array = [&]() -> bool {
+    sc.ws();
+    if (!sc.at('[')) { sc.fail = true; return false; }
+    ++sc.i;
+    arr_span[0] = sc.i;
+    sc.ws();
+    if (sc.at(']')) { arr_span[1] = sc.i; ++sc.i; return true; }
+    while (true) {
+      if (!parse_item()) return false;
+      sc.ws();
+      if (sc.at(',')) { ++sc.i; continue; }
+      if (sc.at(']')) { arr_span[1] = sc.i; ++sc.i; return true; }
+      sc.fail = true;
+      return false;
+    }
+  };
+
+  const bool ok = walk_object([&](int64_t ks, int64_t ke) -> bool {
+    if (sc.key_is(ks, ke, "kind")) {
+      sc.ws();
+      if (!sc.at('"')) return false;  // non-string kind: skip
+      int64_t vs, ve;
+      bool vesc;
+      if (!sc.str_span(&vs, &ve, &vesc)) return true;
+      if (vesc) { sc.fail = true; return true; }  // escaped kind: bail
+      // last-wins duplicate kind, like dict construction
+      kind_span[0] = vs;
+      kind_span[1] = ve;
+      return true;
+    }
+    if (sc.key_is(ks, ke, items_key)) {
+      if (items_seen) { sc.fail = true; return true; }  // dup items: bail
+      items_seen = true;
+      parse_items_array();
+      return true;
+    }
+    return false;
+  });
+  if (!ok || sc.fail) return -1;
+  sc.ws();
+  if (sc.i != n) return -1;  // trailing garbage: json.loads would raise
+  if (!items_seen) return -1;
+  return count;
+}

@@ -91,9 +91,22 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+# bumped together with graphcore_abi_version() in graphcore.cpp on ANY
+# exported-signature change; _bind refuses a mismatching cached .so (the
+# rebuild path then fires) — binding by symbol NAME alone would let a
+# stale library misread argument slots silently
+_ABI_VERSION = 2
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     """Declare every entry point's signature; raises AttributeError when
-    the library predates a symbol."""
+    the library predates a symbol or its ABI version differs."""
+    lib.graphcore_abi_version.restype = ctypes.c_int64
+    lib.graphcore_abi_version.argtypes = []
+    got = lib.graphcore_abi_version()
+    if got != _ABI_VERSION:
+        raise AttributeError(
+            f"graphcore ABI {got} != expected {_ABI_VERSION}")
     lib.unique_inverse_fixed.restype = ctypes.c_int64
     lib.unique_inverse_fixed.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
@@ -117,7 +130,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
-        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
     ]
     return lib
 
@@ -171,16 +184,19 @@ def index_build(rt, rid, rl, st, sid, srl):
     return hashes, order
 
 
-def json_list_spans(body: bytes, items_key: bytes = b"items"):
+def json_list_spans(body: bytes, items_key: bytes = b"items",
+                    nested: bool = False):
     """One-pass scan of a kube List response body (graphcore.cpp
     json_list_spans): returns ``(kind, arr_span, item_spans, keys)`` —
     kind as bytes (b"" when absent), spans as int64 arrays of byte
-    offsets into ``body``, and ``keys`` as one packed bytes buffer of
-    per-item records ``[esc '0'|'1'] ns_raw 0x1f name_raw 0x1e`` (raw =
-    undecoded string content; JSON forbids unescaped control bytes, so
-    the separators cannot collide) — or None when the native path does
-    not apply or the scanner bailed (caller falls back to json.loads;
-    the scanner is strictly conservative)."""
+    offsets into ``body`` (``arr_span[0] < 0`` when ``items_key`` is
+    absent), and ``keys`` as one packed bytes buffer of per-item records
+    ``[esc '0'|'1'] ns_raw 0x1f name_raw 0x1e`` (raw = undecoded string
+    content; JSON forbids unescaped control bytes, so the separators
+    cannot collide) — or None when the native path does not apply or the
+    scanner bailed (caller falls back to json.loads; the scanner is
+    strictly conservative). ``nested`` reads each item's metadata from
+    ``item["object"]`` instead of the item itself (Table rows)."""
     lib = _load()
     if lib is None or not isinstance(body, bytes) or not body:
         return None
@@ -196,7 +212,7 @@ def json_list_spans(body: bytes, items_key: bytes = b"items"):
         body, len(body), items_key,
         kind_span.ctypes.data_as(p64), arr_span.ctypes.data_as(p64),
         item_spans.ctypes.data_as(p64), key_buf,
-        ctypes.byref(key_len), max_items)
+        ctypes.byref(key_len), 1 if nested else 0, max_items)
     if count < 0:
         return None
     kind = body[kind_span[0]:kind_span[1]] if kind_span[0] >= 0 else b""

@@ -423,6 +423,8 @@ extern "C" int64_t json_list_spans(
                           // [esc '0'|'1'] ns_raw 0x1f name_raw 0x1e (raw =
                           // undecoded string content; missing -> empty)
     int64_t* key_len,     // out: bytes written into key_buf
+    int64_t nested,       // 0: metadata at item top level (List items);
+                          // 1: inside item["object"] (Table rows)
     int64_t max_items) {
   jsonscan::Scan sc{buf, n};
   kind_span[0] = kind_span[1] = -1;
@@ -496,11 +498,28 @@ extern "C" int64_t json_list_spans(
     sc.ws();
     const int64_t start = sc.i;
     if (!sc.at('{')) { sc.fail = true; return false; }  // non-object item
-    if (!walk_object([&](int64_t ks, int64_t ke) -> bool {
-          if (!sc.key_is(ks, ke, "metadata")) return false;
-          return parse_metadata();
-        }))
-      return false;
+    const bool walked =
+        nested
+            ? walk_object([&](int64_t ks, int64_t ke) -> bool {
+                // Table row: the keyable object rides row["object"]
+                // (reference filters rows by that object's metadata)
+                if (!sc.key_is(ks, ke, "object")) return false;
+                sc.ws();
+                if (!sc.at('{')) { sc.fail = true; return true; }
+                // last-wins under duplicate "object" keys: a later
+                // object without metadata must CLEAR earlier spans
+                nm_s = nm_e = ns_s = ns_e = -1;
+                nm_esc = ns_esc = false;
+                return walk_object([&](int64_t ks2, int64_t ke2) -> bool {
+                  if (!sc.key_is(ks2, ke2, "metadata")) return false;
+                  return parse_metadata();
+                });
+              })
+            : walk_object([&](int64_t ks, int64_t ke) -> bool {
+                if (!sc.key_is(ks, ke, "metadata")) return false;
+                return parse_metadata();
+              });
+    if (!walked) return false;
     item_spans[2 * idx] = start;
     item_spans[2 * idx + 1] = sc.i;  // exclusive, after the closing '}'
     char* kb = key_buf + *key_len;
@@ -561,6 +580,12 @@ extern "C" int64_t json_list_spans(
   if (!ok || sc.fail) return -1;
   sc.ws();
   if (sc.i != n) return -1;  // trailing garbage: json.loads would raise
-  if (!items_seen) return -1;
+  // items_key absent entirely: legal (count 0, arr_span -1) — the
+  // caller may only need the kind (e.g. to rescan a Table under "rows")
   return count;
 }
+
+// Bumped on ANY exported-signature change: the loader refuses a library
+// whose ABI differs (a stale cached .so with preserved mtimes would
+// otherwise bind by name and silently misread arguments).
+extern "C" int64_t graphcore_abi_version() { return 2; }

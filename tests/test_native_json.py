@@ -149,7 +149,6 @@ def test_escaped_names_decode_exactly():
 
 
 @pytest.mark.parametrize("body", [
-    b'{"kind":"Table","rows":[],"items":[]}',   # Table: Python path
     b'{"items":[1,2]}',                          # non-object items: bail
     b'{"items":[{}],"items":[{}]}',              # duplicate items: bail
     b'{"items":[{}]} trailing',                  # trailing garbage: bail
@@ -187,6 +186,49 @@ def test_scanner_bails_conservatively(body):
     except FilterError:
         combined = "error"
     assert combined == py
+
+
+def test_table_rows_filter_at_the_wire():
+    """JSON Tables route through a rows-keyed rescan: metadata reads
+    from each row's ``object``; kept rows stay byte-identical and the
+    results match the Python Table path."""
+    rng = random.Random(77)
+    for _ in range(60):
+        rows = []
+        for _ in range(rng.randrange(5)):
+            row = {"cells": [rng.choice(NAMES), rng.randrange(9)]}
+            if rng.random() < 0.85:
+                row["object"] = {"kind": "PartialObjectMetadata",
+                                 "metadata": {}}
+                if rng.random() < 0.9:
+                    row["object"]["metadata"]["name"] = rng.choice(NAMES)
+                if rng.random() < 0.5:
+                    row["object"]["metadata"]["namespace"] = \
+                        rng.choice(NAMES)
+            rows.append(row)
+        doc = {"kind": "Table", "apiVersion": "meta.k8s.io/v1",
+               "columnDefinitions": [{"name": "Name", "type": "string"}],
+               "rows": rows}
+        body = json.dumps(doc,
+                          ensure_ascii=rng.random() < 0.5).encode()
+        pool = [(((r.get("object") or {}).get("metadata") or {})
+                 .get("namespace") or "",
+                 ((r.get("object") or {}).get("metadata") or {})
+                 .get("name") or "")
+                for r in rows]
+        allowed = AllowedSet(set(
+            p for p in pool if rng.random() < 0.6))
+        py = py_filter(body, allowed)
+        wire = _filter_list_wire(body, allowed)
+        assert wire is not None
+        assert wire[0] == py[0] == 200
+        assert json.loads(wire[1]) == json.loads(py[1])
+        if py[1] == body:
+            # nothing dropped: the wire path must be byte-identical too
+            assert wire[1] == body
+    # empty table passes through byte-identically
+    empty = b'{"kind":"Table","rows":[],"items":[]}'
+    assert _filter_list_wire(empty, AllowedSet(set())) == (200, empty)
 
 
 def test_lone_surrogate_names_ride_escaped_records():

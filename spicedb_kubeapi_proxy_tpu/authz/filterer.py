@@ -152,6 +152,38 @@ def filter_body(body: bytes, allowed: AllowedSet,
     return 404, b""
 
 
+def _filter_proto_list_native(body: bytes, raw: bytes,
+                              allowed: AllowedSet):
+    """Native proto list filtering (graphcore.cpp proto_list_spans):
+    same record-set comparison as the JSON wire path, ~30x faster than
+    the pure-Python varint walker at 100k items. Returns (status,
+    new_body) or None to fall back (scanner bailed)."""
+    from .. import native
+
+    scan = native.proto_list_spans(raw)
+    if scan is None:
+        return None
+    item_spans, keys = scan
+    recs = keys.split(b"\x1e")
+    pairs_rec = allowed.pairs_records()
+    drop_spans: list = []
+    idx = 0
+    for rec in recs[:len(recs) - 1]:
+        if rec not in pairs_rec:
+            drop_spans.append(idx)
+        idx += 1
+    if not drop_spans:
+        return 200, body  # byte-identical passthrough
+    spans = item_spans[drop_spans].tolist()
+    parts = []
+    pos = 0
+    for s, e in spans:
+        parts.append(raw[pos:s])
+        pos = e
+    parts.append(raw[pos:])
+    return 200, kubeproto.replace_unknown_raw(body, b"".join(parts))
+
+
 def filter_body_proto(body: bytes, allowed: AllowedSet,
                       input: ResolveInput) -> tuple[int, bytes]:
     """Filter a kube-protobuf response body; returns (status, new_body).
@@ -170,6 +202,9 @@ def filter_body_proto(body: bytes, allowed: AllowedSet,
             new_raw = kubeproto.filter_table_raw(raw, allowed.allows)
             return 200, kubeproto.replace_unknown_raw(body, new_raw)
         if kind.endswith("List"):
+            wire = _filter_proto_list_native(body, raw, allowed)
+            if wire is not None:
+                return wire
             new_raw = kubeproto.filter_list_raw(raw, allowed.allows)
             return 200, kubeproto.replace_unknown_raw(body, new_raw)
     except kubeproto.ProtoError as e:

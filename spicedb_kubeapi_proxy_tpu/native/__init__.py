@@ -95,7 +95,7 @@ def _load() -> Optional[ctypes.CDLL]:
 # exported-signature change; _bind refuses a mismatching cached .so (the
 # rebuild path then fires) — binding by symbol NAME alone would let a
 # stale library misread argument slots silently
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -131,6 +131,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.proto_list_spans.restype = ctypes.c_int64
+    lib.proto_list_spans.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
     return lib
 
@@ -217,7 +223,41 @@ def json_list_spans(body: bytes, items_key: bytes = b"items",
         return None
     kind = body[kind_span[0]:kind_span[1]] if kind_span[0] >= 0 else b""
     return (kind, arr_span, item_spans[:2 * count].reshape(-1, 2),
-            key_buf.raw[:key_len.value])
+            ctypes.string_at(key_buf, key_len.value))
+
+
+def proto_list_spans(raw: bytes):
+    """One-pass scan of a kube-protobuf *List MESSAGE (the Unknown
+    envelope's raw field): returns ``(item_spans, keys)`` — full-chunk
+    spans (tag included) of every repeated ``items`` element, and the
+    same packed key-record buffer the JSON scanner emits
+    (``'0' ns 0x1f name 0x1e``; first-occurrence field semantics like
+    kubeproto._field) — or None when the native path does not apply or
+    the scanner bailed (truncated wire data, control bytes or invalid
+    utf-8 in a name: the Python walker keeps authority)."""
+    lib = _load()
+    if lib is None or not isinstance(raw, bytes) or not raw:
+        return None
+    # start with a realistic bound (items are tens of bytes) and grow on
+    # the scanner's overflow code — a degenerate body of 2-byte items
+    # would otherwise force a huge upfront allocation
+    max_items = len(raw) // 64 + 1024
+    p64 = ctypes.POINTER(ctypes.c_int64)
+    while True:
+        item_spans = np.empty(2 * max_items, dtype=np.int64)
+        key_buf = ctypes.create_string_buffer(
+            len(raw) + 3 * max_items + 16)
+        key_len = ctypes.c_int64(0)
+        count = lib.proto_list_spans(
+            raw, len(raw), item_spans.ctypes.data_as(p64), key_buf,
+            ctypes.byref(key_len), max_items)
+        if count == -2 and max_items < len(raw) // 2 + 2:
+            max_items = min(max_items * 4, len(raw) // 2 + 2)
+            continue
+        if count < 0:
+            return None
+        return (item_spans[:2 * count].reshape(-1, 2),
+                ctypes.string_at(key_buf, key_len.value))
 
 
 def sort_perm(keys: np.ndarray) -> Optional[np.ndarray]:

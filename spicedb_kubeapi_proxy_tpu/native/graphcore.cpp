@@ -588,4 +588,182 @@ extern "C" int64_t json_list_spans(
 // Bumped on ANY exported-signature change: the loader refuses a library
 // whose ABI differs (a stale cached .so with preserved mtimes would
 // otherwise bind by name and silently misread arguments).
-extern "C" int64_t graphcore_abi_version() { return 2; }
+extern "C" int64_t graphcore_abi_version() { return 3; }
+
+// ---------------------------------------------------------------------------
+// Protobuf list scanner (authz/filterer.py filter_body_proto): one pass
+// over a kube *List message's bytes (the runtime.Unknown `raw` field,
+// magic stripped) locating every repeated `items` element's full chunk
+// span (tag included) and packing the same per-item key records the JSON
+// scanner emits: '0' ns 0x1f name 0x1e. First-occurrence field semantics
+// mirror the Python walker (kubeproto._field). Bails (-1) on truncated
+// wire data, or on names/namespaces containing control bytes (< 0x20 —
+// would collide with the record separators) or invalid UTF-8 (the Python
+// path decodes with errors="replace"; such names cannot legitimately
+// exist in kube and authority stays with the slow path).
+
+namespace protoscan {
+
+struct PScan {
+  const unsigned char* b;
+  int64_t n;
+  int64_t i = 0;
+  bool fail = false;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (i < n) {
+      const unsigned char c = b[i++];
+      v |= (uint64_t)(c & 0x7F) << shift;
+      if (!(c & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) { fail = true; return 0; }
+    }
+    fail = true;
+    return 0;
+  }
+  // skip one field of wire type wt (tag already consumed)
+  void skip(int wt) {
+    switch (wt) {
+      case 0: varint(); return;
+      case 1: i += 8; if (i > n) fail = true; return;
+      case 2: {
+        const uint64_t len = varint();
+        if (fail) return;
+        // validate BEFORE the signed cast: a huge length varint would
+        // otherwise wrap negative and walk backward / spin forever
+        if (len > (uint64_t)(n - i)) { fail = true; return; }
+        i += (int64_t)len;
+        return;
+      }
+      case 5: i += 4; if (i > n) fail = true; return;
+      default: fail = true; return;
+    }
+  }
+};
+
+// valid UTF-8 with no control bytes (< 0x20)?
+static bool clean_utf8(const unsigned char* p, int64_t m) {
+  int64_t i = 0;
+  while (i < m) {
+    const unsigned char c = p[i];
+    if (c < 0x20) return false;
+    if (c < 0x80) { ++i; continue; }
+    int need;
+    unsigned char lo = 0x80, hi = 0xBF;
+    if (c >= 0xC2 && c <= 0xDF) need = 1;
+    else if (c == 0xE0) { need = 2; lo = 0xA0; }
+    else if (c >= 0xE1 && c <= 0xEC) need = 2;
+    else if (c == 0xED) { need = 2; hi = 0x9F; }
+    else if (c == 0xEE || c == 0xEF) need = 2;
+    else if (c == 0xF0) { need = 3; lo = 0x90; }
+    else if (c >= 0xF1 && c <= 0xF3) need = 3;
+    else if (c == 0xF4) { need = 3; hi = 0x8F; }
+    else return false;
+    if (i + need >= m) return false;
+    if (p[i + 1] < lo || p[i + 1] > hi) return false;
+    for (int k = 2; k <= need; ++k)
+      if (p[i + k] < 0x80 || p[i + k] > 0xBF) return false;
+    i += need + 1;
+  }
+  return true;
+}
+
+}  // namespace protoscan
+
+extern "C" int64_t proto_list_spans(
+    const char* buf_, int64_t n,
+    int64_t* item_spans,  // [2*max_items] full chunk spans (tag included)
+    char* key_buf,        // >= n + 3*max_items; '0' ns 0x1f name 0x1e
+    int64_t* key_len, int64_t max_items) {
+  using protoscan::PScan;
+  const unsigned char* buf = (const unsigned char*)buf_;
+  PScan sc{buf, n};
+  *key_len = 0;
+  int64_t count = 0;
+  while (sc.i < n) {
+    const int64_t tag_start = sc.i;
+    const uint64_t tag = sc.varint();
+    if (sc.fail) return -1;
+    // field numbers compared at full 64-bit width: truncation could
+    // alias a huge field number onto 2 and mis-key a chunk as an item
+    const uint64_t fno = tag >> 3;
+    const int wt = (int)(tag & 7);
+    if (fno != 2 || wt != 2) {  // every XList: repeated items = field 2
+      sc.skip(wt);
+      if (sc.fail) return -1;
+      continue;
+    }
+    const uint64_t ilen = sc.varint();
+    if (sc.fail) return -1;
+    if (ilen > (uint64_t)(n - sc.i)) return -1;
+    const int64_t istart = sc.i, iend = sc.i + (int64_t)ilen;
+    if (count >= max_items) return -2;  // caller grows and retries
+    // first metadata (field 1, wt 2) inside the item; within it the
+    // first name (1) / namespace (3) — kubeproto._field semantics
+    int64_t nm_s = -1, nm_e = -1, ns_s = -1, ns_e = -1;
+    PScan it{buf, iend, istart};
+    bool meta_seen = false;
+    while (it.i < iend) {
+      const uint64_t t2 = it.varint();
+      if (it.fail) return -1;
+      const uint64_t f2 = t2 >> 3;
+      const int w2 = (int)(t2 & 7);
+      if (f2 == 1 && w2 == 2 && !meta_seen) {
+        meta_seen = true;
+        const uint64_t mlen = it.varint();
+        if (it.fail) return -1;
+        if (mlen > (uint64_t)(iend - it.i)) return -1;
+        const int64_t mend = it.i + (int64_t)mlen;
+        PScan md{buf, mend, it.i};
+        while (md.i < mend) {
+          const uint64_t t3 = md.varint();
+          if (md.fail) return -1;
+          const uint64_t f3 = t3 >> 3;
+          const int w3 = (int)(t3 & 7);
+          if (w3 == 2 && (f3 == 1 || f3 == 3)) {
+            const uint64_t slen = md.varint();
+            if (md.fail) return -1;
+            if (slen > (uint64_t)(mend - md.i)) return -1;
+            const int64_t se = md.i + (int64_t)slen;
+            if (f3 == 1 && nm_s < 0) { nm_s = md.i; nm_e = se; }
+            if (f3 == 3 && ns_s < 0) { ns_s = md.i; ns_e = se; }
+            md.i = se;
+          } else {
+            md.skip(w3);
+            if (md.fail) return -1;
+          }
+        }
+        it.i = mend;
+      } else {
+        it.skip(w2);
+        if (it.fail) return -1;
+      }
+    }
+    if (nm_s >= 0 &&
+        !protoscan::clean_utf8(buf + nm_s, nm_e - nm_s))
+      return -1;
+    if (ns_s >= 0 &&
+        !protoscan::clean_utf8(buf + ns_s, ns_e - ns_s))
+      return -1;
+    item_spans[2 * count] = tag_start;
+    item_spans[2 * count + 1] = iend;
+    char* kb = key_buf + *key_len;
+    *kb++ = '0';
+    if (ns_s >= 0) {
+      memcpy(kb, buf + ns_s, (size_t)(ns_e - ns_s));
+      kb += ns_e - ns_s;
+    }
+    *kb++ = '\x1f';
+    if (nm_s >= 0) {
+      memcpy(kb, buf + nm_s, (size_t)(nm_e - nm_s));
+      kb += nm_e - nm_s;
+    }
+    *kb++ = '\x1e';
+    *key_len = kb - key_buf;
+    ++count;
+    sc.i = iend;
+  }
+  return count;
+}

@@ -248,6 +248,116 @@ def test_lone_surrogate_names_ride_escaped_records():
     assert _filter_list_wire(bad, allowed) is None
 
 
+def test_proto_list_native_matches_python_walker():
+    """The native proto scanner must produce byte-identical output to
+    kubeproto.filter_list_raw across fuzzing: extra fields, duplicate
+    metadata, non-length-delimited fields sharing the field numbers."""
+    from spicedb_kubeapi_proxy_tpu.authz.filterer import filter_body_proto
+    from spicedb_kubeapi_proxy_tpu.proxy import kubeproto
+
+    def ld(fno, payload):
+        return (kubeproto._encode_varint((fno << 3) | 2)
+                + kubeproto._encode_varint(len(payload)) + payload)
+
+    def vint(fno, v):
+        return kubeproto._encode_varint(fno << 3) \
+            + kubeproto._encode_varint(v)
+
+    rng = random.Random(99)
+    for trial in range(150):
+        items = []
+        metas = []
+        for _ in range(rng.randrange(6)):
+            name = rng.choice([n for n in NAMES
+                               if "\x00" not in n]) \
+                if rng.random() < 0.9 else None
+            ns = rng.choice(["", "ns1", "uni-日本"]) \
+                if rng.random() < 0.7 else None
+            meta = b""
+            if rng.random() < 0.3:
+                meta += vint(2, rng.randrange(99))  # unrelated varint
+            if name is not None:
+                meta += ld(1, name.encode())
+            if ns:
+                meta += ld(3, ns.encode())
+            item = b""
+            if rng.random() < 0.3:
+                item += vint(1, 7)  # field 1 with WRONG wire type first
+            item += ld(1, meta)
+            if rng.random() < 0.4:
+                item += ld(1, ld(1, b"duplicate-meta-ignored"))
+            if rng.random() < 0.5:
+                item += ld(2, b"\x0a\x03xyz")  # spec-ish nested bytes
+            items.append(ld(2, item))
+            metas.append((ns or "", name or ""))
+        raw = ld(1, b"\x0a\x021")  # ListMeta-ish
+        raw += b"".join(items)
+        if rng.random() < 0.3:
+            raw += vint(9, 5)  # trailing unrelated field
+        body = kubeproto.encode_unknown("v1", "PodList", raw)
+        allowed = AllowedSet(set(
+            p for p in metas if rng.random() < 0.6))
+        py_raw = kubeproto.filter_list_raw(raw, allowed.allows)
+        py_body = kubeproto.replace_unknown_raw(body, py_raw)
+        status, native_body = filter_body_proto(body, allowed, INPUT)
+        assert status == 200
+        assert native_body == py_body or (
+            py_raw == raw and native_body == body), trial
+        # no-drop must be byte-identical to the ORIGINAL body
+        every = AllowedSet(set(metas) | {("", "")})
+        status, out = filter_body_proto(body, every, INPUT)
+        assert (status, out) == (200, body)
+
+    # control bytes / invalid utf-8 in a proto name: native bails, the
+    # Python walker (errors='replace') keeps authority
+    bad_raw = ld(2, ld(1, ld(1, b"\x01ctl")))
+    bad_body = kubeproto.encode_unknown("v1", "PodList", bad_raw)
+    from spicedb_kubeapi_proxy_tpu import native as _native
+
+    assert _native.proto_list_spans(bad_raw) is None
+    status, out = filter_body_proto(bad_body, AllowedSet(set()), INPUT)
+    py = kubeproto.replace_unknown_raw(
+        bad_body, kubeproto.filter_list_raw(
+            bad_raw, AllowedSet(set()).allows))
+    assert (status, out) == (200, py)
+    bad_utf8 = ld(2, ld(1, ld(1, b"\xff\xfe")))
+    assert _native.proto_list_spans(bad_utf8) is None
+
+
+def test_proto_scanner_adversarial_wire():
+    """Crafted wire data that would loop/overflow a naive scanner must
+    BAIL cleanly (review finding: huge length varints cancel the cursor
+    advance; >32-bit field numbers alias onto the items field)."""
+    from spicedb_kubeapi_proxy_tpu import native as _native
+    from spicedb_kubeapi_proxy_tpu.proxy import kubeproto
+
+    def ld(fno, payload):
+        return (kubeproto._encode_varint((fno << 3) | 2)
+                + kubeproto._encode_varint(len(payload)) + payload)
+
+    # length varint 2^64-11: i += (int64)len would step BACKWARD
+    huge = kubeproto._encode_varint(10)[:0]  # build by hand:
+    huge = bytes([0x0A]) + bytes([0xF5] + [0xFF] * 8 + [0x01])
+    assert _native.proto_list_spans(huge + b"xxxx") is None
+    # same huge length on the items field itself
+    evil_item = bytes([0x12]) + bytes([0xF5] + [0xFF] * 8 + [0x01])
+    assert _native.proto_list_spans(evil_item + b"xxxx") is None
+    # a >32-bit field number whose low bits alias to field 2: Python
+    # copies it through; the native scanner must NOT key it as an item
+    big_fno = ((1 << 32) + 2)
+    tag = kubeproto._encode_varint((big_fno << 3) | 2)
+    chunk = tag + kubeproto._encode_varint(4) + b"zzzz"
+    item = ld(2, ld(1, ld(1, b"keepme")))
+    raw = chunk + item
+    scan = _native.proto_list_spans(raw)
+    assert scan is not None
+    item_spans, keys = scan
+    assert len(item_spans) == 1  # only the REAL item keyed
+    assert keys == b"0\x1fkeepme\x1e"
+    # truncated payload lengths at every nesting level bail
+    assert _native.proto_list_spans(ld(2, ld(1, b"\x0a\x7fshort"))) is None
+
+
 def test_kind_and_whitespace_variants():
     body = (b'  {  "apiVersion" : "v1" ,\n "items" : [ '
             b'{ "metadata" : { "name" : "w" } } ] , "kind" : "PodList" }  ')

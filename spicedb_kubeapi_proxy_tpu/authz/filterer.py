@@ -153,14 +153,16 @@ def filter_body(body: bytes, allowed: AllowedSet,
 
 
 def _filter_proto_list_native(body: bytes, raw: bytes,
-                              allowed: AllowedSet):
-    """Native proto list filtering (graphcore.cpp proto_list_spans):
-    same record-set comparison as the JSON wire path, ~30x faster than
-    the pure-Python varint walker at 100k items. Returns (status,
-    new_body) or None to fall back (scanner bailed)."""
+                              allowed: AllowedSet, table: bool = False):
+    """Native proto list/Table filtering (graphcore.cpp
+    proto_list_spans / proto_table_spans): same record-set comparison
+    as the JSON wire path, ~12x faster than the pure-Python varint
+    walker at 100k items. Returns (status, new_body) or None to fall
+    back (scanner bailed)."""
     from .. import native
 
-    scan = native.proto_list_spans(raw)
+    scan = native.proto_table_spans(raw) if table \
+        else native.proto_list_spans(raw)
     if scan is None:
         return None
     item_spans, keys = scan
@@ -199,6 +201,10 @@ def filter_body_proto(body: bytes, allowed: AllowedSet,
             # an un-keyable row (includeObject=None) raises ProtoError ->
             # a clean 401, never a 500 (reference decodes the full Table,
             # responsefilterer.go:349-374)
+            wire = _filter_proto_list_native(body, raw, allowed,
+                                             table=True)
+            if wire is not None:
+                return wire
             new_raw = kubeproto.filter_table_raw(raw, allowed.allows)
             return 200, kubeproto.replace_unknown_raw(body, new_raw)
         if kind.endswith("List"):

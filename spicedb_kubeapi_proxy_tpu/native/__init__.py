@@ -95,7 +95,7 @@ def _load() -> Optional[ctypes.CDLL]:
 # exported-signature change; _bind refuses a mismatching cached .so (the
 # rebuild path then fires) — binding by symbol NAME alone would let a
 # stale library misread argument slots silently
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -138,6 +138,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
+    lib.proto_table_spans.restype = ctypes.c_int64
+    lib.proto_table_spans.argtypes = lib.proto_list_spans.argtypes
     return lib
 
 
@@ -235,6 +237,20 @@ def proto_list_spans(raw: bytes):
     kubeproto._field) — or None when the native path does not apply or
     the scanner bailed (truncated wire data, control bytes or invalid
     utf-8 in a name: the Python walker keeps authority)."""
+    return _proto_spans(raw, "proto_list_spans")
+
+
+def proto_table_spans(raw: bytes):
+    """Like :func:`proto_list_spans` but for a meta.k8s.io Table MESSAGE:
+    spans of repeated ``rows`` (field 3), keys from each row's
+    ``object`` RawExtension (nested magic-prefixed Unknown or bare
+    PartialObjectMetadata — kubeproto.table_row_meta semantics). Bails
+    when any row has no keyable object or an empty name (the Python
+    walker raises ProtoError there and keeps authority)."""
+    return _proto_spans(raw, "proto_table_spans")
+
+
+def _proto_spans(raw: bytes, fn_name: str):
     lib = _load()
     if lib is None or not isinstance(raw, bytes) or not raw:
         return None
@@ -243,12 +259,13 @@ def proto_list_spans(raw: bytes):
     # would otherwise force a huge upfront allocation
     max_items = len(raw) // 64 + 1024
     p64 = ctypes.POINTER(ctypes.c_int64)
+    fn = getattr(lib, fn_name)
     while True:
         item_spans = np.empty(2 * max_items, dtype=np.int64)
         key_buf = ctypes.create_string_buffer(
             len(raw) + 3 * max_items + 16)
         key_len = ctypes.c_int64(0)
-        count = lib.proto_list_spans(
+        count = fn(
             raw, len(raw), item_spans.ctypes.data_as(p64), key_buf,
             ctypes.byref(key_len), max_items)
         if count == -2 and max_items < len(raw) // 2 + 2:

@@ -588,7 +588,7 @@ extern "C" int64_t json_list_spans(
 // Bumped on ANY exported-signature change: the loader refuses a library
 // whose ABI differs (a stale cached .so with preserved mtimes would
 // otherwise bind by name and silently misread arguments).
-extern "C" int64_t graphcore_abi_version() { return 3; }
+extern "C" int64_t graphcore_abi_version() { return 4; }
 
 // ---------------------------------------------------------------------------
 // Protobuf list scanner (authz/filterer.py filter_body_proto): one pass
@@ -670,6 +670,35 @@ static bool clean_utf8(const unsigned char* p, int64_t m) {
   return true;
 }
 
+// Find the length-delimited field `fno` within [start, end): first or
+// last occurrence (kubeproto._field vs decode_unknown semantics).
+// Returns false on malformed wire (caller bails); absent field leaves
+// *s == -1 and returns true.
+static bool find_ld_field(const unsigned char* buf, int64_t start,
+                          int64_t end, uint64_t fno, bool last_wins,
+                          int64_t* s, int64_t* e) {
+  PScan p{buf, end, start};
+  *s = *e = -1;
+  while (p.i < end) {
+    const uint64_t tag = p.varint();
+    if (p.fail) return false;
+    const uint64_t f = tag >> 3;
+    const int wt = (int)(tag & 7);
+    if (f == fno && wt == 2 && (last_wins || *s < 0)) {
+      const uint64_t len = p.varint();
+      if (p.fail) return false;
+      if (len > (uint64_t)(end - p.i)) return false;
+      *s = p.i;
+      *e = p.i + (int64_t)len;
+      p.i = *e;
+    } else {
+      p.skip(wt);
+      if (p.fail) return false;
+    }
+  }
+  return true;
+}
+
 }  // namespace protoscan
 
 extern "C" int64_t proto_list_spans(
@@ -700,46 +729,20 @@ extern "C" int64_t proto_list_spans(
     if (ilen > (uint64_t)(n - sc.i)) return -1;
     const int64_t istart = sc.i, iend = sc.i + (int64_t)ilen;
     if (count >= max_items) return -2;  // caller grows and retries
-    // first metadata (field 1, wt 2) inside the item; within it the
-    // first name (1) / namespace (3) — kubeproto._field semantics
+    // first metadata (field 1) inside the item; within it the first
+    // name (1) / namespace (3) — kubeproto._field semantics
+    int64_t meta_s, meta_e;
     int64_t nm_s = -1, nm_e = -1, ns_s = -1, ns_e = -1;
-    PScan it{buf, iend, istart};
-    bool meta_seen = false;
-    while (it.i < iend) {
-      const uint64_t t2 = it.varint();
-      if (it.fail) return -1;
-      const uint64_t f2 = t2 >> 3;
-      const int w2 = (int)(t2 & 7);
-      if (f2 == 1 && w2 == 2 && !meta_seen) {
-        meta_seen = true;
-        const uint64_t mlen = it.varint();
-        if (it.fail) return -1;
-        if (mlen > (uint64_t)(iend - it.i)) return -1;
-        const int64_t mend = it.i + (int64_t)mlen;
-        PScan md{buf, mend, it.i};
-        while (md.i < mend) {
-          const uint64_t t3 = md.varint();
-          if (md.fail) return -1;
-          const uint64_t f3 = t3 >> 3;
-          const int w3 = (int)(t3 & 7);
-          if (w3 == 2 && (f3 == 1 || f3 == 3)) {
-            const uint64_t slen = md.varint();
-            if (md.fail) return -1;
-            if (slen > (uint64_t)(mend - md.i)) return -1;
-            const int64_t se = md.i + (int64_t)slen;
-            if (f3 == 1 && nm_s < 0) { nm_s = md.i; nm_e = se; }
-            if (f3 == 3 && ns_s < 0) { ns_s = md.i; ns_e = se; }
-            md.i = se;
-          } else {
-            md.skip(w3);
-            if (md.fail) return -1;
-          }
-        }
-        it.i = mend;
-      } else {
-        it.skip(w2);
-        if (it.fail) return -1;
-      }
+    if (!protoscan::find_ld_field(buf, istart, iend, 1, false,
+                                  &meta_s, &meta_e))
+      return -1;
+    if (meta_s >= 0) {
+      if (!protoscan::find_ld_field(buf, meta_s, meta_e, 1, false,
+                                    &nm_s, &nm_e))
+        return -1;
+      if (!protoscan::find_ld_field(buf, meta_s, meta_e, 3, false,
+                                    &ns_s, &ns_e))
+        return -1;
     }
     if (nm_s >= 0 &&
         !protoscan::clean_utf8(buf + nm_s, nm_e - nm_s))
@@ -764,6 +767,98 @@ extern "C" int64_t proto_list_spans(
     *key_len = kb - key_buf;
     ++count;
     sc.i = iend;
+  }
+  return count;
+}
+
+// Protobuf Table scanner: rows = repeated field 3 of meta.k8s.io Table;
+// each row's keyable object rides row.object (RawExtension, field 3)
+// whose raw bytes (field 1, FIRST occurrence like kubeproto._field) are
+// either a magic-prefixed runtime.Unknown (raw = field 2, LAST
+// occurrence like kubeproto.decode_unknown) or a bare
+// PartialObjectMetadata. Emits the same spans + key records as
+// proto_list_spans. Bails (-1) on any row without a keyable object or
+// with an empty name — the Python walker raises ProtoError there
+// (clean 401) and keeps authority.
+extern "C" int64_t proto_table_spans(
+    const char* buf_, int64_t n,
+    int64_t* item_spans, char* key_buf, int64_t* key_len,
+    int64_t max_items) {
+  using protoscan::PScan;
+  const unsigned char* buf = (const unsigned char*)buf_;
+  PScan sc{buf, n};
+  *key_len = 0;
+  int64_t count = 0;
+  while (sc.i < n) {
+    const int64_t tag_start = sc.i;
+    const uint64_t tag = sc.varint();
+    if (sc.fail) return -1;
+    const uint64_t fno = tag >> 3;
+    const int wt = (int)(tag & 7);
+    if (fno != 3 || wt != 2) {  // Table: repeated rows = field 3
+      sc.skip(wt);
+      if (sc.fail) return -1;
+      continue;
+    }
+    const uint64_t rlen = sc.varint();
+    if (sc.fail) return -1;
+    if (rlen > (uint64_t)(n - sc.i)) return -1;
+    const int64_t rstart = sc.i, rend = sc.i + (int64_t)rlen;
+    if (count >= max_items) return -2;
+    // row.object -> RawExtension.raw -> (magic Unknown?) -> metadata
+    // -> name/namespace, all via the shared bounded field finder
+    int64_t ext_s, ext_e;
+    if (!protoscan::find_ld_field(buf, rstart, rend, 3, false,
+                                  &ext_s, &ext_e))
+      return -1;
+    if (ext_s < 0) return -1;  // no object: Python raises (401)
+    int64_t raw_s, raw_e;
+    if (!protoscan::find_ld_field(buf, ext_s, ext_e, 1, false,
+                                  &raw_s, &raw_e))
+      return -1;
+    if (raw_s < 0) return -1;  // no raw bytes: Python raises
+    // magic-prefixed Unknown? take its raw (field 2, LAST occurrence —
+    // decode_unknown's loop overwrites)
+    int64_t obj_s = raw_s, obj_e = raw_e;
+    if (raw_e - raw_s >= 4 && memcmp(buf + raw_s, "k8s\x00", 4) == 0) {
+      if (!protoscan::find_ld_field(buf, raw_s + 4, raw_e, 2, true,
+                                    &obj_s, &obj_e))
+        return -1;
+      if (obj_s < 0) obj_s = obj_e = raw_s;  // no raw: empty object
+    }
+    int64_t meta_s, meta_e;
+    int64_t nm_s = -1, nm_e = -1, ns_s = -1, ns_e = -1;
+    if (!protoscan::find_ld_field(buf, obj_s, obj_e, 1, false,
+                                  &meta_s, &meta_e))
+      return -1;
+    if (meta_s >= 0) {
+      if (!protoscan::find_ld_field(buf, meta_s, meta_e, 1, false,
+                                    &nm_s, &nm_e))
+        return -1;
+      if (!protoscan::find_ld_field(buf, meta_s, meta_e, 3, false,
+                                    &ns_s, &ns_e))
+        return -1;
+    }
+    if (nm_s < 0 || nm_e == nm_s) return -1;  // empty name: Python raises
+    if (!protoscan::clean_utf8(buf + nm_s, nm_e - nm_s)) return -1;
+    if (ns_s >= 0 &&
+        !protoscan::clean_utf8(buf + ns_s, ns_e - ns_s))
+      return -1;
+    item_spans[2 * count] = tag_start;
+    item_spans[2 * count + 1] = rend;
+    char* kb = key_buf + *key_len;
+    *kb++ = '0';
+    if (ns_s >= 0) {
+      memcpy(kb, buf + ns_s, (size_t)(ns_e - ns_s));
+      kb += ns_e - ns_s;
+    }
+    *kb++ = '\x1f';
+    memcpy(kb, buf + nm_s, (size_t)(nm_e - nm_s));
+    kb += nm_e - nm_s;
+    *kb++ = '\x1e';
+    *key_len = kb - key_buf;
+    ++count;
+    sc.i = rend;
   }
   return count;
 }

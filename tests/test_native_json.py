@@ -324,6 +324,50 @@ def test_proto_list_native_matches_python_walker():
     assert _native.proto_list_spans(bad_utf8) is None
 
 
+def test_proto_table_native_matches_python_walker():
+    """proto_table_spans must agree with kubeproto.filter_table_raw on
+    fuzzing over both object encodings (nested magic Unknown and bare
+    PartialObjectMetadata), and bail wherever the walker raises."""
+    from spicedb_kubeapi_proxy_tpu.authz.filterer import filter_body_proto
+    from spicedb_kubeapi_proxy_tpu.proxy import kubeproto
+    from test_kubeproto import table, table_row, unknown as t_unknown
+
+    rng = random.Random(4242)
+    for trial in range(120):
+        rows = []
+        metas = []
+        for _ in range(rng.randrange(5)):
+            name = rng.choice(["a", "b-2", "uni-日本", "x/y"])
+            ns = rng.choice(["", "ns1", "ns2"])
+            rows.append(table_row(name, ns,
+                                  wrap_unknown=rng.random() < 0.5))
+            metas.append((ns, name))
+        raw = table(rows)
+        body = t_unknown("Table", raw, api_version="meta.k8s.io/v1")
+        allowed = AllowedSet(set(
+            p for p in metas if rng.random() < 0.6))
+        py_raw = kubeproto.filter_table_raw(raw, allowed.allows)
+        py_body = kubeproto.replace_unknown_raw(body, py_raw)
+        status, out = filter_body_proto(body, allowed, INPUT)
+        assert status == 200
+        assert out == py_body or (py_raw == raw and out == body), trial
+        # no-drop: byte-identical to the ORIGINAL body
+        status, out = filter_body_proto(
+            body, AllowedSet(set(metas)), INPUT)
+        assert (status, out) == (200, body)
+    # a row without a keyable object: scanner bails; the walker raises
+    # ProtoError -> FilterError (clean 401 upstream)
+    from spicedb_kubeapi_proxy_tpu import native as _native
+    from spicedb_kubeapi_proxy_tpu.authz.filterer import FilterError
+
+    bare = table([b"\x0a\x03abc"])  # row with cells only, no object
+    assert _native.proto_table_spans(bare) is None
+    with pytest.raises(FilterError):
+        filter_body_proto(
+            t_unknown("Table", bare, api_version="meta.k8s.io/v1"),
+            AllowedSet(set()), INPUT)
+
+
 def test_proto_scanner_adversarial_wire():
     """Crafted wire data that would loop/overflow a naive scanner must
     BAIL cleanly (review finding: huge length varints cancel the cursor

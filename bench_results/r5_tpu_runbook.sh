@@ -21,7 +21,7 @@ import subprocess, sys
 p = subprocess.run([sys.executable, "-c", "import jax; print(jax.devices())"],
                    capture_output=True, text=True, timeout=130)
 sys.stdout.write(p.stdout)
-sys.exit(0 if "Tpu" in p.stdout or "axon" in p.stdout.lower() else 1)
+sys.exit(0 if "tpu" in p.stdout.lower() or "axon" in p.stdout.lower() else 1)
 EOF
 if [ $? -ne 0 ]; then
     echo "tunnel still down; not burning the window budget"; exit 1

@@ -506,7 +506,7 @@ class Engine:
         # on remotely-attached chips)
         fut = self._backend(cg).query_async(
             seeds, q_slots, q_batch, now=now,
-            q_cache_key=("lookup", off, n))
+            q_cache_key=("lookup", off, n), q_contiguous=True)
         metrics.counter("engine_lookups_total").inc()
 
         def fin(out):

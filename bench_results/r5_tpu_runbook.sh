@@ -27,13 +27,27 @@ if [ $? -ne 0 ]; then
     echo "tunnel still down; not burning the window budget"; exit 1
 fi
 
-echo "== full suite + profile + remote-compare (one engine build) =="
+# Stage 1: headline only (~6 min of tunnel time). Windows have closed
+# mid-run before (window #1 hung at ~11 min, turning the suite run into a
+# watchdog-partial) — bank a COMPLETE headline JSON before anything else.
+echo "== stage 1: headline only =="
+python bench.py --deadline 900 \
+    > bench_results/r5_tpu_headline.json 2> bench_results/r5_tpu_headline_stderr.log
+echo "stage 1 rc=$?"
+cat bench_results/r5_tpu_headline.json
+echo
+
+# Stage 2: the full suite + profile + remote-compare (rebuilds the graph,
+# ~3 min overhead; worth it for stage isolation). Window-#1 artifacts
+# (r5_tpu_full.json / r5_tpu_profile/) are committed history — write
+# window-#2 outputs to their own names.
+echo "== stage 2: full suite + profile + remote-compare =="
 python bench.py --suite --remote-compare \
-    --profile-dir bench_results/r5_tpu_profile \
-    > bench_results/r5_tpu_full.json 2> bench_results/r5_tpu_stderr.log
+    --profile-dir bench_results/r5_tpu_profile2 \
+    > bench_results/r5_tpu_full2.json 2> bench_results/r5_tpu_stderr2.log
 rc=$?
 echo "bench rc=$rc"
-tail -40 bench_results/r5_tpu_stderr.log
-cat bench_results/r5_tpu_full.json
+tail -40 bench_results/r5_tpu_stderr2.log
+cat bench_results/r5_tpu_full2.json
 echo
 echo "== done; commit the artifacts =="

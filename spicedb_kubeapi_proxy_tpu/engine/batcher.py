@@ -169,10 +169,18 @@ class LookupBatcher:
                 key = ("lookup",) + composition[0]
             else:
                 key = ("lookup_batch", tuple(composition))
+            # homogeneous batches (R concurrent lists of the SAME type +
+            # permission — the common fleet shape) read R rows x one
+            # shared window: promise the grid so the extraction is a
+            # streamed dynamic_slice instead of an R x n random gather
+            grid = None
+            if len(set(composition)) == 1:
+                off0, n0 = composition[0]
+                grid = (off0, n0, len(composition))
             qfut = e._backend(cg).query_async(
                 np.asarray(seeds, dtype=np.int32),
                 np.concatenate(q_parts), np.concatenate(qb_parts),
-                q_cache_key=key)
+                q_cache_key=key, q_contig_grid=grid)
         else:
             qfut = None
         observed = threading.Event()

@@ -112,7 +112,8 @@ def _jit_run_for(cg: "CompiledGraph"):
         run = _JIT_CACHE.get(sig)
         if run is None:
             run = jax.jit(partial(_run, cg.run_meta()),
-                          static_argnames=("max_iters", "q_contig_len"))
+                          static_argnames=("max_iters", "q_contig_len",
+                                           "q_contig_rows"))
             if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
                 _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
             _JIT_CACHE[sig] = run
@@ -648,6 +649,8 @@ class CompiledGraph:
         max_iters: int = DEFAULT_MAX_ITERS,
         q_cache_key: Optional[tuple] = None,
         q_contiguous: Optional[bool] = None,
+        q_contig_grid: Optional[tuple] = None,  # (lo, L, R): R rows x
+        # one shared [lo, lo+L) window (the fused-batch shape)
     ) -> "QueryFuture":
         """Dispatch the fixpoint without blocking.
 
@@ -674,29 +677,39 @@ class CompiledGraph:
         # Contiguous-window queries (the list-filter shape: one type's full
         # permission range) take a dynamic_slice extraction instead of the
         # latency-bound random gather, and ship two scalars instead of a
-        # padded ~0.5MB index upload. ``q_contiguous=True`` is a caller
-        # promise (the engine builds ``off + arange(n)`` itself); None
-        # auto-detects. The window length is only 8-aligned (callers repeat
-        # the same few (off, n) windows, so jit re-specialization stays
-        # bounded without power-of-two bucketing) and must stay inside the
-        # state tensor or dynamic_slice would clamp-and-shift — oversized
-        # tails fall back to the gather.
+        # padded ~0.5MB index upload. Two forms:
+        #   rows=1: one window (``q_contiguous=True`` is a caller promise —
+        #           the engine builds ``off + arange(n)`` itself; None
+        #           auto-detects);
+        #   rows=R: the fused-batch grid (``q_contig_grid=(lo, L, R)``
+        #           promise from engine/batcher.py) — R rows reading the
+        #           SAME window, q order = row-major concatenation.
+        # Slice lengths are exact (static, but unconstrained), so the
+        # window always lies inside the state tensor (no clamp) and the
+        # flat output needs no padding re-map; jit re-specialization is
+        # bounded because callers repeat the same few (off, n) windows.
         Mp_state = (self.M // LANE + 1) * LANE
-        Q_pad8 = (Q + 7) & ~7
         contig = q_contiguous
-        if contig is None and Q:
+        if contig is None and q_contig_grid is None and Q:
             contig = (int(q_slots[-1]) - int(q_slots[0]) == Q - 1
                       and not np.any(q_batch != q_batch[0])
                       and np.array_equal(
                           q_slots,
                           q_slots[0] + np.arange(Q, dtype=np.int64)))
         run_kwargs = {}
-        if contig and Q and int(q_slots[0]) + Q_pad8 <= Mp_state:
+        qs_dev = qb_dev = None
+        if q_contig_grid is not None:
+            lo, L, R = q_contig_grid
+            if (Q == L * R and 0 < L and 0 < R <= B_pad
+                    and lo + L <= Mp_state):
+                qs_dev = np.int32(lo)
+                qb_dev = np.int32(0)
+                run_kwargs["q_contig_len"] = L
+                run_kwargs["q_contig_rows"] = R
+        elif contig and Q and int(q_slots[0]) + Q <= Mp_state:
             qs_dev = np.int32(q_slots[0])
             qb_dev = np.int32(q_batch[0])
-            run_kwargs["q_contig_len"] = Q_pad8
-        else:
-            qs_dev = qb_dev = None
+            run_kwargs["q_contig_len"] = Q
         if qs_dev is None:
             cached = d.get(("q", q_cache_key)) if q_cache_key else None
             if cached is not None:
@@ -936,7 +949,7 @@ def _seed_base(cg: CompiledGraph, seeds):
 
 def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel,
          dsrc, ddst, dexp, seeds, q_slots, q_batch, now_rel, *,
-         max_iters: int, q_contig_len: int = 0):
+         max_iters: int, q_contig_len: int = 0, q_contig_rows: int = 1):
     """The jitted stratified fixpoint. V layout: [B, rows, LANE] uint8 —
     the slot space rides the lane axis so a B=1 query streams exactly M
     bytes per elementwise pass instead of a lane-padded 128x that; slot s
@@ -1000,14 +1013,18 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel,
     # surface it so the host can raise instead of silently denying
     if q_contig_len:
         # contiguous query window (q_slots/q_batch are scalars: start slot
-        # and batch row): a dynamic_slice streams the window at HBM rate,
+        # and start row): a dynamic_slice streams the window at HBM rate,
         # where the general fancy-index gather below is latency-bound
         # random access — on a v5e chip that gather was 31% of the whole
         # query's device time for the list-filter shape (which always
-        # reads one type's full, contiguous permission range)
+        # reads one type's full, contiguous permission range).
+        # q_contig_rows > 1 is the fused-batch grid (engine/batcher.py:
+        # R same-window rows); [R, L] row-major flatten is exactly the
+        # concatenated per-row query order, so no re-mapping is needed.
         out = jax.lax.dynamic_slice(
-            V.reshape(B, Mp), (q_batch, q_slots), (1, q_contig_len)
-        ).reshape(q_contig_len).astype(jnp.bool_)
+            V.reshape(B, Mp), (q_batch, q_slots),
+            (q_contig_rows, q_contig_len)
+        ).reshape(q_contig_rows * q_contig_len).astype(jnp.bool_)
     else:
         out = V.reshape(B, Mp)[q_batch, q_slots].astype(jnp.bool_)
     return out, jnp.logical_not(still_changing), iters

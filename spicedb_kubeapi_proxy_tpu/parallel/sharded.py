@@ -520,8 +520,9 @@ class ShardedGraph:
         now: Optional[float] = None,
         q_cache_key: Optional[tuple] = None,
         q_contiguous: Optional[bool] = None,  # accepted for surface parity;
-        # the sharded extraction re-maps a [B, Qmax] grid, so the
-        # single-chip dynamic_slice fast path does not apply here
+        q_contig_grid: Optional[tuple] = None,  # the sharded extraction
+        # re-maps a [B, Qmax] grid, so the single-chip dynamic_slice fast
+        # path does not apply here
     ) -> ShardedQueryFuture:
         """Engine-compatible flat form (CompiledGraph.query_async surface):
         the flat (q_slots, q_batch) queries are packed into a [B, Qmax]

@@ -1042,15 +1042,40 @@ def test_contiguous_query_window_matches_gather():
     want = cg.query_async(seeds, qs, qb, q_contiguous=False).result()
     assert np.array_equal(got, want)
 
-    # a window ending at the very top of the slot space: the padded bucket
-    # reads into the trash row (or, when it would clamp past the state
-    # tensor, declines to the gather) — either way results must match
+    # a window ending at the very top of the slot space must still match
+    # (exact slice lengths: no clamp is possible, but keep the guard honest)
     lo = max(0, cg.M - 5)
     qs = lo + np.arange(5, dtype=np.int32)
     qb = np.zeros(5, dtype=np.int32)
     tail_fast = cg.query_async(seeds, qs, qb, q_contiguous=True).result()
     tail_gen = cg.query_async(seeds, qs, qb, q_contiguous=False).result()
     assert np.array_equal(tail_fast, tail_gen)
+
+    # the fused-batch grid form (batcher shape): R rows x one shared window
+    subjects = ["alice", "bob", "carol"]
+    rels2 = ["namespace:ns0#viewer@user:bob", "namespace:ns7#viewer@user:bob"]
+    e.write_relationships(touch(*rels2))
+    cg = e.compiled()
+    objs = e._objects_by_name()
+    off = cg.offset_of("namespace", "view")
+    n = cg.type_sizes["namespace"]
+    seeds3 = np.asarray(
+        [cg.encode_subject("user", s, None, objs) for s in subjects],
+        dtype=np.int32)
+    qs = np.tile(off + np.arange(n, dtype=np.int32), 3)
+    qb = np.repeat(np.arange(3, dtype=np.int32), n)
+    grid = cg.query_async(seeds3, qs, qb,
+                          q_contig_grid=(off, n, 3)).result()
+    gen = cg.query_async(seeds3, qs, qb).result()
+    assert np.array_equal(grid, gen)
+    assert grid[:n].any() and grid[n:2 * n].any(), "alice+bob see something"
+    assert not grid[2 * n:].any(), "carol has no grants"
+
+    # malformed grid promises (wrong total, zero rows) must fall back, not
+    # mis-slice
+    bad = cg.query_async(seeds3, qs, qb,
+                         q_contig_grid=(off, n, 2)).result()
+    assert np.array_equal(bad, gen)
 
 
 def test_nonconvergence_raises_not_denies():

@@ -599,6 +599,39 @@ def _measure(args, result: dict) -> None:
     iters = qf.iterations()
     result["fixpoint_iters"] = iters
 
+    # -- fused-concurrency amortization on the HEADLINE shape --
+    # The 50ms target describes a serving fleet, not a lone caller: with
+    # cross-request batching on (proxy --lookup-batch-window), concurrent
+    # same-type list prefilters fuse up to 8 subjects per fixpoint whose
+    # grid extraction is one dynamic_slice. Measured here on the same 10M
+    # graph so the driver-captured JSON carries the deployment number.
+    try:
+        conc_n = 16 if quick else 32
+        e.enable_lookup_batching()
+        conc_subs = [subjects[i % len(subjects)] for i in range(conc_n)]
+
+        def run_conc_headline() -> float:
+            t0 = time.perf_counter()
+            futs = [e.lookup_resources_mask_async("pod", "view", "user", u)
+                    for u in conc_subs]
+            for f in futs:
+                f.result()
+            return (time.perf_counter() - t0) * 1e3
+
+        run_conc_headline()  # warm the fused-grid (B=8) trace
+        conc_ms = sorted(run_conc_headline() for _ in range(3))[1]
+        amort = conc_ms / conc_n
+        log(f"fused concurrency: {conc_n} concurrent pod-list queries "
+            f"(batch window 2ms) in {conc_ms:.1f}ms = {amort:.2f}ms/query "
+            f"amortized")
+        result["concurrent_queries"] = conc_n
+        result["concurrent_amortized_ms_per_query"] = round(amort, 3)
+        result["vs_baseline_concurrent"] = round(BASELINE_TARGET_MS / amort, 2)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        log(f"fused-concurrency section failed (non-fatal): {ex}")
+    finally:
+        e.disable_lookup_batching()
+
     try:
         chain_est, p50_w1, p50_wk, k = _chained_device_estimate(
             e, subjects, trials=max(args.trials // 2, 5))

@@ -146,6 +146,11 @@ class Engine:
 
         self._batcher = LookupBatcher(self, window=window, max_rows=max_rows)
 
+    def disable_lookup_batching(self) -> None:
+        """Revert to one device dispatch per lookup (in-flight batched
+        futures resolve normally; only new submissions are affected)."""
+        self._batcher = None
+
     # -- write path ---------------------------------------------------------
 
     def _validate(self, rel: Relationship) -> None:

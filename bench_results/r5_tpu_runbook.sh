@@ -31,7 +31,7 @@ fi
 # mid-run before (window #1 hung at ~11 min, turning the suite run into a
 # watchdog-partial) — bank a COMPLETE headline JSON before anything else.
 echo "== stage 1: headline only =="
-python bench.py --deadline 900 \
+python bench.py --deadline 1150 \
     > bench_results/r5_tpu_headline.json 2> bench_results/r5_tpu_headline_stderr.log
 echo "stage 1 rc=$?"
 cat bench_results/r5_tpu_headline.json

@@ -293,6 +293,58 @@ def test_full_http_round_trips(env):
     asyncio.run(go())
 
 
+def test_concurrent_lists_fuse_through_batch_window(env):
+    """--lookup-batch-window wiring end-to-end: concurrent same-type list
+    prefilters from different users fuse into shared device dispatches
+    (the grid fast path), and per-user isolation survives the fusion."""
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+            lookup_batch_window=0.02,
+        ).complete()
+        await cfg.run()
+        users = [f"user{i}" for i in range(6)]
+        clients = {u: HttpClient(cfg.server.port, u) for u in users}
+        for u in users:
+            status, _, body = await clients[u].request(
+                "POST", "/api/v1/namespaces",
+                body={"apiVersion": "v1", "kind": "Namespace",
+                      "metadata": {"name": f"ns-{u}"}})
+            assert status == 201, body
+
+        batches0 = metrics.counter("engine_lookup_batches_total").value
+        lookups0 = metrics.counter("engine_lookups_total").value
+
+        async def list_ns(u):
+            status, _, body = await clients[u].request(
+                "GET", "/api/v1/namespaces")
+            assert status == 200
+            return [o["metadata"]["name"]
+                    for o in json.loads(body)["items"]]
+
+        results = await asyncio.gather(*(list_ns(u) for u in users))
+        for u, names in zip(users, results):
+            assert names == [f"ns-{u}"], (u, names)
+
+        fused = metrics.counter("engine_lookup_batches_total").value - batches0
+        issued = metrics.counter("engine_lookups_total").value - lookups0
+        assert issued >= len(users)
+        # fusion must have coalesced: strictly fewer dispatches than lookups
+        assert 0 < fused < issued, (fused, issued)
+
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())
+
+
 def test_inmemory_client(env):
     async def go():
         fake = FakeKube()

@@ -180,6 +180,10 @@ class ShardedQueryFuture:
         out = np.asarray(self._out)
         if self._sel is None:
             return out
+        if isinstance(self._sel[0], str):  # ("contig_grid", L, R)
+            # homogeneous fused batch: flat order IS the row-major grid
+            _, L, R = self._sel
+            return out[:R, :L].reshape(-1)
         rows, cols = self._sel
         return out[rows, cols]
 
@@ -519,26 +523,42 @@ class ShardedGraph:
         q_batch: np.ndarray,  # int32 [Q] batch row per query
         now: Optional[float] = None,
         q_cache_key: Optional[tuple] = None,
-        q_contiguous: Optional[bool] = None,  # accepted for surface parity;
-        q_contig_grid: Optional[tuple] = None,  # the sharded extraction
-        # re-maps a [B, Qmax] grid, so the single-chip dynamic_slice fast
-        # path does not apply here
+        q_contiguous: Optional[bool] = None,  # accepted for surface parity
+        q_contig_grid: Optional[tuple] = None,  # (lo, L, R) promise: R rows
+        # x one shared [lo, lo+L) window — skips the rank re-map entirely
     ) -> ShardedQueryFuture:
         """Engine-compatible flat form (CompiledGraph.query_async surface):
         the flat (q_slots, q_batch) queries are packed into a [B, Qmax]
         grid (rank within row computed vectorized), dispatched, and the
         future re-maps the grid output back to flat [Q] order. The
         iteration budget is the construction-time ``max_iters`` (baked
-        into the jitted shard_map)."""
+        into the jitted shard_map). Homogeneous fused batches
+        (``q_contig_grid``, engine/batcher.py) bypass the O(Q log Q)
+        rank computation and the O(Q) fancy-index result re-map: their
+        grid rows are the window itself and the row-major grid slice IS
+        the flat order."""
         cg = self.cg
         B = seed_slots.shape[0]
         q_slots = np.asarray(q_slots, dtype=np.int32)
         q_batch = np.asarray(q_batch, dtype=np.int32)
         Q = len(q_slots)
-        # rank of each query within its batch row (stable)
-        order = np.argsort(q_batch, kind="stable")
-        sorted_qb = q_batch[order]
-        if Q:
+        if (q_contig_grid is None and q_contiguous and Q and B == 1
+                and not q_batch[0]):
+            # the engine's single-window promise is the R=1 grid
+            q_contig_grid = (int(q_slots[0]), Q, 1)
+        contig = None
+        if q_contig_grid is not None:
+            lo, L, R = q_contig_grid
+            if Q == L * R and 0 < L and 0 < R <= B and lo + L <= cg.M:
+                contig = (lo, L, R)
+        if contig is not None:
+            lo, L, R = contig
+            cols = None
+            Qmax = L
+        elif Q:
+            # rank of each query within its batch row (stable)
+            order = np.argsort(q_batch, kind="stable")
+            sorted_qb = q_batch[order]
             starts = np.flatnonzero(
                 np.r_[True, np.diff(sorted_qb) != 0])
             run_len = np.diff(np.r_[starts, Q])
@@ -558,7 +578,10 @@ class ShardedGraph:
             if q_cache_key else None
         if grid is None:
             grid_np = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
-            grid_np[q_batch, cols] = q_slots
+            if contig is not None:
+                grid_np[:R, :L] = lo + np.arange(L, dtype=np.int32)
+            else:
+                grid_np[q_batch, cols] = q_slots
             # a GLOBAL device array (not a process-local jnp.asarray):
             # identical on every process, sharded over the data axis —
             # valid on single-process and multi-host meshes alike
@@ -570,5 +593,7 @@ class ShardedGraph:
                     self._qgrid.pop(next(iter(self._qgrid)), None)
                 self._qgrid[(q_cache_key, B_pad)] = grid
         out, converged, iters = self._dispatch(seeds, grid, now)
-        return ShardedQueryFuture(out, converged, iters, (q_batch, cols),
+        sel = (("contig_grid", L, R) if contig is not None
+               else (q_batch, cols))
+        return ShardedQueryFuture(out, converged, iters, sel,
                                   max_iters=self.max_iters)

@@ -103,6 +103,33 @@ def test_sharded_matches_unsharded(data, graph):
         assert got_ids == want, f"subject {u}: {got_ids} != {want}"
 
 
+def test_sharded_contig_grid_promise_matches_flat():
+    """The batcher's homogeneous-grid promise on the sharded backend must
+    agree with the general flat path (which argsort-re-maps), including a
+    malformed promise falling back rather than mis-slicing."""
+    e, users = build_engine()
+    cg = e.compiled()
+    objs = e._objects_by_name()
+    sg = ShardedGraph(cg, make_mesh(8, data=2, graph=4))
+    off = cg.offset_of("doc", "read")
+    n = cg.type_sizes["doc"]
+    subs = [("user", users[0]), ("user", users[3]), ("user", "nobody")]
+    seeds = np.asarray(
+        [cg.encode_subject(t, i, None, objs) for (t, i) in subs],
+        dtype=np.int32)
+    qs = np.tile(off + np.arange(n, dtype=np.int32), len(subs))
+    qb = np.repeat(np.arange(len(subs), dtype=np.int32), n)
+    flat = sg.query_async(seeds, qs, qb).result()
+    fast = sg.query_async(seeds, qs, qb,
+                          q_contig_grid=(off, n, len(subs))).result()
+    assert np.array_equal(flat, fast)
+    assert flat[:n].any() and not flat[2 * n:].any()
+    # wrong row count: promise declined, result still correct
+    bad = sg.query_async(seeds, qs, qb,
+                         q_contig_grid=(off, n, 2)).result()
+    assert np.array_equal(bad, flat)
+
+
 def test_sharded_check_grid_odd_shapes():
     e, users = build_engine(seed=11)
     cg = e.compiled()

@@ -168,7 +168,8 @@ class ShardedQueryFuture:
         self._out = out
         self._converged = converged
         self._iters = iters
-        self._sel = sel  # None (grid) | (rows, cols) flat re-mapping
+        self._sel = sel  # None (grid) | (rows, cols) flat re-map |
+        # ("contig_grid", L, R) row-major window slice
         self._max_iters = max_iters
 
     def result(self) -> np.ndarray:

@@ -849,7 +849,13 @@ def main() -> None:
         result.setdefault(
             "error", f"deadline {args.deadline:.0f}s exceeded; "
             "emitting partial result")
-        result["degraded"] = True
+        # a deadline partial is not a backend downgrade: numbers captured
+        # before the cutoff keep their provenance (window #1 measured the
+        # whole headline on a real chip, then the tunnel hung mid-suite —
+        # marking that run "degraded" would misfile real-chip data)
+        result["deadline_exceeded"] = True
+        if result.get("backend") != "tpu":
+            result["degraded"] = True
         log(f"WATCHDOG: deadline {args.deadline:.0f}s exceeded")
         emit(result, 2, os_exit=True)
 

@@ -660,3 +660,169 @@ def test_concurrency_soak_cross_feature(env):
         await cfg.workflow.shutdown()
         upstream_server.close()
     asyncio.run(go())
+
+
+def test_chaos_storm_transient_kube_failures(env):
+    """Chaos leg 1 — transient upstream faults under concurrent churn:
+    kube TRANSPORT failures (connection killed mid-request) injected
+    while three users create namespaces. The workflow retry loop
+    (<=5 attempts, backoff — reference workflow.go:211-222 retries only
+    transport errors) must absorb every burst shorter than the budget;
+    every create must be fully atomic per name (response == upstream ==
+    graph == list visibility), and no lock tuples survive (the crash
+    matrix run as a storm, reference proxy_test.go:106-111). A definitive
+    kube 500 RESPONSE, by contrast, is a rejection: rolled back without
+    retry (workflow.go:243-245) — asserted deterministically at the end."""
+    from spicedb_kubeapi_proxy_tpu.engine import RelationshipFilter
+
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+        ).complete()
+        await cfg.run()
+        users = [f"storm{i}" for i in range(3)]
+        clients = {u: HttpClient(cfg.server.port, u) for u in users}
+        status_by_name: dict[str, tuple] = {}
+
+        async def churn(u, idx):
+            c = clients[u]
+            for i in range(8):
+                if (i + idx) % 3 == 1:
+                    # burst of killed connections, below the 5-attempt
+                    # budget; concurrent writes share the fault queue, so
+                    # which op eats how many faults is nondeterministic
+                    # by design
+                    fake.fail_next(
+                        2, exception=ConnectionResetError("injected"))
+                name = f"st-{u}-{i}"
+                status, _, _ = await c.request(
+                    "POST", "/api/v1/namespaces",
+                    body={"apiVersion": "v1", "kind": "Namespace",
+                          "metadata": {"name": name}})
+                status_by_name[name] = (u, status)
+
+        await asyncio.gather(*(churn(u, i) for i, u in enumerate(users)))
+
+        deadline = asyncio.get_running_loop().time() + 25
+        while (cfg.engine.store.exists(RelationshipFilter(
+                resource_type="lock"))
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.25)
+        assert not cfg.engine.store.exists(
+            RelationshipFilter(resource_type="lock"))
+
+        lists = {}
+        for u in users:
+            status, _, body = await clients[u].request(
+                "GET", "/api/v1/namespaces")
+            assert status == 200
+            lists[u] = {o["metadata"]["name"]
+                        for o in json.loads(body)["items"]}
+
+        landed = 0
+        for name, (u, status) in status_by_name.items():
+            in_upstream = ("namespaces", "", name) in fake.objects
+            in_graph = cfg.engine.store.exists(RelationshipFilter(
+                resource_type="namespace", resource_id=name))
+            visible = name in lists[u]
+            if status == 201:
+                assert in_upstream and in_graph and visible, (
+                    name, status, in_upstream, in_graph, visible)
+                landed += 1
+            else:
+                assert not in_upstream and not in_graph and not visible, (
+                    name, status, in_upstream, in_graph, visible)
+        # bursts stay under the retry budget: everything must have landed
+        assert landed == len(status_by_name), (landed, len(status_by_name))
+
+        # a definitive 500 RESPONSE (nothing else in flight): rejection,
+        # rolled back without retry — reference workflow.go:243-245
+        fake.fail_next(1, status=500)
+        status, _, _ = await clients[users[0]].request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "st-rejected"}})
+        assert status == 500
+        assert ("namespaces", "", "st-rejected") not in fake.objects
+        assert not cfg.engine.store.exists(RelationshipFilter(
+            resource_type="namespace", resource_id="st-rejected"))
+        assert not cfg.engine.store.exists(
+            RelationshipFilter(resource_type="lock"))
+
+        fake.stop_watches()
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())
+
+
+def test_chaos_crash_mid_dual_write_recovers_on_resume(env):
+    """Chaos leg 2 — a failpoint 'process death' mid-dual-write at the
+    HTTP layer: the client sees the dual-write timeout, the instance
+    stays suspended with its lock held (exactly a crashed process), and
+    resume_pending() — what cfg.run() does at boot — replays the event
+    log, completes the kube write, and releases the lock: the create
+    eventually lands even though its HTTP response was an error
+    (at-least-once durable dual-write, reference workflow.go + the e2e
+    crash matrix, run through the full server)."""
+    from spicedb_kubeapi_proxy_tpu.authz import middleware
+    from spicedb_kubeapi_proxy_tpu.engine import RelationshipFilter
+    from spicedb_kubeapi_proxy_tpu.utils.failpoints import failpoints
+
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+        ).complete()
+        await cfg.run()
+        alice = HttpClient(cfg.server.port, "alice")
+
+        # don't sit out the full 30s dual-write wait for the staged crash
+        saved_timeout = middleware.WORKFLOW_RESULT_TIMEOUT
+        middleware.WORKFLOW_RESULT_TIMEOUT = 3.0
+        failpoints.enable("panicKubeWrite", budget=1)
+        status, _, body = await alice.request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "crashy"}})
+        middleware.WORKFLOW_RESULT_TIMEOUT = saved_timeout
+        # the workflow is suspended (simulated dead process): the client
+        # saw a timeout and the half-applied state is held under the lock
+        assert status >= 500, (status, body)
+        assert cfg.engine.store.exists(
+            RelationshipFilter(resource_type="lock"))
+        assert ("namespaces", "", "crashy") not in fake.objects
+        failpoints.disable_all()
+
+        # "restart": resume from the event log, as cfg.run() does at boot
+        resumed = await cfg.workflow.resume_pending()
+        assert resumed, "the suspended instance must be found"
+        deadline = asyncio.get_running_loop().time() + 20
+        while (cfg.engine.store.exists(RelationshipFilter(
+                resource_type="lock"))
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.25)
+        assert not cfg.engine.store.exists(
+            RelationshipFilter(resource_type="lock"))
+        assert ("namespaces", "", "crashy") in fake.objects
+        assert cfg.engine.store.exists(RelationshipFilter(
+            resource_type="namespace", resource_id="crashy"))
+        status, _, body = await alice.request("GET", "/api/v1/namespaces")
+        assert status == 200
+        assert "crashy" in {o["metadata"]["name"]
+                            for o in json.loads(body)["items"]}
+
+        fake.stop_watches()
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())

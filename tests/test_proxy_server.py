@@ -662,7 +662,8 @@ def test_concurrency_soak_cross_feature(env):
     asyncio.run(go())
 
 
-def test_chaos_storm_transient_kube_failures(env):
+@pytest.mark.parametrize("lock_mode", ["Pessimistic", "Optimistic"])
+def test_chaos_storm_transient_kube_failures(env, lock_mode):
     """Chaos leg 1 — transient upstream faults under concurrent churn:
     kube TRANSPORT failures (connection killed mid-request) injected
     while three users create namespaces. The workflow retry loop
@@ -682,6 +683,7 @@ def test_chaos_storm_transient_kube_failures(env):
             rule_content=RULES,
             upstream_url=f"http://127.0.0.1:{upstream_port}",
             workflow_database_path=env,
+            lock_mode=lock_mode,
             bind_port=0,
         ).complete()
         await cfg.run()

@@ -202,10 +202,14 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
     return max((pk - p1) / (k - 1), 0.0), p1, pk, k
 
 
-def run_suite(quick: bool) -> None:
+def run_suite(quick: bool, result: Optional[dict] = None) -> None:
     """BASELINE.md eval configs 3-5 (the headline run is config 2; config 1
     is the trivial ~10-relationship check, covered by every unit test).
-    Results go to stderr; the headline JSON line is unaffected."""
+    Results go to stderr AND, when ``result`` is given, into the emitted
+    JSON as config3_*/config4_*/config5_* fields so a suite artifact is
+    self-contained."""
+    if result is None:
+        result = {}
     from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine
     from spicedb_kubeapi_proxy_tpu.models import parse_schema
 
@@ -277,6 +281,9 @@ definition namespace {
         f"p50_wall={np.percentile(lat, 50):.1f}ms "
         f"fixpoint_iters={iters} (warmup {warm:.1f}s, "
         f"member {member} sees {vis_member}/{n_ns})")
+    result["config3_rels"] = total
+    result["config3_p50_wall_ms"] = round(float(np.percentile(lat, 50)), 3)
+    result["config3_fixpoint_iters"] = iters
 
     # -- config 4: 10-hop tupleset-to-userset chains ------------------------
     n_chains = 2_000 // scale
@@ -306,6 +313,8 @@ definition namespace {
     dt = (time.perf_counter() - t0) * 1e3
     log(f"[config 4] 10-hop chains @ {total} rels: 512 checks in "
         f"{dt:.1f}ms ({all(got) and 'all allowed' or 'DENIALS!'})")
+    result["config4_rels"] = total
+    result["config4_512checks_ms"] = round(dt, 3)
 
     # -- config 5: multi-tenant concurrent lists ----------------------------
     n_ns, n_users, conc = (np.array([100_000, 10_000, 256]) // scale).tolist()
@@ -352,6 +361,9 @@ definition namespace {
         f"{dt_b * 1e3:.0f}ms total = {conc / dt_b:.0f} list-queries/s/chip "
         f"({dt_b * 1e3 / conc:.2f}ms/query amortized, "
         f"{dt / dt_b:.1f}x the unbatched run)")
+    result["config5_conc"] = conc
+    result["config5_ms_per_query"] = round(dt * 1e3 / conc, 3)
+    result["config5_batched_ms_per_query"] = round(dt_b * 1e3 / conc, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -788,7 +800,7 @@ def _measure(args, result: dict) -> None:
             log(f"remote-compare failed (non-fatal): {ex}")
 
     if args.suite:
-        run_suite(quick)
+        run_suite(quick, result)
 
 
 def main() -> None:

@@ -14,6 +14,10 @@
 #   r5_tpu_stderr.log       full methodology log
 set -u
 cd "$(dirname "$0")/.."
+# persistent XLA compile cache: stage 2 (and any re-run) reuses stage 1's
+# compiles instead of re-paying the ~55s tunnel-side warmup per process
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/sdbkp_jaxcache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 echo "== probing tunnel (subprocess, hard timeout) =="
 timeout 150 python - <<'EOF'

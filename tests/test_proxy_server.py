@@ -828,3 +828,87 @@ def test_chaos_crash_mid_dual_write_recovers_on_resume(env):
         await cfg.workflow.shutdown()
         upstream_server.close()
     asyncio.run(go())
+
+
+def test_chaos_crash_storm_converges_after_resumes(env):
+    """Chaos leg 3 — a storm of simulated process deaths: failpoints at
+    BOTH side-effect edges (SpiceDB write, kube write) strike repeatedly
+    while two users create namespaces concurrently. Whichever in-flight
+    workflow eats a fault suspends exactly like a crashed process (its
+    client sees an error); repeated resume_pending() cycles — process
+    restarts — must drain every suspended instance to completion: every
+    create eventually lands atomically, locks reach zero, and the event
+    logs replay deterministically (reference e2e crash matrix as a storm,
+    proxy_test.go:650-830)."""
+    from spicedb_kubeapi_proxy_tpu.authz import middleware
+    from spicedb_kubeapi_proxy_tpu.engine import RelationshipFilter
+    from spicedb_kubeapi_proxy_tpu.utils.failpoints import failpoints
+
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+        ).complete()
+        await cfg.run()
+        users = ["stormA", "stormB"]
+        clients = {u: HttpClient(cfg.server.port, u) for u in users}
+
+        saved_timeout = middleware.WORKFLOW_RESULT_TIMEOUT
+        middleware.WORKFLOW_RESULT_TIMEOUT = 2.0
+        try:
+            async def churn(u, idx):
+                c = clients[u]
+                for i in range(6):
+                    if (i + idx) % 3 == 0:
+                        failpoints.enable("panicKubeWrite", budget=1)
+                    elif (i + idx) % 3 == 1:
+                        failpoints.enable("panicWriteSpiceDB", budget=1)
+                    await c.request(
+                        "POST", "/api/v1/namespaces",
+                        body={"apiVersion": "v1", "kind": "Namespace",
+                              "metadata": {"name": f"cr-{u}-{i}"}})
+
+            await asyncio.gather(*(churn(u, i)
+                                   for i, u in enumerate(users)))
+        finally:
+            middleware.WORKFLOW_RESULT_TIMEOUT = saved_timeout
+            failpoints.disable_all()
+
+        # repeated "restarts" until every suspended instance drains
+        deadline = asyncio.get_running_loop().time() + 30
+        while cfg.workflow.pending_count():
+            assert asyncio.get_running_loop().time() < deadline, \
+                f"{cfg.workflow.pending_count()} instances never drained"
+            await cfg.workflow.resume_pending()
+            await asyncio.sleep(0.25)
+
+        assert not cfg.engine.store.exists(
+            RelationshipFilter(resource_type="lock"))
+        lists = {}
+        for u in users:
+            status, _, body = await clients[u].request(
+                "GET", "/api/v1/namespaces")
+            assert status == 200
+            lists[u] = {o["metadata"]["name"]
+                        for o in json.loads(body)["items"]}
+        for u in users:
+            for i in range(6):
+                name = f"cr-{u}-{i}"
+                in_upstream = ("namespaces", "", name) in fake.objects
+                in_graph = cfg.engine.store.exists(RelationshipFilter(
+                    resource_type="namespace", resource_id=name))
+                visible = name in lists[u]
+                # faults are one-shot: after enough restarts every create
+                # must have landed everywhere
+                assert in_upstream and in_graph and visible, (
+                    name, in_upstream, in_graph, visible)
+
+        fake.stop_watches()
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())

@@ -206,8 +206,10 @@ def _frame_object_key(frame: bytes, pf: PreFilter) -> Optional[tuple]:
             meta = obj.get("metadata") or {}
         ns = (meta.get("namespace") or "") if pf.namespace_expr else ""
         return (ns, meta.get("name") or "")
-    except ValueError:
-        # not JSON and not a well-formed proto frame (e.g. a frame
-        # truncated by a dying upstream): unjudgeable — fail closed
+    except (ValueError, AttributeError, TypeError):
+        # not JSON, not a well-formed proto frame, or JSON whose shape is
+        # not a watch event (array/scalar top level, non-dict rows — a
+        # broken aggregated-API backend): unjudgeable — fail closed with
+        # the documented stream-ending error, never an unhandled crash
         raise kubeproto.ProtoError(
             "unparseable watch frame (truncated or unknown encoding)")

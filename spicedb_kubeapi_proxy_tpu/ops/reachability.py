@@ -690,7 +690,14 @@ class CompiledGraph:
         # bounded because callers repeat the same few (off, n) windows.
         Mp_state = (self.M // LANE + 1) * LANE
         contig = q_contiguous
-        if contig is None and q_contig_grid is None and Q:
+        if contig is None and q_contig_grid is None and Q >= 1024:
+            # auto-detect only LARGE windows: q_contig_len is a static
+            # jit arg, so every distinct detected length is its own XLA
+            # compile — a caller whose small query sets happen to be
+            # consecutive must not accumulate per-length recompiles it
+            # never asked for. Big windows are where the gather hurts,
+            # and their lengths (full type ranges) barely vary. Explicit
+            # promises (the engine/batcher) are always honored.
             contig = (int(q_slots[-1]) - int(q_slots[0]) == Q - 1
                       and not np.any(q_batch != q_batch[0])
                       and np.array_equal(

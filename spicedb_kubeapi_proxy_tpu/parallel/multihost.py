@@ -155,6 +155,7 @@ class MirroredEngine:
         with self._subs_lock:
             subs = list(self._subs)
             self._seq += 1
+            seq = self._seq
             if not subs:
                 # nobody mirroring (single-host MirroredEngine, or every
                 # follower already gone): skip serialization entirely —
@@ -162,13 +163,18 @@ class MirroredEngine:
                 # first frame it receives (and must join before traffic
                 # to share store state, per the join-barrier contract)
                 return
-            frame = {"seq": self._seq, "method": method, **payload}
-            if blob is None:
-                wire = _pack({"ok": True, "frame": frame})
-            else:
-                blob = blob() if callable(blob) else blob
-                wire = _pack_binary(
-                    BinaryResult({"ok": True, "frame": frame}, blob))
+        # serialize OUTSIDE _subs_lock: a multi-MB check_bulk encode must
+        # not block subscribe()/unsubscribe() (a rejoining follower's join
+        # barrier would wait out encode time per batch). Frame ordering is
+        # unaffected — every _publish call site already serializes on the
+        # engine-level self._lock.
+        frame = {"seq": seq, "method": method, **payload}
+        if blob is None:
+            wire = _pack({"ok": True, "frame": frame})
+        else:
+            blob = blob() if callable(blob) else blob
+            wire = _pack_binary(
+                BinaryResult({"ok": True, "frame": frame}, blob))
         for q in subs:
             q.put(wire)
 

@@ -109,6 +109,9 @@ class OIDCAuthenticator:
         self.signing_algs = tuple(signing_algs)
         self.skew = skew
         self._jwks_uri = jwks_uri
+        # bounds how long a refresh-needing validation may wait behind an
+        # in-flight fetch (waiters use ~2x: discovery + JWKS)
+        self.http_timeout = http_timeout
         self._fetch = fetch or (
             lambda url: _default_fetch(url, ca_file, http_timeout))
         # _lock guards the key map + refresh stamp only; the network fetch
@@ -166,9 +169,12 @@ class OIDCAuthenticator:
 
         Stale-while-revalidate: a validation whose kid is in the cached
         map never touches the network or waits on a fetch in flight; only
-        the request that actually triggers a refresh pays for it, and
-        concurrent would-be refreshers fail fast instead of queueing
-        behind one hung socket."""
+        requests that actually need a refresh (cold start, unknown kid)
+        serialize on the single-flight lock — the winner fetches once,
+        waiters then read the refreshed cache instead of 401ing, and the
+        wait is bounded by the fetch's http_timeout. The cooldown stamp
+        still caps fetch frequency under forged-kid storms or a down
+        IDP."""
         with self._lock:
             keys = self._keys
             last = self._last_refresh
@@ -181,17 +187,26 @@ class OIDCAuthenticator:
             if k is not None:
                 return [k]
             # unknown kid — plausible key rotation; at most one refetch
-            # per cooldown window, and only by whoever wins the try-lock
-            if time.monotonic() - last > REFRESH_COOLDOWN and \
-                    self._refresh_lock.acquire(blocking=False):
+            # per cooldown window. All needers serialize on the lock: the
+            # winner fetches, waiters re-read the refreshed map when it
+            # releases (a rotation fetch window must not 401 the very
+            # tokens the rotation is for)
+            if time.monotonic() - last > REFRESH_COOLDOWN:
+                # wait bounded by what a healthy fetch can take: a hung
+                # IDP must not stall rotation-window requests longer than
+                # the fetch's own timeout would
+                if not self._refresh_lock.acquire(
+                        timeout=self.http_timeout * 2):
+                    return []
                 try:
-                    # re-check under the lock: another refresher may have
-                    # just finished while we read the stale stamp —
-                    # back-to-back fetches would defeat the cooldown's
-                    # forged-kid-storm defense
+                    # re-check under the lock: the fetch may have just
+                    # finished — back-to-back fetches would defeat the
+                    # cooldown's forged-kid-storm defense
                     with self._lock:
                         last = self._last_refresh
-                    if time.monotonic() - last > REFRESH_COOLDOWN:
+                        k = (self._keys or {}).get(kid)
+                    if k is None and \
+                            time.monotonic() - last > REFRESH_COOLDOWN:
                         self._stamp_attempt()
                         self._refresh()
                 finally:
@@ -201,12 +216,18 @@ class OIDCAuthenticator:
                 return [k] if k is not None else []
             return []
         # no key map yet (first token, or every earlier fetch failed):
-        # retry only past the cooldown, one fetcher at a time; losers of
-        # the try-lock reject rather than stack up on the IDP socket
-        if last and time.monotonic() - last <= REFRESH_COOLDOWN:
-            raise OIDCError("JWKS unavailable (cooling down)")
-        if not self._refresh_lock.acquire(blocking=False):
-            raise OIDCError("JWKS fetch already in flight")
+        # retry only past the cooldown. One fetcher at a time; the others
+        # WAIT on the lock (bounded by the fetch's http_timeout) and then
+        # validate against the freshly-cached keys — a proxy restart
+        # under a fleet reconnect storm must not convert one fetch's
+        # latency into a burst of spurious 401s
+        # the cooldown decision happens UNDER the lock: the attempt stamp
+        # is written before the fetch starts, so a pre-lock check cannot
+        # tell "a fetch is in flight right now" (wait for it) from "the
+        # last fetch just failed" (cool down)
+        if not self._refresh_lock.acquire(timeout=self.http_timeout * 2):
+            raise OIDCError("JWKS fetch timed out behind an in-flight "
+                            "refresh")
         try:
             # re-check under the lock (see the rotation branch above): a
             # just-finished fetch that still yielded no keys means the

@@ -181,10 +181,10 @@ class Options:
                 "server-name apply only to tcp:// engine endpoints")
         if self.engine_insecure and (
                 self.engine_ca_file or self.engine_skip_verify_ca or
-                self.engine_client_cert_file):
+                self.engine_client_cert_file or self.engine_server_name):
             raise OptionsError(
                 "engine-insecure (plaintext) excludes the TLS options "
-                "(engine-ca-file/skip-verify-ca/client-cert)")
+                "(engine-ca-file/skip-verify-ca/client-cert/server-name)")
         if bool(self.engine_client_cert_file) != \
                 bool(self.engine_client_key_file):
             raise OptionsError(

@@ -50,16 +50,22 @@ def client_ssl_context(ca_file: Optional[str] = None,
                        client_key_file: Optional[str] = None
                        ) -> ssl.SSLContext:
     """Connecting context for proxies / followers. Default: full
-    verification against the system trust store; ``ca_file`` swaps in a
-    custom bundle; ``skip_verify`` keeps TLS (confidentiality) but trusts
-    any presented certificate (the reference's SkipVerifyCA mode)."""
-    ctx = ssl.create_default_context()
+    verification against the system trust store; ``ca_file`` REPLACES the
+    trust store with that bundle (pinning — a publicly-trusted MITM cert
+    must not pass when the operator named a private CA, matching the
+    reference's CAPath mode); ``skip_verify`` keeps TLS (confidentiality)
+    but trusts any presented certificate (SkipVerifyCA)."""
     if ca_file:
         try:
-            ctx.load_verify_locations(cafile=ca_file)
+            # cafile= at construction loads ONLY this bundle: the system
+            # store is never consulted (create_default_context skips
+            # load_default_certs when an explicit CA source is given)
+            ctx = ssl.create_default_context(cafile=ca_file)
         except (OSError, ssl.SSLError) as e:
             raise TLSConfigError(
                 f"cannot load CA bundle {ca_file}: {e}") from None
+    else:
+        ctx = ssl.create_default_context()
     if skip_verify:
         ctx.check_hostname = False
         ctx.verify_mode = ssl.CERT_NONE

@@ -1013,8 +1013,10 @@ def test_contiguous_query_window_matches_gather():
     fall back to the gather rather than shift."""
     rels = ["namespace:ns%d#viewer@user:alice" % i for i in range(0, 40, 3)]
     rels += ["namespace:ns%d#creator@user:alice" % i for i in range(1, 40, 7)]
+    # >1024 pods: the pod window crosses the auto-detect gate (small
+    # windows decline to the gather to bound per-length recompiles)
     rels += ["pod:p%d#namespace@namespace:ns%d" % (i, i % 40)
-             for i in range(200)]
+             for i in range(1100)]
     e = make_engine(*rels)
     cg = e.compiled()
     objs = e._objects_by_name()

@@ -559,3 +559,27 @@ def test_tlsconf_error_surfaces(tmp_path):
                            str(tmp_path / "no-key.pem"))
     with pytest.raises(TLSConfigError):
         client_ssl_context(ca_file=str(tmp_path / "no-ca.pem"))
+
+
+def test_ca_file_pins_trust_to_that_bundle(pki):
+    """--engine-ca-file must REPLACE the trust store, not extend it: a
+    MITM holding any publicly-trusted certificate must fail verification
+    when the operator named a private CA (reference CAPath semantics).
+    The context built from a ca_file must trust exactly that bundle."""
+    pinned = client_ssl_context(ca_file=pki["ca"])
+    assert pinned.cert_store_stats()["x509_ca"] == 1
+    # the default (no ca_file) context loads the system store — on any
+    # realistic image that is far more than our single test CA; at
+    # minimum it must differ from the pinned store
+    system = client_ssl_context()
+    assert system.cert_store_stats() != pinned.cert_store_stats() \
+        or system.cert_store_stats()["x509_ca"] <= 1  # bare image: vacuous
+
+
+def test_insecure_excludes_server_name():
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options, OptionsError)
+    with pytest.raises(OptionsError, match="server-name"):
+        Options(engine_endpoint="tcp://h:1", engine_insecure=True,
+                engine_server_name="engine.corp", rule_content="x",
+                upstream_url="http://x").validate()

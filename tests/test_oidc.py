@@ -331,21 +331,32 @@ def test_hung_jwks_fetch_blocks_only_the_triggering_request(monkeypatch):
     t0 = time.monotonic()
     assert a.authenticate_token(sign_jwt(std_claims())) is not None
     assert time.monotonic() - t0 < 1.0, "cached-kid auth waited on fetch"
-    # a SECOND unknown-kid token must not queue behind the hung socket
-    t0 = time.monotonic()
-    assert a.authenticate_token(
-        sign_jwt(std_claims(), kid="rotated2")) is None
-    assert time.monotonic() - t0 < 1.0, "second refresher queued on fetch"
+    # a SECOND unknown-kid token queues behind the in-flight fetch, but
+    # the wait is BOUNDED by the fetch timeout, not the hang duration
+    second_done = threading.Event()
+
+    def second_request():
+        assert a.authenticate_token(
+            sign_jwt(std_claims(), kid="rotated2")) is None
+        second_done.set()
+
+    t2 = threading.Thread(target=second_request, daemon=True)
+    t2.start()
+    time.sleep(0.3)
+    assert not second_done.is_set(), "waiter should block on the fetch"
     assert not hung_done.is_set()
     release.set()
     t.join(10)
-    assert hung_done.is_set()
+    t2.join(10)
+    assert hung_done.is_set() and second_done.is_set()
 
 
 def test_initial_jwks_fetch_is_single_flight():
     """Before any keys are cached, exactly one request performs the fetch;
-    concurrent first requests fail fast instead of stacking up on the
-    IDP socket."""
+    concurrent first requests WAIT for it (bounded by the fetch timeout)
+    and then validate against the fresh cache — a restart under a
+    reconnect storm must not turn one fetch's latency into spurious
+    401s."""
     release = threading.Event()
     calls = []
 
@@ -357,23 +368,28 @@ def test_initial_jwks_fetch_is_single_flight():
     a = make_auth(fetch=fetch)
     results = {}
 
-    def first():
-        results["first"] = a.authenticate_token(sign_jwt(std_claims()))
+    def auth(slot):
+        results[slot] = a.authenticate_token(sign_jwt(std_claims()))
 
-    t = threading.Thread(target=first, daemon=True)
+    t = threading.Thread(target=auth, args=("first",), daemon=True)
     t.start()
     deadline = time.monotonic() + 5
     while not a._refresh_lock.locked() and time.monotonic() < deadline:
         time.sleep(0.005)
-    # a concurrent request while the initial fetch hangs: rejected fast
-    t0 = time.monotonic()
-    assert a.authenticate_token(sign_jwt(std_claims())) is None
-    assert time.monotonic() - t0 < 1.0
+    # a concurrent request queues on the single-flight lock (it must not
+    # issue its own fetch) ...
+    t2 = threading.Thread(target=auth, args=("second",), daemon=True)
+    t2.start()
+    time.sleep(0.3)
+    assert "second" not in results, "waiter should block on the fetch"
     assert len(calls) == 1
     release.set()
     t.join(10)
-    # the request that performed the fetch succeeds once the IDP answers
+    t2.join(10)
+    # ... and BOTH succeed from the one fetch once the IDP answers
     assert results["first"] is not None
+    assert results["second"] is not None
+    assert len(calls) == 1
 
 
 def test_kidless_token_tries_all_candidate_keys():

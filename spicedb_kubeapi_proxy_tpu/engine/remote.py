@@ -46,6 +46,7 @@ import ssl
 import struct
 import threading
 from dataclasses import asdict
+from functools import partial
 from typing import Optional
 
 from ..utils.net import drain_server
@@ -165,13 +166,23 @@ async def _read_frame(reader: asyncio.StreamReader,
 
 class EngineServer:
     """Serves an :class:`Engine` to remote proxies. Device queries run in
-    worker threads (asyncio.to_thread) so slow fixpoints never stall other
-    connections' dispatches — concurrent queries pipeline on the device the
-    same way in-process callers do."""
+    worker threads so slow fixpoints never stall other connections'
+    dispatches — concurrent queries pipeline on the device the same way
+    in-process callers do.
+
+    The workers come from a DEDICATED executor, not the loop's default
+    pool: push-watch streams park a thread per subscriber waiting for
+    events, and batched lookups (enable_lookup_batching) park up to
+    max_rows threads per fill window — on a small host the default
+    pool's min(32, cpus+4) workers would starve request handling (and an
+    embedding application's own to_thread users would compete with the
+    engine)."""
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
                  port: int = 0, token: Optional[str] = None,
-                 ssl_context=None):
+                 ssl_context=None, max_workers: int = 64):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.engine = engine
         self.host = host
         self.port = port
@@ -183,6 +194,14 @@ class EngineServer:
         self.ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()  # live connection-handler tasks
+        self._executor = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="engine-host")
+
+    async def _in_worker(self, fn, *args):
+        """Run blocking work on the dedicated pool (to_thread semantics,
+        minus contextvars, which the handlers don't use)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, partial(fn, *args))
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
@@ -218,6 +237,11 @@ class EngineServer:
         finally:
             if waker is not None:
                 waker.cancel()
+        # drained handlers have returned their workers; drop the pool
+        # without joining stragglers (a parked wait_events unblocks at
+        # its heartbeat timeout — the drain's waker already released the
+        # common case)
+        self._executor.shutdown(wait=False, cancel_futures=True)
         self._server = None
 
     async def _serve(self, reader: asyncio.StreamReader,
@@ -281,7 +305,7 @@ class EngineServer:
             if fn is None:
                 return {"ok": False, "kind": "proto",
                         "error": f"unknown op {op!r}"}
-            result = await asyncio.to_thread(fn, req)
+            result = await self._in_worker(fn, req)
             if isinstance(result, BinaryResult):
                 return result
             return {"ok": True, "result": result}
@@ -386,7 +410,7 @@ class EngineServer:
         rev = from_rev
         while True:
             try:
-                events = await asyncio.to_thread(
+                events = await self._in_worker(
                     self.engine.wait_events, rev, self.PUSH_HEARTBEAT)
             except StoreError as e:
                 writer.write(_pack({"ok": False, "push": True,
@@ -421,7 +445,7 @@ class EngineServer:
         try:
             while True:
                 try:
-                    wire = await asyncio.to_thread(
+                    wire = await self._in_worker(
                         q.get, True, self.PUSH_HEARTBEAT)
                 except _queue.Empty:
                     writer.write(_pack({"ok": True, "hb": True}))
@@ -882,6 +906,13 @@ def main(argv=None) -> int:
     ap.add_argument("--mirror-leader",
                     help="(follower processes) host:port of process 0's "
                          "engine endpoint to subscribe to")
+    ap.add_argument("--lookup-batch-window", type=float, default=0.0,
+                    help="fuse concurrent lookup_mask requests (across "
+                         "ALL connected proxies) into shared device "
+                         "dispatches, holding each for at most this many "
+                         "seconds (0 = off). No effect on --distributed "
+                         "hosts: mirrored lookups pin their evaluation "
+                         "time for SPMD lockstep, which bypasses fusion")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -958,6 +989,8 @@ def main(argv=None) -> int:
         log.info("engine mesh: %s", dict(mesh.shape))
     bootstrap = "\n---\n".join(open(f).read() for f in args.bootstrap) or None
     engine = Engine(bootstrap=bootstrap, mesh=mesh)
+    if args.lookup_batch_window > 0:
+        engine.enable_lookup_batching(args.lookup_batch_window)
     if engine.load_snapshot_if_exists(args.snapshot_path):
         log.info("loaded snapshot %s (revision %d)", args.snapshot_path,
                  engine.revision)

@@ -1,0 +1,23 @@
+
+import os, sys
+role, port_coord, port_tcp, repo = (sys.argv[1], sys.argv[2], sys.argv[3],
+                                    sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, repo)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+pid = "0" if role == "leader" else "1"
+argv = ["--distributed", f"127.0.0.1:{port_coord},2,{pid}",
+        "--engine-mesh", "auto", "--token", "mh-tok",
+        "--engine-insecure"]  # loopback-only test fixture
+if role == "leader":
+    argv += ["--bind-port", port_tcp]
+    print("LEADER STARTING", flush=True)
+else:
+    argv += ["--mirror-leader", f"127.0.0.1:{port_tcp}",
+             "--bind-port", "0"]
+    print("FOLLOWER STARTING", flush=True)
+sys.exit(main(argv))

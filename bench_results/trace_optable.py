@@ -1,0 +1,86 @@
+"""Summarize a jax profiler trace into a per-op device-time table.
+
+    python bench_results/trace_optable.py <dir-or-trace.json.gz> [trials]
+
+Given a profile dir (bench --profile-dir) or a vm.trace.json.gz path,
+prints device ops sorted by total time with per-trial ms, bytes accessed,
+and effective GB/s — the table behind r5_tpu_trace_analysis.md, so the
+next chip window's before/after comparison is one command per trace.
+``trials`` defaults to the modal op count (each latency-loop trial runs
+every op once).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(
+        os.path.join(path, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not hits:
+        raise SystemExit(f"no *.trace.json.gz under {path}")
+    return hits[-1]
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    trace = find_trace(sys.argv[1])
+    with gzip.open(trace) as f:
+        tr = json.load(f)
+    ev = tr.get("traceEvents", [])
+    names = {e["pid"]: e["args"].get("name") for e in ev
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev_pids = {p for p, n in names.items() if n and "TPU" in n.upper()}
+    if not dev_pids:
+        dev_pids = {p for p, n in names.items()
+                    if n and "CPU" not in n.upper()}
+    rows: dict[str, list] = {}
+    for e in ev:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        if name.startswith("jit__"):  # wrapper span double-counts children
+            continue
+        args = e.get("args") or {}
+        r = rows.setdefault(name, [0, 0, 0,
+                                   args.get("hlo_category", ""),
+                                   (args.get("source", "") or "")
+                                   .split("/")[-1]])
+        r[0] += e.get("dur", 0)
+        r[1] += 1
+        r[2] += int(args.get("bytes_accessed", 0))
+    if not rows:
+        raise SystemExit("no device ops in trace")
+    trials = (int(sys.argv[2]) if len(sys.argv) > 2
+              else collections.Counter(
+                  r[1] for r in rows.values()).most_common(1)[0][0])
+    print(f"# {trace}  (trials={trials})")
+    print(f"{'op':<26}{'ms/trial':>10}{'MB':>9}{'GB/s':>8}  category source")
+    tot_ms = tot_b = 0.0
+    for name, (dur, k, b, cat, src) in sorted(
+            rows.items(), key=lambda kv: -kv[1][0]):
+        ms = dur / trials / 1e3
+        per_trial_b = b / trials
+        tot_ms += ms
+        tot_b += per_trial_b
+        if ms < 0.005:
+            continue
+        gbps = (per_trial_b / 1e6) / ms if ms else 0
+        print(f"{name:<26}{ms:10.3f}{per_trial_b / 1e6:9.2f}{gbps:8.1f}"
+              f"  {cat} {src}")
+    print(f"\nTOTAL device ms/trial = {tot_ms:.2f}, "
+          f"bytes/trial = {tot_b / 1e6:.1f} MB, "
+          f"effective = {tot_b / 1e6 / tot_ms if tot_ms else 0:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

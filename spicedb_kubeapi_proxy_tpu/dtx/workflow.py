@@ -185,10 +185,14 @@ def _kube_req(input: WorkflowInput) -> dict:
 def _is_successful(verb: str, status: int) -> bool:
     """Verb-aware success semantics (workflow.go:252-275): a delete of an
     already-gone object (404) and a create of an already-present object
-    (409) both count as applied."""
+    (409) both count as applied. Any other verb is unsupported for
+    dual-writes (workflow.go:264-266 errors rather than guessing) —
+    raising here rolls everything back and surfaces the error."""
     if verb == "delete":
         return status in (404, 200)
-    return status in (409, 201, 200)
+    if verb in ("create", "update", "patch"):
+        return status in (409, 201, 200)
+    raise ActivityError(f"unsupported kube verb for dual-write: {verb}")
 
 
 def pessimistic_write(ctx: WorkflowContext, input_dict: dict):
@@ -226,7 +230,15 @@ def pessimistic_write(ctx: WorkflowContext, input_dict: dict):
         if out.get("retry_after", 0) > 0:
             yield ctx.sleep(out["retry_after"])
             continue
-        if _is_successful(input.verb, out["status"]):
+        try:
+            ok = _is_successful(input.verb, out["status"])
+        except ActivityError:
+            # unsupported verb: roll back BEFORE surfacing the error
+            # (workflow.go:264-266 — cleanup precedes the error return)
+            yield from _cleanup(ctx, ctx.instance_id,
+                                updates + [lock_update])
+            raise
+        if ok:
             yield from _cleanup(ctx, ctx.instance_id, [lock_update])
             return out
         # kube rejected the operation: roll back everything

@@ -175,6 +175,27 @@ def test_kube_rejection_rolls_back():
     asyncio.run(run())
 
 
+def test_unsupported_verb_rolls_back_and_errors():
+    """A dual-write on a verb outside create/update/patch/delete must roll
+    back the relationships and surface an error, never guess at success
+    semantics. The verb->HTTP-method map rejects it at the activity (like
+    the reference's httpMethodFromVerb), the retry budget exhausts, and
+    cleanup precedes the error (workflow.go:248-249,264-266);
+    _is_successful's own unsupported-verb guard is defense-in-depth
+    behind that, as in the reference."""
+    async def run():
+        w = World()
+        inp = ns_create_input()
+        inp.verb = "deletecollection"
+        iid = await w.runner.create_instance(
+            LOCK_MODE_PESSIMISTIC, inp.to_dict())
+        with pytest.raises(ActivityError):
+            await w.runner.get_result(iid, timeout=15)
+        assert not w.has_rel("namespace:team-a#creator@user:alice")
+        assert w.no_leftover_locks()
+    asyncio.run(run())
+
+
 def test_kube_transient_exception_retried():
     async def run():
         w = World()

@@ -5,13 +5,14 @@
 #
 #   bash bench_results/r5_tpu_runbook.sh
 #
-# Produces, under bench_results/:
-#   r5_tpu_full.json        headline + suite configs (incl. post-closure
-#                           config 3) + remote-compare + tail diagnosis
-#   r5_tpu_profile/         jax profiler trace of the headline loop
-#                           (fixpoint annotated "sdbkp:fixpoint" — answers
-#                           the 150-vs-819 GB/s bandwidth question)
-#   r5_tpu_stderr.log       full methodology log
+# Produces, under bench_results/ (window-#1 artifacts r5_tpu_full.json /
+# r5_tpu_profile/ are committed history; this writes fresh names):
+#   r5_tpu_headline.json    stage 1: complete headline-only JSON (banked
+#                           first — windows have closed mid-run)
+#   r5_tpu_full2.json       stage 2: suite configs + remote-compare
+#   r5_tpu_profile2/        stage-2 profiler trace — summarize with
+#                           python bench_results/trace_optable.py
+#   r5_tpu_*stderr*.log     full methodology logs
 set -u
 cd "$(dirname "$0")/.."
 # persistent XLA compile cache: stage 2 (and any re-run) reuses stage 1's

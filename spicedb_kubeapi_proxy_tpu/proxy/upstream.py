@@ -137,6 +137,13 @@ def _is_watch(req: ProxyRequest) -> bool:
 async def _read_head(reader) -> tuple[int, dict]:
     status_line = await reader.readline()
     parts = status_line.decode("latin-1").split(" ", 2)
+    if len(parts) < 2 or not parts[1].strip().isdigit():
+        # upstream closed (or garbled) before a status line: surface a
+        # connection error — the retry/error paths handle those — not a
+        # bare IndexError from the parse
+        raise ConnectionResetError(
+            "upstream closed the connection before sending a response "
+            f"status line ({status_line[:60]!r})")
     status = int(parts[1])
     headers: dict[str, str] = {}
     while True:

@@ -912,3 +912,46 @@ def test_chaos_crash_storm_converges_after_resumes(env):
         await cfg.workflow.shutdown()
         upstream_server.close()
     asyncio.run(go())
+
+
+def test_upstream_dying_mid_request_surfaces_connection_error(env):
+    """An upstream that closes the socket before sending a status line
+    must surface as a connection error (which retry paths absorb), never
+    a bare IndexError from the status-line parse — found by a soak where
+    killed-connection faults printed IndexError tracebacks."""
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=env,
+            bind_port=0,
+        ).complete()
+        await cfg.run()
+        alice = HttpClient(cfg.server.port, "alice")
+        # a dual-write whose kube writes ALL die mid-request: the workflow
+        # retries then reports cleanly (5xx), no IndexError anywhere
+        # exactly the retry budget (5+1 attempts), so nothing leaks into
+        # the later requests
+        fake.fail_next(6, exception=ConnectionResetError("mid-request"))
+        status, _, body = await alice.request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "dying"}})
+        assert status >= 500, (status, body)
+        assert b"IndexError" not in body
+        # a read hitting the same fault: clean 5xx too
+        fake.fail_next(1, exception=ConnectionResetError("mid-request"))
+        status, _, body = await alice.request("GET", "/api/v1/namespaces")
+        assert status >= 500
+        assert b"IndexError" not in body
+        # and the path recovers once the upstream behaves
+        status, _, _ = await alice.request("GET", "/api/v1/namespaces")
+        assert status == 200
+
+        fake.stop_watches()
+        await cfg.server.stop()
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())

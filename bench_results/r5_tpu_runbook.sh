@@ -32,6 +32,17 @@ if [ $? -ne 0 ]; then
     echo "tunnel still down; not burning the window budget"; exit 1
 fi
 
+# Stage 0: extraction A/B (~2 min incl. build) — confirms the
+# contiguous-window dynamic_slice win (window-1 trace predicts ~0.9 ms
+# of 3.04 ms device time) even if the window closes before stage 1.
+echo "== stage 0: extraction A/B micro =="
+timeout 420 python bench_results/extraction_ab.py \
+    > bench_results/r5_tpu_extraction_ab.json \
+    2> bench_results/r5_tpu_extraction_ab_stderr.log
+echo "stage 0 rc=$?"
+cat bench_results/r5_tpu_extraction_ab.json 2>/dev/null
+echo
+
 # Stage 1: headline only (~6 min of tunnel time). Windows have closed
 # mid-run before (window #1 hung at ~11 min, turning the suite run into a
 # watchdog-partial) — bank a COMPLETE headline JSON before anything else.

@@ -10,6 +10,7 @@ connection, authentication mode, and functional options for embedding.
 from __future__ import annotations
 
 import argparse
+import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -41,6 +42,40 @@ def _parse_mesh_spec(spec: str) -> dict:
         return parse_mesh_spec(spec)
     except MeshSpecError as e:
         raise OptionsError(str(e)) from None
+
+
+def _probe_device_backend(timeout: float) -> None:
+    """Initialize the jax backend in a THROWAWAY subprocess first: the
+    remotely-attached TPU plugin blocks forever (no error) when its
+    tunnel is down, and a hang must surface as a boot failure with a
+    clear message, not as a ready-but-frozen proxy. Same pattern as
+    bench.py's probe. The subprocess also warms nothing — the real
+    in-process init happens lazily afterwards."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        p = subprocess.run(
+            [_sys.executable, "-c",
+             # honor an explicit JAX_PLATFORMS=cpu despite the image's
+             # sitecustomize override (same guard as tests/conftest.py)
+             "import os, jax;\n"
+             "os.environ.get('JAX_PLATFORMS') == 'cpu' and "
+             "jax.config.update('jax_platforms', 'cpu');\n"
+             "print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        raise OptionsError(
+            f"device backend did not answer within {timeout:.0f}s "
+            "(hung TPU plugin / tunnel down?) — fix the device "
+            "attachment, lower --engine-probe-timeout, or set it to 0 "
+            "to skip the probe") from None
+    if p.returncode != 0:
+        raise OptionsError(
+            "device backend probe failed: "
+            f"{(p.stderr or p.stdout).strip()[-400:]}")
+    log = logging.getLogger("sdbkp.options")
+    log.info("device backend probe: %s", p.stdout.strip() or "?")
 
 
 @dataclass
@@ -117,6 +152,12 @@ class Options:
     # >0 coalesces concurrent list prefilters into fused device dispatches
     # (seconds of added latency traded for per-dispatch amortization)
     lookup_batch_window: float = 0.0
+    # >0 probes the device backend in a SUBPROCESS with this timeout
+    # before building an in-process engine: the remotely-attached TPU
+    # plugin HANGS (not errors) when its tunnel is down, which would
+    # otherwise pass /readyz and then freeze the first authorization.
+    # 0 = skip (tests, CPU-only use); the CLI defaults it on for serving.
+    engine_probe_timeout: float = 0.0
     # /debug/config stays 404 unless explicitly enabled — even a sanitized
     # topology dump is opt-in, not default-on
     enable_debug_config: bool = False
@@ -288,6 +329,8 @@ class Options:
                                   ssl_context=ssl_context,
                                   server_hostname=self.engine_server_name)
         else:
+            if self.engine_probe_timeout > 0:
+                _probe_device_backend(self.engine_probe_timeout)
             bootstrap = "\n---\n".join(
                 [open(f).read() for f in self.bootstrap_files]
                 + ([self.bootstrap_content] if self.bootstrap_content else []))
@@ -525,6 +568,12 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--enable-debug-config", action="store_true",
                         help="serve the sanitized options dump on "
                              "/debug/config (off by default)")
+    parser.add_argument("--engine-probe-timeout", type=float, default=120.0,
+                        help="probe the device backend in a subprocess "
+                             "with this timeout before serving (a hung "
+                             "TPU attachment fails boot with a clear "
+                             "error instead of freezing the first "
+                             "request); 0 skips the probe")
     parser.add_argument("--engine-mesh",
                         help="multi-chip device mesh for the in-process "
                              "engine: 'auto' or 'data=D,graph=G'")
@@ -578,6 +627,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,
         lookup_batch_window=args.lookup_batch_window,
+        engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,
         feature_gates=args.feature_gates,

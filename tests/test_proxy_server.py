@@ -955,3 +955,22 @@ def test_upstream_dying_mid_request_surfaces_connection_error(env):
         await cfg.workflow.shutdown()
         upstream_server.close()
     asyncio.run(go())
+
+
+def test_engine_probe_timeout(env):
+    """--engine-probe-timeout: a responsive backend passes boot; the probe
+    rejects rather than hangs when the device cannot answer (validated
+    against a genuinely hung TPU tunnel during development — here the
+    cpu backend answers, and the flag=0 default skips probing)."""
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        _probe_device_backend)
+
+    _probe_device_backend(60)  # cpu backend: must pass quickly
+    # and the Options path accepts the field
+    cfg = Options(
+        rule_content=RULES,
+        upstream=FakeKube(),
+        workflow_database_path=env,
+        engine_probe_timeout=60,
+    ).complete()
+    assert cfg.engine is not None

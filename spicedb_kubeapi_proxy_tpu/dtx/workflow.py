@@ -195,8 +195,25 @@ def _is_successful(verb: str, status: int) -> bool:
     raise ActivityError(f"unsupported kube verb for dual-write: {verb}")
 
 
+SUPPORTED_VERBS = ("create", "update", "patch", "delete")
+
+
+def _validate_verb(verb: str) -> None:
+    """Reject unsupported verbs BEFORE any side effect: past this point a
+    deterministic verb error would either burn the kube retry budget
+    (pessimistic — the activity's error is indistinguishable from a
+    transient one) or, worse, pass the optimistic path's existence
+    arbitration (a collection GET answers 200) and fabricate success
+    over committed relationship writes."""
+    if verb not in SUPPORTED_VERBS:
+        raise ActivityError(
+            f"unsupported kube verb for dual-write: {verb!r} "
+            f"(supported: {', '.join(SUPPORTED_VERBS)})")
+
+
 def pessimistic_write(ctx: WorkflowContext, input_dict: dict):
     input = WorkflowInput.from_dict(input_dict)
+    _validate_verb(input.verb)
     lock_rel = resource_lock_rel(input, ctx.instance_id)
     lock_update = {"op": "create", "rel": lock_rel}
 
@@ -251,6 +268,7 @@ def pessimistic_write(ctx: WorkflowContext, input_dict: dict):
 
 def optimistic_write(ctx: WorkflowContext, input_dict: dict):
     input = WorkflowInput.from_dict(input_dict)
+    _validate_verb(input.verb)
     updates = _base_updates(input)
     yield from _expand_delete_filters(ctx, input, updates)
 

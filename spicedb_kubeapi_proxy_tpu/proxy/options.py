@@ -329,6 +329,19 @@ class Options:
                                   ssl_context=ssl_context,
                                   server_hostname=self.engine_server_name)
         else:
+            import os as _os
+
+            if _os.environ.get("JAX_PLATFORMS") == "cpu":
+                # honor an explicit cpu request IN-PROCESS too: the
+                # image's sitecustomize override would otherwise attach
+                # the TPU plugin here even though the probe subprocess
+                # (which applies the same guard) reported cpu
+                import jax as _jax
+
+                try:
+                    _jax.config.update("jax_platforms", "cpu")
+                except Exception:  # already initialized: keep selection
+                    pass
             if self.engine_probe_timeout > 0:
                 _probe_device_backend(self.engine_probe_timeout)
             bootstrap = "\n---\n".join(

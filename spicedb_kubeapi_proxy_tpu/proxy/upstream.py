@@ -137,14 +137,16 @@ def _is_watch(req: ProxyRequest) -> bool:
 async def _read_head(reader) -> tuple[int, dict]:
     status_line = await reader.readline()
     parts = status_line.decode("latin-1").split(" ", 2)
-    if len(parts) < 2 or not parts[1].strip().isdigit():
+    try:
+        status = int(parts[1].strip())
+    except (IndexError, ValueError):
         # upstream closed (or garbled) before a status line: surface a
         # connection error — the retry/error paths handle those — not a
-        # bare IndexError from the parse
+        # bare IndexError/ValueError from the parse (str.isdigit would
+        # still admit non-ASCII digits that int() rejects)
         raise ConnectionResetError(
             "upstream closed the connection before sending a response "
-            f"status line ({status_line[:60]!r})")
-    status = int(parts[1])
+            f"status line ({status_line[:60]!r})") from None
     headers: dict[str, str] = {}
     while True:
         line = await reader.readline()

@@ -175,24 +175,25 @@ def test_kube_rejection_rolls_back():
     asyncio.run(run())
 
 
-def test_unsupported_verb_rolls_back_and_errors():
-    """A dual-write on a verb outside create/update/patch/delete must roll
-    back the relationships and surface an error, never guess at success
-    semantics. The verb->HTTP-method map rejects it at the activity (like
-    the reference's httpMethodFromVerb), the retry budget exhausts, and
-    cleanup precedes the error (workflow.go:248-249,264-266);
-    _is_successful's own unsupported-verb guard is defense-in-depth
-    behind that, as in the reference."""
+def test_unsupported_verb_rejected_before_any_side_effect():
+    """A dual-write on a verb outside create/update/patch/delete is
+    rejected up front in BOTH lock modes — before any SpiceDB write, so
+    nothing needs rolling back, no retry budget burns, and (critically)
+    the optimistic path's existence arbitration cannot fabricate success
+    over committed relationship writes (a collection GET answers 200).
+    The activity's verb->method map and _is_successful stay as
+    defense-in-depth behind it."""
     async def run():
-        w = World()
-        inp = ns_create_input()
-        inp.verb = "deletecollection"
-        iid = await w.runner.create_instance(
-            LOCK_MODE_PESSIMISTIC, inp.to_dict())
-        with pytest.raises(ActivityError):
-            await w.runner.get_result(iid, timeout=15)
-        assert not w.has_rel("namespace:team-a#creator@user:alice")
-        assert w.no_leftover_locks()
+        for mode in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
+            w = World()
+            inp = ns_create_input()
+            inp.verb = "deletecollection"
+            iid = await w.runner.create_instance(mode, inp.to_dict())
+            with pytest.raises(Exception, match="unsupported kube verb"):
+                await w.runner.get_result(iid, timeout=10)
+            assert not w.has_rel("namespace:team-a#creator@user:alice"), mode
+            assert w.no_leftover_locks(), mode
+            assert not w.kube.requests, (mode, "kube must never be hit")
     asyncio.run(run())
 
 

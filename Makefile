@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-quick bench bench-quick serve-dev demo native lint clean
+.PHONY: test test-quick chaos bench bench-quick serve-dev demo native lint clean
 
 # full suite on the virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -13,6 +13,11 @@ test:
 test-quick:
 	$(PY) -m pytest tests/test_engine.py tests/test_rules.py \
 	  tests/test_authz.py -q
+
+# failpoint-driven transport chaos: deterministic (no sleeps — backoff
+# schedules injected), also part of the default `make test` selection
+chaos:
+	$(PY) -m pytest -m chaos -q --continue-on-collection-errors
 
 # the headline benchmark (real TPU if reachable, CPU-degraded otherwise)
 bench:

@@ -30,6 +30,8 @@ from ..dtx.runner import ActivityError, WorkflowEngine, WorkflowTimeout
 from ..dtx.workflow import KubeResp, LOCK_MODE_PESSIMISTIC
 from ..engine import Engine
 from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
+from ..utils.metrics import metrics
+from ..utils.resilience import DependencyUnavailable
 from ..rules.expr import ExprError
 from ..rules.input import ResolveInput, UserInfo
 from ..rules.matcher import MapMatcher, RequestMeta
@@ -62,6 +64,9 @@ class AuthzDeps:
     # disk-cached discovery RESTMapper, server.go:228-243); None = every
     # discovery request hits the upstream
     discovery_cache: Optional[object] = None
+    # per-dependency circuit breakers (utils/resilience.CircuitBreaker)
+    # whose open state makes /readyz report unready with a reason
+    breakers: tuple = ()
 
 
 def _always_allowed(req: ProxyRequest) -> bool:
@@ -76,6 +81,27 @@ def _always_allowed(req: ProxyRequest) -> bool:
 
 
 async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
+    """The authorization chain, with fail-closed dependency degradation:
+    an open circuit breaker or exhausted deadline — upstream kube or the
+    remote TPU engine — maps to a bounded, RETRYABLE kube Status 503
+    with a ``Retry-After`` header. Never a hang (deadlines bound every
+    dependency wait) and never a fail-open 200 (an unanswerable check is
+    a denial-shaped error, mirroring how SpiceDB failures surface as
+    retryable statuses in dtx/workflow.py kube_conflict_resp)."""
+    try:
+        return await _authorize_inner(req, deps)
+    except DependencyUnavailable as e:
+        metrics.counter("proxy_dependency_unavailable_total",
+                        dependency=e.dependency).inc()
+        resp = kube_status(
+            503, f"dependency {e.dependency} unavailable: {e}",
+            "ServiceUnavailable")
+        resp.headers["Retry-After"] = str(max(1, int(e.retry_after + 0.5)))
+        return resp
+
+
+async def _authorize_inner(req: ProxyRequest,
+                           deps: AuthzDeps) -> ProxyResponse:
     info = req.request_info
     user = req.user
     if info is None:
@@ -126,6 +152,14 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
         except UpdateError as e:
             return kube_status(500, str(e))
         if update_rule is not None:
+            # fail fast with the 503 + Retry-After family BEFORE durably
+            # enqueueing the dual-write when a dependency circuit is
+            # hard-open: a BreakerOpen raised inside a workflow activity
+            # would be stringified into an ActivityError 502 after
+            # burning the workflow's whole retry budget against instant
+            # rejections (check_open never consumes the probe slot)
+            for b in deps.breakers:
+                b.check_open()
             return await _dual_write(req, deps, update_rule, input)
         return await deps.upstream(req)
 

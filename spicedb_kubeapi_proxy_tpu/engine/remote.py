@@ -45,11 +45,15 @@ import socket
 import ssl
 import struct
 import threading
+import time
 from dataclasses import asdict
 from functools import partial
 from typing import Optional
 
+from ..utils.failpoints import FailPointError, failpoints
+from ..utils.metrics import metrics
 from ..utils.net import drain_server
+from ..utils.resilience import CircuitBreaker, Deadline, RetryPolicy
 
 from ..models.tuples import Relationship
 from .engine import CheckItem, Engine, SchemaViolation, WatchEvent
@@ -75,6 +79,22 @@ _ERROR_KINDS = {
     "schema": SchemaViolation,
     "store": StoreError,
 }
+
+# ops that are safe to retry after a transport failure even if the
+# request bytes reached the engine host: pure reads. Writes
+# (write/delete_relationships) are NEVER in this set — once bytes are on
+# the wire the server may have applied them, and a replay would
+# double-apply (the no-retry-after-send invariant in _transact).
+_IDEMPOTENT_OPS = frozenset({
+    "check_bulk", "lookup_resources", "lookup_mask", "object_ids",
+    "revision", "exists", "watch_since", "watch_gate",
+    "read_relationships",
+})
+
+# "the transport failed" (vs the engine answering with an error): socket
+# errors — connect refused/reset/timeout, TLS failures — plus armed
+# failpoints so chaos tests drive the same classification
+TRANSPORT_ERRORS = (OSError, FailPointError)
 
 
 class RemoteEngineError(RuntimeError):
@@ -571,10 +591,25 @@ class RemoteEngine:
     def __init__(self, host: str, port: int, token: Optional[str] = None,
                  timeout: float = 300.0, connect_timeout: float = 10.0,
                  pool_size: int = 8, ssl_context=None,
-                 server_hostname: Optional[str] = None):
+                 server_hostname: Optional[str] = None,
+                 retries: int = 2,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 breaker_failure_threshold: int = 5,
+                 breaker_reset_seconds: float = 10.0):
         self.host = host
         self.port = port
         self.token = token
+        # dependency identity for breaker state, /readyz reasons, metrics
+        self.dependency = f"engine:{host}:{port}"
+        # retries apply ONLY to _IDEMPOTENT_OPS (reads); transport
+        # failures on writes surface after exactly one attempt
+        self.retries = retries
+        self.retry_policy = retry_policy or RetryPolicy(base=0.05, cap=1.0)
+        self.breaker = breaker or CircuitBreaker(
+            self.dependency,
+            failure_threshold=breaker_failure_threshold,
+            reset_timeout=breaker_reset_seconds)
         # TLS to the engine host (utils/tlsconf.client_ssl_context);
         # server_hostname overrides the SNI/verification name when the
         # dialed address is not the certificate's name (e.g. an IP)
@@ -597,9 +632,16 @@ class RemoteEngine:
 
     # -- transport ----------------------------------------------------------
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, deadline: Optional[Deadline] = None
+                 ) -> socket.socket:
+        failpoints.hit("engine.connect")
+        connect_budget = self.connect_timeout
+        read_budget = self.timeout
+        if deadline is not None:
+            connect_budget = deadline.budget(self.connect_timeout)
+            read_budget = deadline.budget(self.timeout)
         s = socket.create_connection((self.host, self.port),
-                                     timeout=self.connect_timeout)
+                                     timeout=connect_budget)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self.ssl_context is not None:
             try:
@@ -608,10 +650,11 @@ class RemoteEngine:
             except Exception:
                 s.close()
                 raise
-        s.settimeout(self.timeout)
+        s.settimeout(read_budget)
         return s
 
-    def _acquire(self) -> tuple[socket.socket, bool]:
+    def _acquire(self, deadline: Optional[Deadline] = None
+                 ) -> tuple[socket.socket, bool]:
         """(live connection, fresh?): pooled sockets are liveness-probed
         first, so a stale one (engine host restarted, peer FIN pending) is
         replaced BEFORE any request bytes are written — retrying after a
@@ -637,13 +680,14 @@ class RemoteEngine:
                     alive = True
                     probe = None
                 if alive:
-                    s.settimeout(self.timeout)
+                    s.settimeout(self.timeout if deadline is None
+                                 else deadline.budget(self.timeout))
                     return s, False
                 del probe
             except OSError:
                 pass
             s.close()
-        return self._connect(), True
+        return self._connect(deadline), True
 
     def _release(self, s: socket.socket) -> None:
         with self._pool_lock:
@@ -667,12 +711,62 @@ class RemoteEngine:
 
     def _call_any(self, op: str, **args):
         """Like ``_call`` but passes binary responses through as a
-        ``(meta, payload)`` tuple."""
+        ``(meta, payload)`` tuple. Read ops retry transport failures
+        (connect backoff included — a fresh connection is dialed per
+        attempt once the pool is drained); every attempt is accounted to
+        the endpoint's circuit breaker, and an open breaker fails fast
+        with :class:`~..utils.resilience.BreakerOpen` before any
+        connect."""
         msg = {"op": op, **args}
         if self.token:
             msg["token"] = self.token
         payload = _pack(msg)
-        s, fresh = self._acquire()
+        attempts = (self.retries + 1) if op in _IDEMPOTENT_OPS else 1
+        delays = self.retry_policy.delays()
+        # ONE wall-clock budget shared by every attempt: retries against
+        # a host that accepts but never answers must not multiply the
+        # caller's worst-case stall to attempts * read-timeout — the
+        # self.timeout total is the bound either way (per-attempt socket
+        # budgets are derived from what remains)
+        deadline = Deadline.after(self.timeout)
+        while True:
+            attempts -= 1
+            self.breaker.allow()
+            start = time.monotonic()
+            try:
+                resp = self._transact(payload, deadline)
+            except TRANSPORT_ERRORS:
+                self.breaker.record_failure()
+                deadline.check(self.dependency)
+                if attempts <= 0:
+                    raise
+                metrics.counter("proxy_dependency_retries_total",
+                                dependency=self.dependency).inc()
+                time.sleep(min(next(delays), deadline.remaining()))
+                continue
+            except BaseException:
+                # non-transport outcome (protocol/frame error, pre-auth
+                # rejection raised as an error kind): no verdict on the
+                # transport, but the admitted half-open probe slot must
+                # not leak or the breaker wedges open forever
+                self.breaker.release()
+                raise
+            self.breaker.record_success()
+            metrics.histogram("proxy_dependency_seconds",
+                              dependency=self.dependency).observe(
+                time.monotonic() - start)
+            if isinstance(resp, tuple):
+                return resp  # (meta, payload) binary response
+            if resp.get("ok"):
+                return resp.get("result")
+            kind = resp.get("kind", "internal")
+            err = resp.get("error", "")
+            raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
+
+    def _transact(self, payload: bytes,
+                  deadline: Optional[Deadline] = None):
+        """ONE attempt: acquire a live connection, round-trip, release."""
+        s, fresh = self._acquire(deadline)
         try:
             if fresh and self.token and len(payload) > MAX_FRAME_PREAUTH:
                 # the server caps pre-auth frames; upgrade a fresh
@@ -684,22 +778,17 @@ class RemoteEngine:
                     raise _ERROR_KINDS.get(
                         ping.get("kind", "internal"),
                         RemoteEngineError)(ping.get("error", ""))
-            # no retry once bytes are on the wire: the server may have
-            # processed the op even if the connection then died, and
-            # replaying a write would double-apply it (staleness is
-            # handled by the pre-send liveness probe in _acquire)
+            # no retry once bytes are on the wire for WRITES: the server
+            # may have processed the op even if the connection then died,
+            # and replaying a write would double-apply it (staleness is
+            # handled by the pre-send liveness probe in _acquire). Reads
+            # in _IDEMPOTENT_OPS retry at the _call_any layer.
             resp = self._round_trip(s, payload)
         except Exception:
             s.close()
             raise
         self._release(s)
-        if isinstance(resp, tuple):
-            return resp  # (meta, payload) binary response
-        if resp.get("ok"):
-            return resp.get("result")
-        kind = resp.get("kind", "internal")
-        err = resp.get("error", "")
-        raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
+        return resp
 
     def _round_trip(self, s: socket.socket, payload: bytes):
         s.sendall(payload)
@@ -707,6 +796,7 @@ class RemoteEngine:
 
     def _read_response(self, s: socket.socket):
         """A JSON response dict, or (meta, payload) for binary frames."""
+        failpoints.hit("engine.read")
         return _read_frame_sync(s)
 
     # -- engine surface ------------------------------------------------------

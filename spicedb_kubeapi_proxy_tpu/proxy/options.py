@@ -172,6 +172,27 @@ class Options:
     # restarts. 0 disables caching.
     discovery_cache_ttl: float = 600.0
     discovery_cache_dir: Optional[str] = None
+    # -- dependency resilience (utils/resilience.py) -------------------------
+    # per-attempt connect budget and per-request total deadline for the
+    # upstream kube-apiserver (deadline 0 = unlimited; it covers watch
+    # ESTABLISHMENT only, never the long-lived frame stream)
+    upstream_connect_timeout: float = 5.0
+    upstream_request_deadline: float = 30.0
+    # transport retries for idempotent upstream requests (GET/HEAD) that
+    # failed before a status line arrived; writes are never retried
+    upstream_retries: int = 1
+    # tcp:// engine endpoints: per-attempt connect budget, TOTAL
+    # response budget per call (shared across retries, so a stalled host
+    # stalls a handler for at most this long), and transport retries for
+    # read ops (check/lookup/revision — never relationship writes)
+    engine_connect_timeout: float = 10.0
+    engine_read_timeout: float = 300.0
+    engine_retries: int = 2
+    # circuit breakers (one for the upstream, one per engine endpoint):
+    # consecutive transport failures to open, and how long an open
+    # circuit waits before admitting a half-open probe
+    breaker_failure_threshold: int = 5
+    breaker_reset_seconds: float = 10.0
 
     def _parse_remote(self) -> Optional[tuple[str, int]]:
         """(host, port) for tcp:// endpoints, None otherwise; raises on a
@@ -242,6 +263,19 @@ class Options:
                 raise OptionsError(str(e)) from None
         if self.lock_mode not in (LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC):
             raise OptionsError(f"invalid lock mode {self.lock_mode!r}")
+        if self.upstream_retries < 0 or self.engine_retries < 0:
+            raise OptionsError("retry counts must be >= 0")
+        if self.upstream_connect_timeout <= 0 \
+                or self.engine_connect_timeout <= 0 \
+                or self.engine_read_timeout <= 0:
+            raise OptionsError("connect/read timeouts must be > 0")
+        if self.upstream_request_deadline < 0:
+            raise OptionsError(
+                "upstream-request-deadline must be >= 0 (0 = unlimited)")
+        if self.breaker_failure_threshold < 1:
+            raise OptionsError("breaker-failure-threshold must be >= 1")
+        if self.breaker_reset_seconds < 0:
+            raise OptionsError("breaker-reset-seconds must be >= 0")
         if bool(self.tls_cert_file) != bool(self.tls_key_file):
             raise OptionsError(
                 "tls-cert-file and tls-key-file must be set together")
@@ -325,9 +359,15 @@ class Options:
                         self.engine_client_key_file)
                 except TLSConfigError as e:
                     raise OptionsError(str(e)) from None
-            engine = RemoteEngine(*remote, token=self.engine_token,
-                                  ssl_context=ssl_context,
-                                  server_hostname=self.engine_server_name)
+            engine = RemoteEngine(
+                *remote, token=self.engine_token,
+                ssl_context=ssl_context,
+                server_hostname=self.engine_server_name,
+                connect_timeout=self.engine_connect_timeout,
+                timeout=self.engine_read_timeout,
+                retries=self.engine_retries,
+                breaker_failure_threshold=self.breaker_failure_threshold,
+                breaker_reset_seconds=self.breaker_reset_seconds)
         else:
             import os as _os
 
@@ -385,6 +425,11 @@ class Options:
                 client_cert=uc.client_cert,
                 client_key=uc.client_key,
                 insecure_skip_verify=uc.insecure_skip_verify,
+                connect_timeout=self.upstream_connect_timeout,
+                request_deadline=self.upstream_request_deadline,
+                retries=self.upstream_retries,
+                breaker_failure_threshold=self.breaker_failure_threshold,
+                breaker_reset_seconds=self.breaker_reset_seconds,
             )
         workflow = WorkflowEngine(db_path=self.workflow_database_path)
         register_workflows(workflow)
@@ -396,10 +441,16 @@ class Options:
             discovery_cache = DiscoveryCache(
                 ttl=self.discovery_cache_ttl,
                 cache_dir=self.discovery_cache_dir)
+        # breakers surface on /readyz with per-dependency reasons; an
+        # injected upstream/engine without one simply isn't tracked
+        dep_breakers = tuple(
+            b for b in (getattr(upstream, "breaker", None),
+                        getattr(engine, "breaker", None)) if b is not None)
         deps = AuthzDeps(
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
             discovery_cache=discovery_cache,
+            breakers=dep_breakers,
         )
         ssl_context = None
         if self.tls_cert_file:
@@ -462,6 +513,10 @@ class Options:
         "upstream_url", "upstream_insecure", "kubeconfig",
         "kubeconfig_context", "bind_host", "bind_port",
         "workflow_database_path", "lock_mode", "snapshot_path",
+        "upstream_connect_timeout", "upstream_request_deadline",
+        "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
+        "engine_retries", "breaker_failure_threshold",
+        "breaker_reset_seconds",
     )
 
     def debug_dump(self) -> dict:
@@ -598,6 +653,40 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--discovery-cache-dir",
                         help="persist the discovery cache here so it "
                              "survives restarts")
+    parser.add_argument("--upstream-connect-timeout", type=float,
+                        default=5.0,
+                        help="per-attempt connect budget to the upstream "
+                             "kube-apiserver (seconds)")
+    parser.add_argument("--upstream-request-deadline", type=float,
+                        default=30.0,
+                        help="total per-request deadline to the upstream, "
+                             "shared across retries; covers watch "
+                             "establishment only, not the stream "
+                             "(0 = unlimited)")
+    parser.add_argument("--upstream-retries", type=int, default=1,
+                        help="transport retries for idempotent upstream "
+                             "requests (GET/HEAD) that failed before a "
+                             "status line; writes are never retried")
+    parser.add_argument("--engine-connect-timeout", type=float,
+                        default=10.0,
+                        help="per-attempt connect budget to a tcp:// "
+                             "engine host (seconds)")
+    parser.add_argument("--engine-read-timeout", type=float, default=300.0,
+                        help="TOTAL per-call response budget to a tcp:// "
+                             "engine host, shared across retries "
+                             "(generous: the first query after a "
+                             "snapshot refresh pays an XLA compile)")
+    parser.add_argument("--engine-retries", type=int, default=2,
+                        help="transport retries for engine READ ops "
+                             "(check/lookup/revision); relationship "
+                             "writes are never retried")
+    parser.add_argument("--breaker-failure-threshold", type=int, default=5,
+                        help="consecutive transport failures that open a "
+                             "dependency's circuit breaker (fail-fast "
+                             "503s + /readyz unready until it half-opens)")
+    parser.add_argument("--breaker-reset-seconds", type=float, default=10.0,
+                        help="how long an open circuit waits before "
+                             "admitting a half-open probe")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -646,4 +735,12 @@ def options_from_args(args: argparse.Namespace) -> Options:
         feature_gates=args.feature_gates,
         discovery_cache_ttl=args.discovery_cache_ttl,
         discovery_cache_dir=args.discovery_cache_dir,
+        upstream_connect_timeout=args.upstream_connect_timeout,
+        upstream_request_deadline=args.upstream_request_deadline,
+        upstream_retries=args.upstream_retries,
+        engine_connect_timeout=args.engine_connect_timeout,
+        engine_read_timeout=args.engine_read_timeout,
+        engine_retries=args.engine_retries,
+        breaker_failure_threshold=args.breaker_failure_threshold,
+        breaker_reset_seconds=args.breaker_reset_seconds,
     )

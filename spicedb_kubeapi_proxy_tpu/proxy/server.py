@@ -96,7 +96,24 @@ class Server:
         return resp
 
     async def _handle_inner(self, req: ProxyRequest) -> ProxyResponse:
-        if req.path == "/readyz" or req.path == "/livez":
+        if req.path == "/livez":
+            return ProxyResponse(status=200, body=b"ok")
+        if req.path == "/readyz":
+            # readiness is per-dependency: an open circuit breaker on the
+            # upstream kube-apiserver or an engine endpoint makes the
+            # replica unready, with the dependency NAMED in the body
+            # (kube readyz check style) so the operator sees which leg is
+            # degraded — instead of the unconditional 200 that would keep
+            # routing traffic into guaranteed 503s
+            reasons = [(b.dependency, r)
+                       for b in getattr(self.deps, "breakers", ())
+                       if (r := b.open_reason()) is not None]
+            if reasons:
+                body = "".join(f"[-]{dep}: {reason}\n"
+                               for dep, reason in reasons)
+                return ProxyResponse(
+                    status=503, headers={"Content-Type": "text/plain"},
+                    body=body.encode())
             return ProxyResponse(status=200, body=b"ok")
         if req.path == "/metrics":
             return ProxyResponse(

@@ -11,13 +11,25 @@ from __future__ import annotations
 
 import asyncio
 import ssl
+import time
 from typing import AsyncIterator, Optional
 from urllib.parse import urlsplit
 
+from ..utils.failpoints import FailPointError, failpoints
+from ..utils.metrics import metrics
+from ..utils.resilience import CircuitBreaker, Deadline, RetryPolicy
 from .types import ProxyRequest, ProxyResponse
 
 HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "upgrade",
                "proxy-connection", "te", "trailer", "content-length", "host"}
+
+# "the transport failed": the connection could not be established, died,
+# or timed out. These feed the circuit breaker and — pre-response, on
+# idempotent requests only — the retry path. FailPointError is included
+# so the upstream.connect/upstream.read failpoints drive the exact same
+# classification chaos tests need to exercise.
+TRANSPORT_ERRORS = (OSError, asyncio.TimeoutError, TimeoutError,
+                    asyncio.IncompleteReadError, FailPointError)
 
 
 class HttpUpstream:
@@ -32,12 +44,32 @@ class HttpUpstream:
                  ca_file: Optional[str] = None,
                  client_cert: Optional[str] = None,
                  client_key: Optional[str] = None,
-                 insecure_skip_verify: bool = False):
+                 insecure_skip_verify: bool = False,
+                 connect_timeout: float = 5.0,
+                 request_deadline: float = 30.0,
+                 retries: int = 1,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 breaker_failure_threshold: int = 5,
+                 breaker_reset_seconds: float = 10.0):
         u = urlsplit(base_url)
         self.scheme = u.scheme or "http"
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or (443 if self.scheme == "https" else 80)
         self.token = token
+        # per-attempt connect budget and per-request total deadline (0 =
+        # unlimited); a watch's deadline covers establishment only — the
+        # long-lived frame stream is exempt by design
+        self.connect_timeout = connect_timeout
+        self.request_deadline = request_deadline
+        # retries apply ONLY to idempotent requests (GET/HEAD: get, list,
+        # watch establishment) that failed BEFORE a status line arrived —
+        # a write may have been applied even if the response never came
+        self.retries = retries
+        self.retry_policy = retry_policy or RetryPolicy(base=0.05, cap=1.0)
+        self.breaker = breaker or CircuitBreaker(
+            "upstream", failure_threshold=breaker_failure_threshold,
+            reset_timeout=breaker_reset_seconds)
         self._ssl: Optional[ssl.SSLContext] = None
         if self.scheme == "https":
             ctx = ssl.create_default_context(cafile=ca_file)
@@ -49,8 +81,51 @@ class HttpUpstream:
             self._ssl = ctx
 
     async def __call__(self, req: ProxyRequest) -> ProxyResponse:
-        reader, writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self._ssl)
+        deadline = Deadline.after(self.request_deadline)
+        attempts = (self.retries + 1
+                    if req.method.upper() in ("GET", "HEAD") else 1)
+        delays = self.retry_policy.delays()
+        while True:
+            attempts -= 1
+            self.breaker.allow()
+            head_seen = [False]
+            start = time.monotonic()
+            try:
+                resp = await self._attempt(req, deadline, head_seen)
+            except TRANSPORT_ERRORS:
+                self.breaker.record_failure()
+                # an exhausted deadline is terminal even for idempotent
+                # requests: surface it as the 503-mapped family
+                deadline.check("upstream")
+                if attempts <= 0 or head_seen[0]:
+                    raise
+                metrics.counter("proxy_dependency_retries_total",
+                                dependency="upstream").inc()
+                await asyncio.sleep(min(next(delays), deadline.remaining()))
+                continue
+            except BaseException:
+                # non-transport outcome (e.g. the handler task was
+                # cancelled mid-attempt): no verdict on the dependency,
+                # but the admitted half-open probe slot must not leak
+                self.breaker.release()
+                raise
+            self.breaker.record_success()
+            metrics.histogram("proxy_dependency_seconds",
+                              dependency="upstream").observe(
+                time.monotonic() - start)
+            return resp
+
+    async def _attempt(self, req: ProxyRequest, deadline: Deadline,
+                       head_seen: list) -> ProxyResponse:
+        failpoints.hit("upstream.connect")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port, ssl=self._ssl),
+                deadline.budget(self.connect_timeout))
+        except (asyncio.TimeoutError, TimeoutError):
+            raise ConnectionError(
+                f"connect to upstream {self.host}:{self.port} "
+                "timed out") from None
         try:
             headers = {k: v for k, v in req.headers.items()
                        if k.lower() not in HOP_HEADERS
@@ -74,13 +149,17 @@ class HttpUpstream:
                 writer.write(req.body)
             await writer.drain()
 
-            status, resp_headers = await _read_head(reader)
+            failpoints.hit("upstream.read")
+            status, resp_headers = await asyncio.wait_for(
+                _read_head(reader), deadline.budget())
+            head_seen[0] = True
             is_stream = _is_watch(req) and status == 200
             if is_stream:
                 return ProxyResponse(
                     status=status, headers=resp_headers,
                     stream=_stream_body(reader, writer, resp_headers))
-            body = await _read_body(reader, resp_headers)
+            body = await asyncio.wait_for(
+                _read_body(reader, resp_headers), deadline.budget())
             writer.close()
             return ProxyResponse(status=status, headers=resp_headers, body=body)
         except BaseException:
@@ -164,6 +243,22 @@ def _header(headers: dict, name: str) -> Optional[str]:
     return None
 
 
+def _chunk_size(size_line: bytes) -> int:
+    """Chunked-transfer size line -> int. Garbage surfaces as a
+    connection error the way _read_head does for a garbled status line —
+    the retry/error paths classify it as a transport failure instead of
+    a bare ValueError escaping to the panic handler."""
+    try:
+        size = int(size_line.strip().split(b";")[0] or b"0", 16)
+    except ValueError:
+        size = -1  # int() admits a leading '-'; treat both the same
+    if size < 0:
+        raise ConnectionResetError(
+            "upstream sent a garbled chunk-size line "
+            f"({size_line[:40]!r})")
+    return size
+
+
 async def _read_body(reader, headers: dict) -> bytes:
     te = _header(headers, "transfer-encoding") or ""
     if "chunked" in te.lower():
@@ -172,7 +267,7 @@ async def _read_body(reader, headers: dict) -> bytes:
             size_line = await reader.readline()
             if not size_line:
                 break
-            size = int(size_line.strip().split(b";")[0] or b"0", 16)
+            size = _chunk_size(size_line)
             if size == 0:
                 await reader.readline()
                 break
@@ -233,7 +328,7 @@ async def _stream_body(reader, writer, headers: dict) -> AsyncIterator[bytes]:
                 size_line = await reader.readline()
                 if not size_line:
                     break
-                size = int(size_line.strip().split(b";")[0] or b"0", 16)
+                size = _chunk_size(size_line)
                 if size == 0:
                     break
                 data = await reader.readexactly(size)

@@ -1,3 +1,11 @@
-"""Utilities: failpoints, metrics, logging."""
+"""Utilities: failpoints, metrics, resilience (deadlines/retries/breakers)."""
 
 from .failpoints import FailPointError, failpoints  # noqa: F401
+from .resilience import (  # noqa: F401
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    DependencyUnavailable,
+    RetryPolicy,
+)

@@ -10,8 +10,11 @@ stay in production code paths like the reference's activity hooks
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+
+log = logging.getLogger("sdbkp.failpoints")
 
 
 class FailPointError(RuntimeError):
@@ -34,7 +37,15 @@ class _Registry:
                 continue
             if ":" in part:
                 name, count = part.split(":", 1)
-                self.enable(name, int(count))
+                try:
+                    budget = int(count)
+                except ValueError:
+                    # a malformed entry must not take down every process
+                    # importing the package (this runs at import time)
+                    log.warning("ignoring malformed FAILPOINTS entry %r "
+                                "(want name:count)", part)
+                    continue
+                self.enable(name, budget)
             else:
                 self.enable(part, 1)
 

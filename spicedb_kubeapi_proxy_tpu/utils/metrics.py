@@ -1,4 +1,5 @@
-"""Minimal in-process metrics: counters + histograms, Prometheus text format.
+"""Minimal in-process metrics: counters + gauges + histograms, Prometheus
+text format.
 
 The reference advertises metrics support but wires no exporter of its own
 (SURVEY.md §5 — embedded SpiceDB metrics are explicitly disabled); the TPU
@@ -22,6 +23,32 @@ class Counter:
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """A value that can go up and down (breaker state, pool occupancy)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
 
     @property
     def value(self) -> float:
@@ -67,6 +94,7 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[tuple, Counter] = {}
+        self._gauges: dict[tuple, Gauge] = {}
         self._hists: dict[tuple, Histogram] = {}
 
     def counter(self, name: str, **labels) -> Counter:
@@ -76,6 +104,14 @@ class Registry:
             if c is None:
                 c = self._counters[key] = Counter()
             return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = (name,) + tuple(sorted(labels.items()))
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge()
+            return g
 
     def histogram(self, name: str, buckets=None, **labels) -> Histogram:
         key = (name,) + tuple(sorted(labels.items()))
@@ -90,6 +126,8 @@ class Registry:
         with self._lock:
             for key, c in sorted(self._counters.items()):
                 out.append(f"{_fmt(key)} {c.value}")
+            for key, g in sorted(self._gauges.items()):
+                out.append(f"{_fmt(key)} {g.value}")
             for key, h in sorted(self._hists.items()):
                 name = key[0]
                 labels = key[1:]
@@ -100,6 +138,7 @@ class Registry:
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._hists.clear()
 
 

@@ -1,0 +1,455 @@
+"""Failpoint-driven transport chaos for the resilience layer.
+
+Deterministic by construction: failures come from armed failpoints
+(`upstream.connect`, `upstream.read`, `engine.connect`, `engine.read`)
+or from real connection-refused sockets on loopback, backoff schedules
+are injected as all-zero (no sleeps), and breaker clocks are fake.
+
+Covers the ISSUE acceptance pins: upstream dies before the status line
+(GET retried once, POST never), upstream dies mid-watch-stream (partial
+proto frame dropped, partial JSON line surfaced), engine refused then
+recovered (breaker opens -> half-opens -> closes), an open engine
+breaker failing an authorized list CLOSED with a 503 + Retry-After and
+an unready /readyz naming the dependency, and single-attempt writes.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.engine import Engine, WriteOp
+from spicedb_kubeapi_proxy_tpu.engine.remote import (
+    EngineServer,
+    RemoteEngine,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+from spicedb_kubeapi_proxy_tpu.proxy.inmemory import InMemoryClient
+from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest
+from spicedb_kubeapi_proxy_tpu.proxy.upstream import HttpUpstream
+from spicedb_kubeapi_proxy_tpu.utils.failpoints import (
+    FailPointError,
+    failpoints,
+)
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+    STATE_CLOSED,
+    STATE_OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    RetryPolicy,
+)
+
+from fake_kube import FakeKube, serve_upstream
+
+pytestmark = pytest.mark.chaos
+
+NO_BACKOFF = RetryPolicy(base=0.0, cap=0.0)
+
+RULES = open(os.path.join(os.path.dirname(__file__), "..", "deploy",
+                          "rules.yaml")).read()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    failpoints.disable_all()
+    metrics.reset()
+    yield
+    failpoints.disable_all()
+    metrics.reset()
+
+
+def _upstream(port, **kw):
+    kw.setdefault("retries", 1)
+    kw.setdefault("retry_policy", NO_BACKOFF)
+    kw.setdefault("breaker",
+                  CircuitBreaker("upstream", failure_threshold=100))
+    return HttpUpstream(f"http://127.0.0.1:{port}", **kw)
+
+
+# -- upstream: death before the status line ----------------------------------
+
+
+def test_upstream_get_retried_once_on_pre_response_death():
+    async def go():
+        server, port = await serve_upstream(FakeKube())
+        up = _upstream(port)
+        for fp in ("upstream.connect", "upstream.read"):
+            metrics.reset()
+            # one pre-response death: the GET retries once and succeeds
+            failpoints.enable(fp, 1)
+            resp = await up(ProxyRequest(method="GET",
+                                         path="/api/v1/namespaces"))
+            assert resp.status == 200, fp
+            retries = metrics.counter("proxy_dependency_retries_total",
+                                      dependency="upstream")
+            assert retries.value == 1.0, fp
+        # deaths exceeding the retry budget surface the transport error
+        failpoints.enable("upstream.connect", 2)
+        with pytest.raises(FailPointError):
+            await up(ProxyRequest(method="GET", path="/api/v1/namespaces"))
+        server.close()
+    asyncio.run(go())
+
+
+def test_upstream_post_never_retried():
+    async def go():
+        server, port = await serve_upstream(FakeKube())
+        up = _upstream(port, retries=3)
+        # pre-connect death: even this NEVER retries a POST
+        failpoints.enable("upstream.connect", 2)
+        with pytest.raises(FailPointError):
+            await up(ProxyRequest(method="POST", path="/api/v1/namespaces",
+                                  body=b"{}"))
+        assert failpoints.armed("upstream.connect"), \
+            "exactly one attempt: one of the two armed hits must remain"
+        failpoints.disable_all()
+        # post-send death (request bytes are on the wire): same — the
+        # upstream may already be applying the write
+        failpoints.enable("upstream.read", 2)
+        with pytest.raises(FailPointError):
+            await up(ProxyRequest(method="POST", path="/api/v1/namespaces",
+                                  body=b"{}"))
+        assert failpoints.armed("upstream.read")
+        retries = metrics.counter("proxy_dependency_retries_total",
+                                  dependency="upstream")
+        assert retries.value == 0.0
+        server.close()
+    asyncio.run(go())
+
+
+# -- upstream: death mid-watch-stream ----------------------------------------
+
+
+async def _canned_http_server(payload: bytes):
+    """Serve exactly ``payload`` after consuming a request head, then
+    close — an upstream that dies mid-response."""
+    async def conn(reader, writer):
+        try:
+            await reader.readuntil(b"\r\n\r\n")
+            writer.write(payload)
+            await writer.drain()
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(conn, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1]
+
+
+def _watch_req():
+    return ProxyRequest(method="GET", path="/api/v1/namespaces",
+                        query={"watch": ["true"]},
+                        headers={"Accept": "application/json"})
+
+
+def test_upstream_dies_mid_proto_watch_drops_partial_frame():
+    async def go():
+        whole = (3).to_bytes(4, "big") + b"abc"
+        torso = (100).to_bytes(4, "big") + b"only-ten"  # 92 bytes missing
+        head = (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/vnd.kubernetes.protobuf;"
+                b"stream=watch\r\n\r\n")
+        server, port = await _canned_http_server(head + whole + torso)
+        up = _upstream(port)
+        resp = await up(_watch_req())
+        assert resp.status == 200 and resp.stream is not None
+        frames = [f async for f in resp.stream]
+        # the complete frame arrives intact (length prefix preserved for
+        # byte-identical passthrough); the dead connection's torso is
+        # DROPPED, never surfaced as a truncated frame
+        assert frames == [whole]
+        server.close()
+    asyncio.run(go())
+
+
+def test_upstream_dies_mid_json_watch_surfaces_partial_line():
+    async def go():
+        head = (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/json\r\n\r\n")
+        body = b'{"type":"ADDED"}\n{"type":"MODI'  # cut mid-event
+        server, port = await _canned_http_server(head + body)
+        up = _upstream(port)
+        resp = await up(_watch_req())
+        frames = [f async for f in resp.stream]
+        # JSON framing is newline-delimited: the partial tail is still
+        # surfaced (the downstream join refuses to judge it), unlike the
+        # self-describing proto torso above
+        assert frames == [b'{"type":"ADDED"}\n', b'{"type":"MODI']
+        server.close()
+    asyncio.run(go())
+
+
+# -- upstream: garbled chunk-size line ----------------------------------------
+
+
+def test_garbled_chunk_size_is_a_connection_error():
+    async def go():
+        head = (b"HTTP/1.1 200 OK\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n")
+        server, port = await _canned_http_server(head + b"zz-not-hex\r\n")
+        up = _upstream(port, retries=0)
+        with pytest.raises(ConnectionResetError, match="chunk-size"):
+            await up(ProxyRequest(method="GET", path="/api/v1/namespaces"))
+        # a NEGATIVE size parses as an int but is just as garbled — it
+        # must not leak as readexactly's bare ValueError either
+        server_neg, port_neg = await _canned_http_server(head + b"-5\r\n")
+        up_neg = _upstream(port_neg, retries=0)
+        with pytest.raises(ConnectionResetError, match="chunk-size"):
+            await up_neg(ProxyRequest(method="GET",
+                                      path="/api/v1/namespaces"))
+        server_neg.close()
+        # streaming path classifies it the same way: the watch ends
+        # instead of ValueError escaping through the frame iterator
+        server2, port2 = await _canned_http_server(head + b"zz-not-hex\r\n")
+        up2 = _upstream(port2, retries=0)
+        resp = await up2(_watch_req())
+        with pytest.raises(ConnectionResetError, match="chunk-size"):
+            async for _ in resp.stream:
+                pass
+        server.close()
+        server2.close()
+    asyncio.run(go())
+
+
+# -- engine: refused then recovers (breaker full cycle) -----------------------
+
+
+def test_engine_refused_then_recovers_breaker_cycle():
+    async def go():
+        e = Engine()
+        srv = EngineServer(e)
+        port = await srv.start()
+        await srv.stop()  # connections now refused
+        clock = FakeClock()
+        breaker = CircuitBreaker(f"engine:127.0.0.1:{port}",
+                                 failure_threshold=2, reset_timeout=5.0,
+                                 clock=clock)
+        remote = RemoteEngine("127.0.0.1", port, retries=0,
+                              retry_policy=NO_BACKOFF, breaker=breaker)
+        for _ in range(2):
+            with pytest.raises(OSError):
+                await asyncio.to_thread(lambda: remote.revision)
+        assert breaker.state == STATE_OPEN
+        # fail-fast: no socket is touched while open
+        with pytest.raises(BreakerOpen):
+            await asyncio.to_thread(lambda: remote.revision)
+        # the engine host comes back on the same port; after the reset
+        # window the half-open probe succeeds and the circuit closes
+        srv2 = EngineServer(e, port=port)
+        await srv2.start()
+        clock.advance(5.0)
+        assert await asyncio.to_thread(lambda: remote.revision) \
+            == e.revision
+        assert breaker.state == STATE_CLOSED
+        state = metrics.gauge("proxy_dependency_breaker_state",
+                              dependency=f"engine:127.0.0.1:{port}")
+        assert state.value == STATE_CLOSED
+        remote.close()
+        await srv2.stop()
+    asyncio.run(go())
+
+
+def test_engine_stall_is_bounded_by_one_total_deadline():
+    """A host that ACCEPTS but never answers must stall a read for at
+    most ~the read timeout TOTAL — retries share one deadline instead of
+    multiplying the worst case by attempts, and the exhausted budget
+    surfaces as the 503-mapped DeadlineExceeded."""
+    import time as _time
+
+    from spicedb_kubeapi_proxy_tpu.utils.resilience import DeadlineExceeded
+
+    async def go():
+        async def black_hole(reader, writer):
+            await reader.read()  # consume forever, never respond
+
+        server = await asyncio.start_server(black_hole, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        remote = RemoteEngine("127.0.0.1", port, timeout=0.3, retries=5,
+                              retry_policy=NO_BACKOFF,
+                              breaker=CircuitBreaker(
+                                  f"engine:127.0.0.1:{port}",
+                                  failure_threshold=100))
+        t0 = _time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            await asyncio.to_thread(lambda: remote.revision)
+        elapsed = _time.monotonic() - t0
+        # 6 attempts at 0.3s each would be ~1.8s; the shared deadline
+        # caps the whole call near one read-timeout
+        assert elapsed < 1.0, elapsed
+        remote.close()
+        server.close()
+    asyncio.run(go())
+
+
+def test_engine_read_ops_retry_and_count_metrics():
+    async def go():
+        e = Engine()
+        e.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:dev#creator@user:alice"))])
+        srv = EngineServer(e)
+        port = await srv.start()
+        remote = RemoteEngine("127.0.0.1", port, retries=2,
+                              retry_policy=NO_BACKOFF,
+                              breaker=CircuitBreaker(
+                                  f"engine:127.0.0.1:{port}",
+                                  failure_threshold=100))
+        # a read op absorbs transport deaths within its retry budget
+        failpoints.enable("engine.read", 2)
+        ids = await asyncio.to_thread(
+            remote.lookup_resources, "namespace", "view", "user", "alice")
+        assert ids == ["dev"]
+        retries = metrics.counter(
+            "proxy_dependency_retries_total",
+            dependency=f"engine:127.0.0.1:{port}")
+        assert retries.value == 2.0
+        assert "proxy_dependency_retries_total" in metrics.render()
+        remote.close()
+        await srv.stop()
+    asyncio.run(go())
+
+
+def test_engine_writes_never_retried_single_attempt():
+    async def go():
+        e = Engine()
+        srv = EngineServer(e)
+        port = await srv.start()
+        remote = RemoteEngine("127.0.0.1", port, retries=3,
+                              retry_policy=NO_BACKOFF,
+                              breaker=CircuitBreaker(
+                                  f"engine:127.0.0.1:{port}",
+                                  failure_threshold=100))
+        rel = parse_relationship("namespace:dev#creator@user:alice")
+        # post-send failpoint: the request reached the engine host, the
+        # response never came — a replay could double-apply the write
+        failpoints.enable("engine.read", 2)
+        with pytest.raises(FailPointError):
+            await asyncio.to_thread(
+                remote.write_relationships, [WriteOp("touch", rel)])
+        assert failpoints.armed("engine.read"), \
+            "exactly one attempt: one of the two armed hits must remain"
+        failpoints.disable("engine.read")
+        retries = metrics.counter(
+            "proxy_dependency_retries_total",
+            dependency=f"engine:127.0.0.1:{port}")
+        assert retries.value == 0.0
+        # the single attempt DID land server-side even though the client
+        # never saw a response — exactly why replays are unsafe. The
+        # server dispatches the buffered frame asynchronously after the
+        # client hangs up, so wait (bounded) for it to apply.
+        from spicedb_kubeapi_proxy_tpu.engine import CheckItem
+
+        item = CheckItem("namespace", "dev", "view", "user", "alice")
+        for _ in range(200):
+            if e.revision >= 1:
+                break
+            await asyncio.sleep(0.01)
+        assert e.check(item)
+        remote.close()
+        await srv.stop()
+    asyncio.run(go())
+
+
+def test_open_upstream_breaker_fails_dual_write_fast_with_503(tmp_path):
+    """A dual-write against a hard-open upstream breaker gets the same
+    fail-closed 503 + Retry-After as reads — BEFORE the workflow is
+    durably enqueued (a BreakerOpen inside an activity would otherwise
+    burn the workflow retry budget and surface as a 502)."""
+    async def go():
+        fake = FakeKube()
+        upstream_server, upstream_port = await serve_upstream(fake)
+        cfg = Options(
+            rule_content=RULES,
+            upstream_url=f"http://127.0.0.1:{upstream_port}",
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+        ).complete()
+        await cfg.workflow.resume_pending()
+        cfg.deps.upstream.breaker.force_open()
+        alice = InMemoryClient(cfg.server.handle, user="alice")
+        resp = await alice.post("/api/v1/namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "blocked"}})
+        assert resp.status == 503, resp.body
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert json.loads(resp.body)["reason"] == "ServiceUnavailable"
+        # nothing reached the upstream and nothing landed in the graph
+        assert not any(r.method == "POST" for r in fake.requests)
+        await cfg.workflow.shutdown()
+        upstream_server.close()
+    asyncio.run(go())
+
+
+# -- the acceptance pin: fail-closed 503 through the whole proxy --------------
+
+
+def test_open_engine_breaker_fails_list_closed_with_503_and_readyz(tmp_path):
+    async def go():
+        e = Engine()
+        srv = EngineServer(e)
+        port = await srv.start()
+        dep = f"engine:127.0.0.1:{port}"
+        cfg = Options(
+            engine_endpoint=f"tcp://127.0.0.1:{port}",
+            engine_insecure=True,
+            rule_content=RULES,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            engine_retries=0,
+            breaker_failure_threshold=1,
+            breaker_reset_seconds=60.0,
+        ).complete()
+        await cfg.workflow.resume_pending()
+        alice = InMemoryClient(cfg.server.handle, user="alice")
+
+        # healthy baseline: authorized list succeeds, /readyz is 200
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status == 200
+        assert (await alice.get("/readyz")).status == 200
+
+        # the engine host wedges: one transport death trips the breaker
+        failpoints.enable("engine.read", 1)
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status >= 500
+        assert cfg.engine.breaker.state == STATE_OPEN
+
+        # fail-CLOSED and bounded: 503 with Retry-After, never a hang,
+        # never a fail-open 200 list
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status == 503, resp.body
+        status = json.loads(resp.body)
+        assert status["kind"] == "Status"
+        assert status["reason"] == "ServiceUnavailable"
+        assert dep in status["message"]
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert int(resp.headers["Retry-After"]) <= 60
+
+        # /readyz turns unready NAMING the engine dependency
+        resp = await alice.get("/readyz")
+        assert resp.status == 503
+        assert f"[-]{dep}" in resp.body.decode()
+        assert "circuit open" in resp.body.decode()
+        # liveness is about the process, not its dependencies
+        assert (await alice.get("/livez")).status == 200
+
+        # breaker state + failure counters are visible on /metrics
+        body = (await alice.get("/metrics")).body.decode()
+        assert (f'proxy_dependency_breaker_state{{dependency="{dep}"}} '
+                f'{float(STATE_OPEN)}') in body
+        assert 'proxy_dependency_unavailable_total' in body
+
+        await cfg.workflow.shutdown()
+        cfg.engine.close()
+        await srv.stop()
+    asyncio.run(go())

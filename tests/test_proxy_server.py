@@ -685,6 +685,11 @@ def test_chaos_storm_transient_kube_failures(env, lock_mode):
             workflow_database_path=env,
             lock_mode=lock_mode,
             bind_port=0,
+            # this storm orchestrates its own fault budgets; concurrent
+            # bursts can exceed the breaker threshold back-to-back, and a
+            # tripped breaker would fail ops the workflow budget should
+            # absorb (the breaker has dedicated coverage in test_chaos.py)
+            breaker_failure_threshold=100,
         ).complete()
         await cfg.run()
         users = [f"storm{i}" for i in range(3)]
@@ -927,13 +932,17 @@ def test_upstream_dying_mid_request_surfaces_connection_error(env):
             upstream_url=f"http://127.0.0.1:{upstream_port}",
             workflow_database_path=env,
             bind_port=0,
+            # 8 consecutive injected transport failures below; keep the
+            # breaker out of the way (dedicated coverage in test_chaos.py)
+            breaker_failure_threshold=100,
         ).complete()
         await cfg.run()
         alice = HttpClient(cfg.server.port, "alice")
         # a dual-write whose kube writes ALL die mid-request: the workflow
-        # retries then reports cleanly (5xx), no IndexError anywhere
-        # exactly the retry budget (5+1 attempts), so nothing leaks into
-        # the later requests
+        # retries then reports cleanly (5xx), no IndexError anywhere.
+        # The transport layer never retries POSTs, so the workflow budget
+        # consumes exactly the 6 faults (5+1 attempts) and nothing leaks
+        # into the later requests
         fake.fail_next(6, exception=ConnectionResetError("mid-request"))
         status, _, body = await alice.request(
             "POST", "/api/v1/namespaces",
@@ -941,8 +950,13 @@ def test_upstream_dying_mid_request_surfaces_connection_error(env):
                   "metadata": {"name": "dying"}})
         assert status >= 500, (status, body)
         assert b"IndexError" not in body
-        # a read hitting the same fault: clean 5xx too
+        # ONE killed connection on a read: absorbed by the transport
+        # layer's idempotent-GET retry (utils/resilience.py)
         fake.fail_next(1, exception=ConnectionResetError("mid-request"))
+        status, _, body = await alice.request("GET", "/api/v1/namespaces")
+        assert status == 200
+        # a read whose retry ALSO dies: clean 5xx, no IndexError
+        fake.fail_next(2, exception=ConnectionResetError("mid-request"))
         status, _, body = await alice.request("GET", "/api/v1/namespaces")
         assert status >= 500
         assert b"IndexError" not in body

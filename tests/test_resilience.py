@@ -1,0 +1,286 @@
+"""Unit coverage for the dependency-resilience layer
+(utils/resilience.py) and its metrics surface: deadlines, decorrelated
+retry backoff, the circuit-breaker state machine (driven by an injected
+clock — no sleeps), the Gauge metric type, and the FAILPOINTS env-parse
+hardening."""
+
+import math
+import random
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.utils.metrics import Registry, metrics
+from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    DependencyUnavailable,
+    RetryPolicy,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# -- Deadline ----------------------------------------------------------------
+
+
+def test_deadline_budget_derives_per_attempt_timeouts():
+    clock = FakeClock()
+    d = Deadline.after(10.0, clock=clock)
+    # attempt caps clamp to the remaining total
+    assert d.budget(5.0) == 5.0
+    clock.advance(7.0)
+    assert d.budget(5.0) == pytest.approx(3.0)
+    assert d.remaining() == pytest.approx(3.0)
+    assert not d.expired
+    clock.advance(4.0)
+    assert d.expired
+    assert d.remaining() == 0.0
+    with pytest.raises(DeadlineExceeded) as ei:
+        d.check("upstream")
+    assert ei.value.dependency == "upstream"
+
+
+def test_deadline_zero_or_none_means_unlimited():
+    for total in (None, 0, 0.0):
+        d = Deadline.after(total)
+        assert d.remaining() is math.inf
+        assert not d.expired
+        assert d.budget(5.0) == 5.0
+        assert d.budget() is None  # usable as wait_for/settimeout "no limit"
+        d.check("upstream")  # never raises
+
+
+def test_deadline_exceeded_maps_to_dependency_unavailable():
+    assert issubclass(DeadlineExceeded, DependencyUnavailable)
+    assert issubclass(BreakerOpen, DependencyUnavailable)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+
+def test_retry_policy_decorrelated_jitter_bounds():
+    p = RetryPolicy(base=0.1, cap=2.0, rng=random.Random(7))
+    delays = p.delays()
+    prev = p.base
+    for _ in range(50):
+        d = next(delays)
+        assert 0.1 <= d <= 2.0
+        assert d <= max(0.1, prev * 3) + 1e-9
+        prev = max(d, p.base)
+
+
+def test_retry_policy_zero_base_is_sleepless():
+    p = RetryPolicy(base=0.0, cap=0.0)
+    delays = p.delays()
+    assert [next(delays) for _ in range(10)] == [0.0] * 10
+
+
+def test_breaker_check_open_rejects_during_inflight_probe():
+    """check_open must also fail fast while the half-open probe is in
+    flight — a probe can hang up to a full read timeout against a
+    stalled host, and dual-writes must not durably enqueue behind it."""
+    clock = FakeClock()
+    b = CircuitBreaker("upstream", failure_threshold=1, reset_timeout=5.0,
+                       clock=clock)
+    b.allow()
+    b.record_failure()
+    clock.advance(5.0)
+    b.check_open()  # probe-eligible: passes
+    b.allow()  # probe admitted
+    with pytest.raises(BreakerOpen, match="probe in flight"):
+        b.check_open()
+    b.record_success()
+    b.check_open()  # closed again
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+
+def test_breaker_full_state_machine():
+    clock = FakeClock()
+    b = CircuitBreaker("engine:h:1", failure_threshold=3,
+                      reset_timeout=5.0, clock=clock)
+    gauge = metrics.gauge("proxy_dependency_breaker_state",
+                          dependency="engine:h:1")
+    assert b.state == STATE_CLOSED and gauge.value == STATE_CLOSED
+    assert b.open_reason() is None
+
+    # below threshold: stays closed; a success resets the streak
+    for _ in range(2):
+        b.allow()
+        b.record_failure()
+    b.allow()
+    b.record_success()
+    for _ in range(2):
+        b.allow()
+        b.record_failure()
+    assert b.state == STATE_CLOSED
+
+    # threshold consecutive failures -> OPEN, fail-fast with Retry-After
+    b.allow()
+    b.record_failure()
+    assert b.state == STATE_OPEN and gauge.value == STATE_OPEN
+    assert "circuit open" in b.open_reason()
+    clock.advance(2.0)
+    with pytest.raises(BreakerOpen) as ei:
+        b.allow()
+    assert ei.value.dependency == "engine:h:1"
+    assert ei.value.retry_after == pytest.approx(3.0)
+
+    # reset window elapses -> HALF_OPEN admits exactly one probe
+    clock.advance(3.5)
+    b.allow()
+    assert b.state == STATE_HALF_OPEN and gauge.value == STATE_HALF_OPEN
+    with pytest.raises(BreakerOpen):
+        b.allow()  # second concurrent probe rejected
+    # probe failure re-opens with a fresh window
+    b.record_failure()
+    assert b.state == STATE_OPEN
+    with pytest.raises(BreakerOpen):
+        b.allow()
+
+    # next probe succeeds -> CLOSED again
+    clock.advance(5.0)
+    b.allow()
+    b.record_success()
+    assert b.state == STATE_CLOSED and gauge.value == STATE_CLOSED
+    assert b.open_reason() is None
+    rejections = metrics.counter(
+        "proxy_dependency_breaker_rejections_total",
+        dependency="engine:h:1")
+    assert rejections.value == 3.0
+
+
+def test_breaker_release_frees_a_wedged_probe_slot():
+    """A half-open probe that ends in a NON-transport outcome (handler
+    cancelled, protocol error) must release its slot — otherwise the
+    breaker rejects everything forever with no path to recovery."""
+    clock = FakeClock()
+    b = CircuitBreaker("upstream", failure_threshold=1, reset_timeout=5.0,
+                       clock=clock)
+    b.allow()
+    b.record_failure()  # open
+    clock.advance(5.0)
+    b.allow()  # half-open probe admitted
+    assert b.state == STATE_HALF_OPEN
+    b.release()  # probe ended without a transport verdict
+    # state and failure streak unchanged, but the next attempt may probe
+    assert b.state == STATE_HALF_OPEN
+    b.allow()
+    b.record_success()
+    assert b.state == STATE_CLOSED
+
+
+def test_breaker_check_open_fails_fast_without_consuming_probe():
+    clock = FakeClock()
+    b = CircuitBreaker("upstream", failure_threshold=1, reset_timeout=5.0,
+                       clock=clock)
+    b.check_open()  # closed: no-op
+    b.allow()
+    b.record_failure()
+    with pytest.raises(BreakerOpen) as ei:
+        b.check_open()
+    assert ei.value.retry_after == pytest.approx(5.0)
+    # probe-eligible: check_open defers to a real attempt, and it never
+    # consumed the probe slot meanwhile
+    clock.advance(5.0)
+    b.check_open()
+    b.allow()
+    b.record_success()
+    assert b.state == STATE_CLOSED
+
+
+def test_breaker_probe_eligible_reports_ready():
+    """An open breaker past its reset window reports READY on /readyz:
+    unreadiness pulls the replica from rotation, and without traffic
+    allow() — the only open->half-open path — would never run, leaving
+    the replica unready forever after the dependency recovers."""
+    clock = FakeClock()
+    b = CircuitBreaker("engine:h:1", failure_threshold=1, reset_timeout=5.0,
+                       clock=clock)
+    b.allow()
+    b.record_failure()
+    assert "circuit open" in b.open_reason()
+    clock.advance(5.0)
+    assert b.open_reason() is None  # probe-eligible -> back in rotation
+    b.allow()  # traffic returns and probes
+    assert "probing" in b.open_reason()
+    b.record_success()
+    assert b.open_reason() is None
+
+
+def test_breaker_force_open_and_reason_naming():
+    clock = FakeClock()
+    b = CircuitBreaker("upstream", failure_threshold=5, reset_timeout=10.0,
+                       clock=clock)
+    b.force_open()
+    assert b.state == STATE_OPEN
+    assert "next probe in 10.0s" in b.open_reason()
+    with pytest.raises(BreakerOpen):
+        b.allow()
+
+
+# -- Gauge metric type -------------------------------------------------------
+
+
+def test_gauge_set_inc_dec_and_render_format():
+    r = Registry()
+    g = r.gauge("proxy_dependency_breaker_state", dependency="upstream")
+    g.set(2)
+    g.inc()
+    g.dec(0.5)
+    assert g.value == 2.5
+    r.counter("proxy_requests_total").inc()
+    r.histogram("proxy_request_seconds").observe(0.1)
+    text = r.render()
+    assert ('proxy_dependency_breaker_state{dependency="upstream"} 2.5'
+            in text.splitlines())
+    # gauges render alongside counters and histogram _count/_sum
+    assert "proxy_requests_total 1.0" in text
+    assert "proxy_request_seconds_count 1" in text
+    # same (name, labels) key returns the same gauge; reset clears it
+    assert r.gauge("proxy_dependency_breaker_state",
+                   dependency="upstream") is g
+    r.reset()
+    assert "breaker_state" not in r.render()
+
+
+# -- FAILPOINTS env hardening ------------------------------------------------
+
+
+def test_failpoints_malformed_env_entry_is_skipped_not_fatal(monkeypatch):
+    from spicedb_kubeapi_proxy_tpu.utils.failpoints import _Registry
+
+    monkeypatch.setenv("FAILPOINTS",
+                       "broken:abc, ,good:2,bare,also:bad:3")
+    reg = _Registry()  # must not raise despite the malformed entries
+    assert not reg.armed("broken")
+    assert reg.armed("good")
+    assert reg.armed("bare")
+    assert not reg.armed("also")
+    # budgets parsed from the well-formed entries still count down
+    for _ in range(2):
+        with pytest.raises(Exception):
+            reg.hit("good")
+    assert not reg.armed("good")

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-quick chaos bench bench-quick serve-dev demo native lint clean
+.PHONY: test test-quick chaos bench bench-quick bench-smoke serve-dev demo native lint clean
 
 # full suite on the virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -25,6 +25,10 @@ bench:
 
 bench-quick:
 	$(PY) bench.py --quick
+
+# CI-sized bench exercising the full hot path including the decision
+# cache's repeat-traffic phase (cold vs warm p50 + hit rate on stderr)
+bench-smoke: bench-quick
 
 # fully self-contained demo: proxy + in-memory upstream + sample rules
 # on http://127.0.0.1:8080 (the reference's `mage dev:up`+`dev:run` flow

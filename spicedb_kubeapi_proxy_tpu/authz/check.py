@@ -9,6 +9,8 @@ call — a single batched fixpoint on device.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from ..engine import CheckItem, Engine
 from ..rules.compile import RelationshipExpr, RunnableRule
 from ..rules.input import ResolveInput
@@ -27,16 +29,47 @@ def collect_check_items(exprs: list[RelationshipExpr],
     return items
 
 
-def run_checks(engine: Engine, rules: list[RunnableRule],
-               input: ResolveInput, post: bool = False) -> bool:
-    """True iff every generated check passes (fully consistent)."""
+def collect_all_items(rules: list[RunnableRule], input: ResolveInput,
+                      post: bool = False) -> list[CheckItem]:
     items: list[CheckItem] = []
     for r in rules:
         items.extend(collect_check_items(
             r.post_checks if post else r.checks, input))
+    return items
+
+
+def run_checks(engine: Engine, rules: list[RunnableRule],
+               input: ResolveInput, post: bool = False,
+               items: Optional[list[CheckItem]] = None) -> bool:
+    """True iff every generated check passes (fully consistent).
+    ``items`` skips re-generating the check relationships when the caller
+    already collected them (the cached-probe fast path)."""
+    if items is None:
+        items = collect_all_items(rules, input, post)
     if not items:
         return True
     return all(engine.check_bulk(items))
+
+
+def cached_verdict(engine: Engine, rules: list[RunnableRule],
+                   input: ResolveInput, post: bool = False
+                   ) -> tuple[list[CheckItem], Optional[bool]]:
+    """Non-blocking decision-cache probe: ``(items, verdict)`` where
+    ``verdict`` is the combined answer when EVERY generated check hit the
+    engine's decision cache, else ``None`` (caller falls back to
+    :func:`run_checks` off-loop — the probe never dispatches or blocks,
+    so the middleware can run it on the event loop and skip the
+    ``asyncio.to_thread`` hop entirely on a full hit)."""
+    items = collect_all_items(rules, input, post)
+    if not items:
+        return items, True
+    probe = getattr(engine, "try_cached_check", None)
+    if probe is None:  # remote engines have no local cache to probe
+        return items, None
+    got = probe(items)
+    if got is None:
+        return items, None
+    return items, all(got)
 
 
 def has_checks(rules: list[RunnableRule]) -> bool:

@@ -35,7 +35,7 @@ from ..utils.resilience import DependencyUnavailable
 from ..rules.expr import ExprError
 from ..rules.input import ResolveInput, UserInfo
 from ..rules.matcher import MapMatcher, RequestMeta
-from .check import run_checks
+from .check import cached_verdict, run_checks
 from .filterer import apply_filter
 from .lookups import PreFilterError, run_prefilter, single_prefilter
 from .postfilter import filter_list_response
@@ -132,11 +132,18 @@ async def _authorize_inner(req: ProxyRequest,
             "Forbidden")
 
     try:
-        # to_thread keeps the event loop free while the device query's
-        # readback is in flight (concurrent requests pipeline their
-        # dispatches; the reference fans checks out over goroutines,
-        # check.go:77-93)
-        if not await asyncio.to_thread(run_checks, deps.engine, rules, input):
+        # non-blocking decision-cache probe first: a full hit answers on
+        # the event loop with zero thread handoff (the repeat-heavy
+        # serving shape — same rule set, same subject — pays only dict
+        # lookups); any miss falls to the to_thread path, which keeps the
+        # loop free while the device query's readback is in flight
+        # (concurrent requests pipeline their dispatches; the reference
+        # fans checks out over goroutines, check.go:77-93)
+        items, verdict = cached_verdict(deps.engine, rules, input)
+        if verdict is None:
+            verdict = await asyncio.to_thread(
+                run_checks, deps.engine, rules, input, items=items)
+        if not verdict:
             return kube_status(
                 403,
                 f"user {user.name!r} is not permitted to {info.verb} "
@@ -233,8 +240,13 @@ async def _authorize_inner(req: ProxyRequest,
     if info.verb == "get" and resp.status < 300 \
        and any(r.post_checks for r in rules):
         try:
-            if not await asyncio.to_thread(
-                    run_checks, deps.engine, rules, input, post=True):
+            post_items, post_verdict = cached_verdict(
+                deps.engine, rules, input, post=True)
+            if post_verdict is None:
+                post_verdict = await asyncio.to_thread(
+                    run_checks, deps.engine, rules, input, post=True,
+                    items=post_items)
+            if not post_verdict:
                 return kube_status(
                     403,
                     f"user {user.name!r} is not permitted to {info.verb} "

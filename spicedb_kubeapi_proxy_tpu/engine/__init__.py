@@ -17,5 +17,6 @@ from .store import (  # noqa: F401
     StoreError,
     WriteOp,
 )
+from .decision_cache import DecisionCache  # noqa: F401
 from .evaluator import OracleEvaluator  # noqa: F401
 from .engine import CheckItem, Engine, WatchEvent  # noqa: F401

@@ -71,6 +71,7 @@ class LookupBatcher:
         self._lock = threading.Lock()
         self._pending: list[tuple] = []  # (args tuple, BatchedLookup)
         self._timer: Optional[threading.Timer] = None
+        self._closed = False
 
     def submit(self, resource_type: str, permission: str, subject_type: str,
                subject_id: str,
@@ -78,21 +79,32 @@ class LookupBatcher:
         """Only now-less lookups batch (callers pinning an explicit
         evaluation time bypass the batcher — the engine dispatches those
         directly), so one dispatch-time clock is correct for the whole
-        fused batch, exactly like the unbatched path."""
+        fused batch, exactly like the unbatched path.
+
+        A late submit racing ``close()`` (disable_lookup_batching during
+        shutdown reads ``engine._batcher`` before it is nulled) falls
+        through to the direct engine path instead of queueing into a dead
+        batcher whose timer will never fire."""
         fut = BatchedLookup()
         with self._lock:
-            self._pending.append(
-                ((resource_type, permission, subject_type, subject_id,
-                  subject_relation), fut))
-            n = len(self._pending)
-            if n >= self.max_rows:
-                batch = self._take_locked()
-            else:
-                batch = None
-                if n == 1:
-                    self._timer = threading.Timer(self.window, self._on_timer)
+            closed = self._closed
+            batch = None
+            if not closed:
+                self._pending.append(
+                    ((resource_type, permission, subject_type, subject_id,
+                      subject_relation), fut))
+                n = len(self._pending)
+                if n >= self.max_rows:
+                    batch = self._take_locked()
+                elif n == 1:
+                    self._timer = threading.Timer(self.window,
+                                                  self._on_timer)
                     self._timer.daemon = True
                     self._timer.start()
+        if closed:
+            return self.engine._lookup_direct(
+                resource_type, permission, subject_type, subject_id,
+                subject_relation, None)
         if batch:
             self._flush(batch)
         return fut
@@ -204,7 +216,10 @@ class LookupBatcher:
             pos += n
 
     def close(self) -> None:
+        """Flush the pending batch and mark the batcher dead: submits
+        from here on bypass it entirely (direct engine path)."""
         with self._lock:
+            self._closed = True
             batch = self._take_locked()
         if batch:
             self._flush(batch)

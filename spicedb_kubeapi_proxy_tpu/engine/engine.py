@@ -28,6 +28,7 @@ from ..ops.reachability import (
     incremental_update,
 )
 from ..utils.metrics import metrics
+from .decision_cache import DecisionCache, MISS, check_key, lookup_key
 from .evaluator import OracleEvaluator
 from .store import (
     Precondition,
@@ -124,6 +125,7 @@ class Engine:
         self._lock = threading.RLock()
         self._compiled: Optional[CompiledGraph] = None
         self._batcher = None
+        self._decision_cache: Optional[DecisionCache] = None
         # host-side (q_slots, q_batch) arrays per (offset, size): a mask
         # lookup's query arrays are a pure function of the slot layout, so
         # rebuilding 2x400KB of arange/zeros per request is waste (their
@@ -147,9 +149,32 @@ class Engine:
         self._batcher = LookupBatcher(self, window=window, max_rows=max_rows)
 
     def disable_lookup_batching(self) -> None:
-        """Revert to one device dispatch per lookup (in-flight batched
-        futures resolve normally; only new submissions are affected)."""
-        self._batcher = None
+        """Revert to one device dispatch per lookup. The retired batcher
+        is closed: its pending batch flushes, and any racing submit that
+        still holds a reference falls through to the direct engine path
+        instead of queueing into a dead batcher."""
+        b, self._batcher = self._batcher, None
+        if b is not None:
+            b.close()
+
+    def enable_decision_cache(self, max_entries: int = 65536,
+                              max_mask_bytes: int = 256 << 20) -> None:
+        """Serve byte-identical repeat queries at an unchanged store
+        revision from a revision-keyed LRU instead of re-dispatching, and
+        coalesce concurrent identical misses into one dispatch
+        (engine/decision_cache.py). Semantics are unchanged: writes bump
+        the revision (new keys), expiring tuples bound every entry with
+        the store's next-expiry watermark, and explicit-``now`` queries
+        bypass the cache entirely."""
+        self._decision_cache = DecisionCache(max_entries=max_entries,
+                                             max_mask_bytes=max_mask_bytes)
+
+    def disable_decision_cache(self) -> None:
+        """Drop the cache (gauges zeroed); in-flight fills resolve but
+        are no longer consulted."""
+        c, self._decision_cache = self._decision_cache, None
+        if c is not None:
+            c.clear()
 
     # -- write path ---------------------------------------------------------
 
@@ -307,6 +332,33 @@ class Engine:
         one bulk RPC per request; here the whole bulk is one fixpoint)."""
         return self.check_bulk_async(items, now=now).result()
 
+    def try_cached_check(self, items: list[CheckItem]
+                         ) -> Optional[list[bool]]:
+        """Non-blocking decision-cache probe: the full verdict list when
+        EVERY item is a hit at the current revision, else ``None``
+        (a partial answer is useless to the authz chain — it would
+        dispatch anyway). Never compiles, never dispatches, never blocks
+        beyond a shard lock: callers on an event loop can probe before
+        paying the ``asyncio.to_thread`` handoff
+        (authz/middleware.py)."""
+        cache = self._decision_cache
+        if cache is None:
+            return None
+        if not items:
+            return []
+        rev = self.store.revision
+        now = time.time()
+        out: list[bool] = []
+        for it in items:
+            v = cache.get(check_key(rev, it), now, record=False)
+            if v is MISS:
+                return None
+            out.append(v)
+        # counted only once the WHOLE probe served (partial probes fall
+        # through to check_bulk_async, which records its own hits/misses)
+        cache.note_hits("check", len(out))
+        return out
+
     def _backend(self, cg: CompiledGraph):
         """The query executor for a compiled graph: the graph itself
         (single device) or a mesh-pinned ShardedGraph, rebuilt whenever the
@@ -396,10 +448,61 @@ class Engine:
     def check_bulk_async(self, items: list[CheckItem],
                          now: Optional[float] = None) -> "EngineFuture":
         """Dispatch a bulk check without blocking (device→host readback
-        overlaps with other in-flight queries); ``.result()`` to wait."""
+        overlaps with other in-flight queries); ``.result()`` to wait.
+
+        With the decision cache enabled (and no explicit ``now`` — a
+        pinned clock must see the store exactly as of that instant, so it
+        bypasses the cache), per-item verdicts are served from the cache
+        and only the miss residue dispatches; the answer list reassembles
+        in the caller's order. Verdicts — positive and negative — are
+        cached keyed by the snapshot revision with the store's
+        next-expiry watermark as deadline."""
+        cache = self._decision_cache
+        if cache is None or now is not None or not items:
+            return self._check_bulk_dispatch(items, now)
+        # pin ONE compiled snapshot for the whole bulk — hits are keyed
+        # at its revision and the miss residue dispatches against the
+        # same graph, so the answer list reflects a single revision even
+        # when a write lands mid-call (the uncached path's one-snapshot
+        # guarantee)
+        cg = self.compiled()
+        now0 = time.time()
+        keys = [check_key(cg.revision, it) for it in items]
+        out: list = [None] * len(items)
+        miss_idx: list[int] = []
+        for i, k in enumerate(keys):
+            v = cache.get(k, now0)
+            if v is MISS:
+                miss_idx.append(i)
+            else:
+                out[i] = v
+        if not miss_idx:
+            return EngineFuture(None, lambda _: list(out))
+        inner = self._check_bulk_dispatch(
+            [items[i] for i in miss_idx], now0, cg=cg)
+
+        def fin(_):
+            got = inner.result()
+            deadline = self.store.next_expiry(now0)
+            for j, i in enumerate(miss_idx):
+                v = bool(got[j])
+                cache.put(keys[i], v, deadline, 0, now0)
+                out[i] = v
+            return list(out)
+
+        return EngineFuture(None, fin, iters=inner.iterations)
+
+    def _check_bulk_dispatch(self, items: list[CheckItem],
+                             now: Optional[float] = None,
+                             cg: Optional[CompiledGraph] = None
+                             ) -> "EngineFuture":
+        """The raw (cache-less) bulk check: one chunked device pass.
+        ``cg`` pins an already-obtained snapshot (the cached path passes
+        the graph its hits were keyed against)."""
         if not items:
             return EngineFuture(None, lambda _: [])
-        cg = self.compiled()
+        if cg is None:
+            cg = self.compiled()
         objs = self._objects_by_name()
         t0 = time.perf_counter()
         backend = self._backend(cg)
@@ -468,7 +571,75 @@ class Engine:
         Concurrent list requests dispatch back-to-back and overlap their
         readbacks — the reference's goroutine-per-prefilter overlap
         (pkg/authz/responsefilterer.go:165-183) without the goroutines.
-        With batching enabled, concurrent calls fuse into one dispatch."""
+        With batching enabled, concurrent calls fuse into one dispatch.
+
+        The decision cache (when enabled, now-less queries only) sits in
+        front of everything: repeats at an unchanged revision are served
+        host-side with zero device work, and concurrent identical misses
+        singleflight — one caller dispatches (through the batcher when
+        enabled, which therefore only ever sees true misses), the rest
+        piggyback on its future. Cached masks are copied on read so no
+        caller can mutate the cache's array."""
+        cache = self._decision_cache
+        if cache is None or now is not None:
+            return self._lookup_submit(resource_type, permission,
+                                       subject_type, subject_id,
+                                       subject_relation, now)
+        rev = self.compiled().revision
+        key = lookup_key(rev, resource_type, permission, subject_type,
+                         subject_id, subject_relation)
+        now0 = time.time()
+        hit = cache.get(key, now0)
+        if hit is not MISS:
+            mask, interner = hit
+            return EngineFuture(None, lambda _: (
+                None if mask is None else mask.copy(), interner))
+        leader, flight = cache.flight(key, now0)
+        if not leader:
+
+            def fin_follower(_):
+                mask, interner = flight.result()
+                return (None if mask is None else mask.copy(), interner)
+
+            return EngineFuture(None, fin_follower)
+        try:
+            inner = self._lookup_submit(resource_type, permission,
+                                        subject_type, subject_id,
+                                        subject_relation, None)
+        except BaseException as e:  # dispatch died before a future existed
+            flight.abort(e)
+            cache.release(key, flight)
+            raise
+
+        def finish():
+            try:
+                value = inner.result()
+            except BaseException:
+                cache.release(key, flight)  # errors are never cached
+                raise
+            mask, interner = value
+            deadline = self.store.next_expiry(now0)
+            flight.deadline = deadline
+            cache.put(key, (mask, interner), deadline,
+                      0 if mask is None else int(mask.nbytes), now0)
+            cache.release(key, flight)
+            return value
+
+        flight.launch(finish)
+
+        def fin_leader(_):
+            mask, interner = flight.result()
+            return (None if mask is None else mask.copy(), interner)
+
+        return EngineFuture(None, fin_leader,
+                            iters=getattr(inner, "iterations", None))
+
+    def _lookup_submit(self, resource_type: str, permission: str,
+                       subject_type: str, subject_id: str,
+                       subject_relation: Optional[str],
+                       now: Optional[float]):
+        """Route one true-miss lookup: fused through the batcher when
+        enabled, direct otherwise."""
         if self._batcher is not None and now is None:
             # explicit-now callers bypass the batcher: a fused batch runs
             # at one dispatch-time clock, which is only equivalent to the
@@ -476,12 +647,24 @@ class Engine:
             return self._batcher.submit(
                 resource_type, permission, subject_type, subject_id,
                 subject_relation)
+        return self._lookup_direct(resource_type, permission, subject_type,
+                                   subject_id, subject_relation, now)
+
+    def _lookup_direct(self, resource_type: str, permission: str,
+                       subject_type: str, subject_id: str,
+                       subject_relation: Optional[str],
+                       now: Optional[float]):
         cg = self.compiled()
         objs = self._objects_by_name()
         off = cg.offset_of(resource_type, permission)
         n = cg.type_sizes.get(resource_type)
         interner = objs.get(resource_type)
         if off is None or interner is None:
+            # trivial lookups (unknown type/permission) count too — the
+            # batched path already counts them in LookupBatcher._dispatch,
+            # and tests read engine_lookups_total as "lookups the engine
+            # answered", cache hits excluded
+            metrics.counter("engine_lookups_total").inc()
             return EngineFuture(None, lambda _: (None, None))
         seeds = np.asarray(
             [cg.encode_subject(subject_type, subject_id, subject_relation, objs)],

@@ -1003,6 +1003,22 @@ def main(argv=None) -> int:
                          "seconds (0 = off). No effect on --distributed "
                          "hosts: mirrored lookups pin their evaluation "
                          "time for SPMD lockstep, which bypasses fusion")
+    from ..proxy.options import parse_bool_flag
+
+    ap.add_argument("--authz-cache", type=parse_bool_flag, nargs="?",
+                    const=True, default=True, metavar="BOOL",
+                    help="revision-keyed decision cache + singleflight: "
+                         "identical checks/lookups at an unchanged "
+                         "revision serve host-side, shared across ALL "
+                         "connected proxy replicas (default on). No "
+                         "effect on --distributed hosts: mirrored "
+                         "queries pin their evaluation time, which "
+                         "bypasses the cache")
+    ap.add_argument("--authz-cache-size", type=int, default=65536,
+                    help="max cached decisions (LRU entries)")
+    ap.add_argument("--authz-cache-mask-bytes", type=int,
+                    default=256 << 20,
+                    help="resident lookup-mask byte budget")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -1081,6 +1097,10 @@ def main(argv=None) -> int:
     engine = Engine(bootstrap=bootstrap, mesh=mesh)
     if args.lookup_batch_window > 0:
         engine.enable_lookup_batching(args.lookup_batch_window)
+    if args.authz_cache:
+        engine.enable_decision_cache(
+            max_entries=args.authz_cache_size,
+            max_mask_bytes=args.authz_cache_mask_bytes)
     if engine.load_snapshot_if_exists(args.snapshot_path):
         log.info("loaded snapshot %s (revision %d)", args.snapshot_path,
                  engine.revision)

@@ -285,6 +285,13 @@ class Store:
         # kube "resourceVersion too old" semantics)
         self.watch_retention = 1_000_000
         self._watch_oldest_rev = 0
+        # (revision, sorted unique finite expirations of live rows): the
+        # decision cache's expiration watermark, rebuilt lazily at most
+        # once per revision (engine/decision_cache.py). _has_finite_exp
+        # is the monotone fast path: stores that never wrote an expiring
+        # tuple (the common deployment) skip the rebuild scan entirely.
+        self._expiry_bounds: Optional[tuple] = None
+        self._has_finite_exp = False
 
     # -- interning helpers -------------------------------------------------
 
@@ -465,12 +472,16 @@ class Store:
                     WatchRecord(rev, OP_TOUCH, self._extern_rel(key, exp)))
             if new_rows:
                 keys = np.array([k for k, _ in new_rows], dtype=np.int32)
+                exp_col = np.array([e for _, e in new_rows],
+                                   dtype=np.float64)
                 cols = Columns(
                     keys[:, 0].copy(), keys[:, 1].copy(), keys[:, 2].copy(),
                     keys[:, 3].copy(), keys[:, 4].copy(), keys[:, 5].copy(),
-                    np.array([e for _, e in new_rows], dtype=np.float64),
+                    exp_col,
                 )
                 self._append_rows(cols)
+                if not self._has_finite_exp and np.isfinite(exp_col).any():
+                    self._has_finite_exp = True
             self._trim_watch_log()
             self.revision = rev
             self._watch_cond.notify_all()
@@ -512,6 +523,8 @@ class Store:
                    else np.full(n, NO_EXPIRATION))
             exp = np.where(np.isnan(exp), NO_EXPIRATION, exp)
             self._append_rows(Columns(rt, rid, rl, st, sid, srl, exp))
+            if not self._has_finite_exp and np.isfinite(exp).any():
+                self._has_finite_exp = True
             self.revision += 1
             self.unlogged_revision = self.revision
             self._watch_cond.notify_all()
@@ -578,6 +591,35 @@ class Store:
                 self.revision = rev
                 self._watch_cond.notify_all()
             return count
+
+    def next_expiry(self, now: float) -> float:
+        """Earliest expiration boundary strictly after ``now`` among live
+        tuples — the decision cache's per-snapshot validity watermark:
+        a result computed at ``now`` stays exact until this instant (the
+        clock cannot revoke or grant anything in between; writes bump the
+        revision and change the cache key instead). ``+inf`` when no live
+        tuple carries a finite expiration.
+
+        Cheap: stores that never wrote an expiring tuple answer from a
+        flag without touching a row; otherwise the sorted boundary array
+        is rebuilt at most once per revision (lazily, on first ask) and
+        each call is a binary search."""
+        with self._lock:
+            if not self._has_finite_exp:
+                return float("inf")
+            ent = self._expiry_bounds
+            if ent is None or ent[0] != self.revision:
+                vals = []
+                for cols, alive in zip(self._chunks, self._alive):
+                    sel = alive & np.isfinite(cols.exp)
+                    if sel.any():
+                        vals.append(cols.exp[sel])
+                arr = (np.unique(np.concatenate(vals)) if vals
+                       else np.empty(0, dtype=np.float64))
+                self._expiry_bounds = ent = (self.revision, arr)
+            arr = ent[1]
+            i = int(np.searchsorted(arr, now, side="right"))
+            return float(arr[i]) if i < len(arr) else float("inf")
 
     def _trim_watch_log(self) -> None:
         # caller holds the lock
@@ -698,6 +740,11 @@ class Store:
             self._alive = [np.ones(len(cols), dtype=bool)]
             self._index = StoreIndex()
             self._start_index_prebuild()
+            # a restored store may land on the SAME revision number with
+            # different rows — the revision check alone would serve the
+            # old lineage's expiration watermark
+            self._expiry_bounds = None
+            self._has_finite_exp = bool(np.isfinite(cols.exp).any())
             self.revision = int(meta["revision"])
             self.unlogged_revision = self.revision
             self._watch_log = []

@@ -34,6 +34,19 @@ class OptionsError(ValueError):
     pass
 
 
+def parse_bool_flag(v) -> bool:
+    """argparse type for ``--flag``, ``--flag=true`` and ``--flag=false``
+    (kube-style boolean flags; used by --authz-cache, default on)."""
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("1", "true", "t", "yes", "y", "on"):
+        return True
+    if s in ("0", "false", "f", "no", "n", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
+
+
 def _parse_mesh_spec(spec: str) -> dict:
     """Mesh spec parsing (parallel/mesh.py), re-raised as OptionsError."""
     from ..parallel.mesh import MeshSpecError, parse_mesh_spec
@@ -152,6 +165,15 @@ class Options:
     # >0 coalesces concurrent list prefilters into fused device dispatches
     # (seconds of added latency traded for per-dispatch amortization)
     lookup_batch_window: float = 0.0
+    # revision-keyed decision cache + singleflight on the authorization
+    # hot path (engine/decision_cache.py): repeats at an unchanged store
+    # revision serve host-side with zero device dispatches. In-process
+    # engines only (a tcp:// engine host caches on the host; pass the
+    # same flags there). Default ON; --authz-cache=false restores the
+    # byte-identical uncached behavior.
+    authz_cache: bool = True
+    authz_cache_size: int = 65536  # max cached decisions (LRU entries)
+    authz_cache_mask_bytes: int = 256 << 20  # resident lookup-mask budget
     # >0 probes the device backend in a SUBPROCESS with this timeout
     # before building an in-process engine: the remotely-attached TPU
     # plugin HANGS (not errors) when its tunnel is down, which would
@@ -276,6 +298,10 @@ class Options:
             raise OptionsError("breaker-failure-threshold must be >= 1")
         if self.breaker_reset_seconds < 0:
             raise OptionsError("breaker-reset-seconds must be >= 0")
+        if self.authz_cache_size < 1:
+            raise OptionsError("authz-cache-size must be >= 1")
+        if self.authz_cache_mask_bytes < 0:
+            raise OptionsError("authz-cache-mask-bytes must be >= 0")
         if bool(self.tls_cert_file) != bool(self.tls_key_file):
             raise OptionsError(
                 "tls-cert-file and tls-key-file must be set together")
@@ -396,6 +422,10 @@ class Options:
             engine.load_snapshot_if_exists(self.snapshot_path)
             if self.lookup_batch_window > 0:
                 engine.enable_lookup_batching(self.lookup_batch_window)
+            if self.authz_cache:
+                engine.enable_decision_cache(
+                    max_entries=self.authz_cache_size,
+                    max_mask_bytes=self.authz_cache_mask_bytes)
         upstream = self.upstream
         if upstream is None:
             from .kubeconfig import UpstreamConfig
@@ -513,6 +543,7 @@ class Options:
         "upstream_url", "upstream_insecure", "kubeconfig",
         "kubeconfig_context", "bind_host", "bind_port",
         "workflow_database_path", "lock_mode", "snapshot_path",
+        "authz_cache", "authz_cache_size", "authz_cache_mask_bytes",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
@@ -631,6 +662,24 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="seconds to hold a list prefilter for fusing "
                              "concurrent lookups into one device dispatch "
                              "(0 disables)")
+    parser.add_argument("--authz-cache", type=parse_bool_flag,
+                        nargs="?", const=True, default=True,
+                        metavar="BOOL",
+                        help="revision-keyed decision cache + "
+                             "singleflight on the authorization hot "
+                             "path: identical checks/lookups at an "
+                             "unchanged store revision serve host-side "
+                             "with zero device dispatches (default on; "
+                             "--authz-cache=false disables; in-process "
+                             "engines only — pass the same flags to a "
+                             "tcp:// engine host)")
+    parser.add_argument("--authz-cache-size", type=int, default=65536,
+                        help="max cached decisions (LRU entries, check "
+                             "verdicts and lookup masks combined)")
+    parser.add_argument("--authz-cache-mask-bytes", type=int,
+                        default=256 << 20,
+                        help="resident lookup-mask byte budget; the "
+                             "cold end evicts past it")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
     parser.add_argument("--enable-debug-config", action="store_true",
@@ -729,6 +778,9 @@ def options_from_args(args: argparse.Namespace) -> Options:
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,
         lookup_batch_window=args.lookup_batch_window,
+        authz_cache=args.authz_cache,
+        authz_cache_size=args.authz_cache_size,
+        authz_cache_mask_bytes=args.authz_cache_mask_bytes,
         engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,

@@ -129,3 +129,30 @@ def test_concurrent_threads_fuse():
         np.testing.assert_array_equal(results[u], want[u])
     # the 8 concurrent lookups fused into at most a few dispatches
     assert metrics.counter("engine_lookup_batches_total").value >= 1
+
+
+def test_close_marks_batcher_dead_and_submits_fall_through():
+    # a submit racing disable_lookup_batching (shutdown) must not queue
+    # into a dead batcher whose timer will never fire
+    e = build(batch_window=60.0, max_rows=100)  # nothing flushes on its own
+    b = e._batcher
+    b.close()
+    fut = b.submit("ns", "view", "user", "u3", None)
+    mask, interner = fut.result()  # direct engine path, no window wait
+    want, _ = build().lookup_resources_mask("ns", "view", "user", "u3")
+    np.testing.assert_array_equal(mask, want)
+
+
+def test_disable_lookup_batching_closes_and_flushes():
+    e = build(batch_window=60.0, max_rows=100)
+    b = e._batcher
+    pending = e.lookup_resources_mask_async("ns", "view", "user", "u1")
+    e.disable_lookup_batching()
+    assert b._closed
+    # the pending lookup was flushed by close(), not abandoned
+    mask, interner = pending.result()
+    want, _ = build().lookup_resources_mask("ns", "view", "user", "u1")
+    np.testing.assert_array_equal(mask, want)
+    # new lookups take the direct path
+    m2, _ = e.lookup_resources_mask("ns", "view", "user", "u2")
+    assert m2 is not None

@@ -32,6 +32,8 @@ Subpackages
                  (reference pkg/proxy, pkg/inmemory)
 - ``dtx``      — durable dual-write workflow engine
                  (reference pkg/authz/distributedtx)
+- ``persistence`` — store durability: segmented write-ahead log,
+                 snapshot checkpoints, crash recovery (``--data-dir``)
 - ``utils``    — failpoints, metrics, logging
 """
 

@@ -126,6 +126,7 @@ class Engine:
         self._compiled: Optional[CompiledGraph] = None
         self._batcher = None
         self._decision_cache: Optional[DecisionCache] = None
+        self._persistence = None  # persistence/manager.py, opt-in
         # host-side (q_slots, q_batch) arrays per (offset, size): a mask
         # lookup's query arrays are a pure function of the slot layout, so
         # rebuilding 2x400KB of arange/zeros per request is waste (their
@@ -175,6 +176,37 @@ class Engine:
         c, self._decision_cache = self._decision_cache, None
         if c is not None:
             c.clear()
+
+    def enable_persistence(self, data_dir: str, **kw):
+        """Make the relationship store durable under ``data_dir``
+        (``--data-dir``): recover whatever a previous process left there
+        (newest valid snapshot + WAL tail, persistence/recovery.py), then
+        journal every subsequent mutation through a write-ahead log with
+        background snapshot checkpoints. Returns the
+        :class:`~..persistence.Persistence` manager (its ``.recovery``
+        says what was restored). Keyword args pass through to
+        ``Persistence.open`` (wal_fsync, checkpoint thresholds...)."""
+        from ..persistence import Persistence
+
+        with self._lock:
+            if self._persistence is not None:
+                raise RuntimeError("persistence is already enabled")
+            p = Persistence.open(self.store, data_dir, **kw)
+            self._persistence = p
+            self._compiled = None  # recovery replaced the store contents
+        return p
+
+    def close_persistence(self, final_checkpoint: bool = True) -> None:
+        """Graceful shutdown of the durability layer (fsync + by default
+        a final checkpoint so the next boot replays nothing)."""
+        with self._lock:
+            p, self._persistence = self._persistence, None
+        if p is not None:
+            p.close(final_checkpoint=final_checkpoint)
+
+    @property
+    def persistence(self):
+        return self._persistence
 
     # -- write path ---------------------------------------------------------
 
@@ -721,6 +753,12 @@ class Engine:
 
     def load_snapshot(self, path: str) -> None:
         with self._lock:
+            if self._persistence is not None:
+                # a file restore bypasses the journal: the WAL would
+                # replay over the wrong lineage on the next boot
+                raise StoreError(
+                    "load_snapshot is incompatible with an enabled "
+                    "persistence data dir (recovery owns restores)")
             self.store.load(path)
             self._compiled = None
 

@@ -302,7 +302,7 @@ class EngineServer:
                         and req.get("op") == "mirror_subscribe":
                     # multi-host follower: stream every mirrored engine
                     # action (parallel/multihost.py MirroredEngine)
-                    await self._push_mirror(writer)
+                    await self._push_mirror(writer, req)
                     return
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
@@ -451,18 +451,43 @@ class EngineServer:
     def _op_mirror_subscribe(self, req: dict):
         """Ack for a multi-host follower subscription; _serve_inner then
         switches the connection into the mirror-push loop. Only valid
-        when the engine is a MirroredEngine leader."""
+        when the engine is a MirroredEngine leader. An optional
+        ``from_revision`` (a restarting follower's recovered revision)
+        makes the stream open with a catch-up frame — the delta from the
+        leader's watch history, or a full state transfer when that
+        history no longer reaches back far enough."""
         if not hasattr(self.engine, "subscribe"):
             raise StoreError(
                 "engine host is not a multi-host leader "
                 "(no MirroredEngine)")
+        if "from_revision" in req:
+            int(req["from_revision"])  # validate now, fail as a JSON error
+            if not hasattr(self.engine, "subscribe_with_catchup"):
+                raise StoreError(
+                    "engine host does not support follower catch-up")
         return {"subscribed": True}
 
-    async def _push_mirror(self, writer: asyncio.StreamWriter) -> None:
+    async def _push_mirror(self, writer: asyncio.StreamWriter,
+                           req: dict) -> None:
         import queue as _queue
 
-        q = self.engine.subscribe()
+        if "from_revision" in req:
+            # atomic cut (multihost.py subscribe_with_catchup): the
+            # catch-up lands the follower at exactly the revision the
+            # queued live frames continue from
+            q, meta, payload = await self._in_worker(
+                self.engine.subscribe_with_catchup,
+                int(req["from_revision"]))
+        else:
+            q, meta, payload = self.engine.subscribe(), None, None
         try:
+            if meta is not None:
+                frame = {"ok": True, "catchup": meta}
+                if payload is not None:
+                    writer.write(_pack_binary(BinaryResult(frame, payload)))
+                else:
+                    writer.write(_pack(frame))
+                await writer.drain()
             while True:
                 try:
                     wire = await self._in_worker(
@@ -982,7 +1007,25 @@ def main(argv=None) -> int:
                          "certificate verification")
     ap.add_argument("--snapshot-path",
                     help="relationship-store snapshot: loaded at boot if "
-                         "present, saved on graceful shutdown")
+                         "present, saved on graceful shutdown (superseded "
+                         "by --data-dir, which also survives SIGKILL)")
+    ap.add_argument("--data-dir",
+                    help="durable persistence directory (persistence/): "
+                         "write-ahead log + snapshot checkpoints; crash "
+                         "recovery replays the WAL tail at boot. Unset = "
+                         "in-memory store (today's behavior)")
+    ap.add_argument("--wal-fsync", default="interval:100",
+                    help="WAL fsync policy: always | interval:<ms> | off "
+                         "(default interval:100)")
+    ap.add_argument("--checkpoint-wal-bytes", type=int, default=64 << 20,
+                    help="snapshot-checkpoint the store once this many "
+                         "WAL bytes accumulate since the last checkpoint")
+    ap.add_argument("--checkpoint-wal-records", type=int, default=50000,
+                    help="...or this many WAL records, whichever first")
+    ap.add_argument("--checkpoint-keep", type=int, default=2,
+                    help="snapshot generations to retain (the WAL is "
+                         "pruned only up to the OLDEST kept one, so "
+                         "recovery can fall back a generation)")
     ap.add_argument("--engine-mesh",
                     help="device mesh for this host's chips: 'auto' or "
                          "'data=D,graph=G' (the engine host owns the mesh; "
@@ -1093,8 +1136,29 @@ def main(argv=None) -> int:
         except ValueError as e:  # MeshSpecError or axis/device mismatch
             ap.error(str(e))
         log.info("engine mesh: %s", dict(mesh.shape))
+    if args.data_dir and args.snapshot_path:
+        ap.error("--data-dir and --snapshot-path are mutually exclusive "
+                 "(the data dir owns snapshots AND the write-ahead log)")
+    from ..persistence.wal import WalError, parse_fsync_policy
+
+    if args.data_dir:
+        try:
+            parse_fsync_policy(args.wal_fsync)
+        except WalError as e:
+            ap.error(str(e))
     bootstrap = "\n---\n".join(open(f).read() for f in args.bootstrap) or None
     engine = Engine(bootstrap=bootstrap, mesh=mesh)
+    persistence = None
+    if args.data_dir:
+        persistence = engine.enable_persistence(
+            args.data_dir, wal_fsync=args.wal_fsync,
+            checkpoint_wal_bytes=args.checkpoint_wal_bytes,
+            checkpoint_wal_records=args.checkpoint_wal_records,
+            checkpoint_keep=args.checkpoint_keep)
+        log.info("persistence: %s (recovered revision %d, %d WAL "
+                 "records replayed)", args.data_dir,
+                 persistence.recovery.revision,
+                 persistence.recovery.replayed_records)
     if args.lookup_batch_window > 0:
         engine.enable_lookup_batching(args.lookup_batch_window)
     if args.authz_cache:
@@ -1105,14 +1169,23 @@ def main(argv=None) -> int:
         log.info("loaded snapshot %s (revision %d)", args.snapshot_path,
                  engine.revision)
     if args.distributed and process_id > 0:
-        # follower: replay the leader's mirror stream until it ends
+        # follower: replay the leader's mirror stream until it ends; a
+        # persistent follower resumes from its own recovered revision
+        # (the leader catches it up from its watch history / a state
+        # transfer instead of requiring a process-lifetime stream)
         from ..parallel.multihost import follower_loop
 
         host, _, port = args.mirror_leader.rpartition(":")
         log.info("following leader %s:%s%s", host, port,
                  " (TLS)" if mirror_ssl else "")
-        follower_loop(engine, host, int(port), token=args.token,
-                      ssl_context=mirror_ssl)
+        try:
+            follower_loop(engine, host, int(port), token=args.token,
+                          ssl_context=mirror_ssl,
+                          from_revision=(engine.revision
+                                         if persistence is not None
+                                         else None))
+        finally:
+            engine.close_persistence()
         return 0
     if args.distributed:
         from ..parallel.multihost import MirroredEngine
@@ -1135,6 +1208,12 @@ def main(argv=None) -> int:
         if args.snapshot_path:
             engine.save_snapshot(args.snapshot_path)
             log.info("saved snapshot to %s", args.snapshot_path)
+        if persistence is not None:
+            # final checkpoint + WAL fsync: the next boot loads one
+            # snapshot and replays zero records
+            await asyncio.get_running_loop().run_in_executor(
+                None, engine.close_persistence)
+            log.info("persistence closed (checkpointed %s)", args.data_dir)
 
     asyncio.run(serve())
     return 0

@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Iterator, Optional
 
 import numpy as np
@@ -292,6 +292,12 @@ class Store:
         # tuple (the common deployment) skip the rebuild scan entirely.
         self._expiry_bounds: Optional[tuple] = None
         self._has_finite_exp = False
+        # durability hook (persistence/manager.py): called UNDER the
+        # write lock with (record_meta, blob) after each revision-
+        # advancing mutation, so journal order == revision order and the
+        # record is on disk before the transaction returns. None = the
+        # store is purely in-memory (default; every existing caller).
+        self.journal = None
 
     # -- interning helpers -------------------------------------------------
 
@@ -458,18 +464,24 @@ class Store:
             # Pass 2 — apply.
             rev = self.revision + 1
             new_rows: list[tuple[tuple, float]] = []
+            journaled = self.journal is not None
+            effects: list[dict] = []  # journal record (concrete, replayable)
             for code, key, exp in plan:
                 pos = idx.get(key, self._alive)
                 if pos is not None:
                     self._alive[pos[0]][pos[1]] = False
                 if code == OP_DELETE:
+                    rel = self._extern_rel(key, NO_EXPIRATION)
                     self._watch_log.append(
-                        WatchRecord(rev, OP_DELETE,
-                                    self._extern_rel(key, NO_EXPIRATION)))
+                        WatchRecord(rev, OP_DELETE, rel))
+                    if journaled:
+                        effects.append({"op": "delete", "rel": asdict(rel)})
                     continue
                 new_rows.append((key, exp))
-                self._watch_log.append(
-                    WatchRecord(rev, OP_TOUCH, self._extern_rel(key, exp)))
+                rel = self._extern_rel(key, exp)
+                self._watch_log.append(WatchRecord(rev, OP_TOUCH, rel))
+                if journaled:
+                    effects.append({"op": "touch", "rel": asdict(rel)})
             if new_rows:
                 keys = np.array([k for k, _ in new_rows], dtype=np.int32)
                 exp_col = np.array([e for _, e in new_rows],
@@ -484,15 +496,26 @@ class Store:
                     self._has_finite_exp = True
             self._trim_watch_log()
             self.revision = rev
+            if self.journal is not None:
+                self.journal({"kind": "write", "rev": rev,
+                              "effects": effects}, None)
             self._watch_cond.notify_all()
             return rev
 
-    def bulk_load(self, rels_cols: dict) -> int:
+    def bulk_load(self, rels_cols: dict,
+                  _revision: Optional[int] = None) -> int:
         """Fast path for large graph loads (bench setup): columnar string
         arrays {resource_type, resource_id, relation, subject_type,
         subject_id, subject_relation?, expiration?}. Rows are assumed
-        deduplicated. Not logged to watch."""
+        deduplicated. Not logged to watch. ``_revision`` pins the
+        assigned revision — the WAL replay path (persistence/recovery.py)
+        re-applies a journaled load at the revision it was acknowledged
+        with."""
         with self._lock:
+            if _revision is not None and _revision <= self.revision:
+                raise StoreError(
+                    f"bulk_load replay revision {_revision} is not past "
+                    f"current revision {self.revision}")
             n = len(rels_cols["resource_id"])
 
             def intern_typed(type_col, id_col):
@@ -525,8 +548,14 @@ class Store:
             self._append_rows(Columns(rt, rid, rl, st, sid, srl, exp))
             if not self._has_finite_exp and np.isfinite(exp).any():
                 self._has_finite_exp = True
-            self.revision += 1
+            self.revision = (_revision if _revision is not None
+                             else self.revision + 1)
             self.unlogged_revision = self.revision
+            if self.journal is not None:
+                from ..persistence.codec import encode_bulk_cols
+
+                self.journal({"kind": "bulk_load", "rev": self.revision},
+                             encode_bulk_cols(rels_cols))
             self._watch_cond.notify_all()
             self._start_index_prebuild()
             return self.revision
@@ -572,6 +601,8 @@ class Store:
                     )
             count = 0
             rev = self.revision + 1
+            journaled = self.journal is not None
+            effects: list[dict] = []
             for cols, alive in zip(self._chunks, self._alive):
                 mask = self._filter_mask(cols, f, now=now) & alive
                 rows = np.flatnonzero(mask)
@@ -583,14 +614,82 @@ class Store:
                     key = (int(cols.rt[ri]), int(cols.rid[ri]), int(cols.rl[ri]),
                            int(cols.st[ri]), int(cols.sid[ri]), int(cols.srl[ri]))
                     # the index needs no touch-up: lookups check aliveness
-                    self._watch_log.append(
-                        WatchRecord(rev, OP_DELETE,
-                                    self._extern_rel(key, NO_EXPIRATION)))
+                    rel = self._extern_rel(key, NO_EXPIRATION)
+                    self._watch_log.append(WatchRecord(rev, OP_DELETE, rel))
+                    if journaled:
+                        effects.append({"op": "delete", "rel": asdict(rel)})
             if count:
                 self._trim_watch_log()
                 self.revision = rev
+                if self.journal is not None:
+                    self.journal({"kind": "delete", "rev": rev,
+                                  "effects": effects}, None)
                 self._watch_cond.notify_all()
             return count
+
+    def apply_effects(self, effects: list, revision: int) -> None:
+        """Replay hook: apply concrete touch/delete effects and pin the
+        revision. Two callers — WAL replay at boot (persistence/
+        recovery.py) and follower catch-up over the mirror protocol
+        (parallel/multihost.py) — both re-applying decisions a live
+        ``write``/``delete_by_filter`` already made, so there are no
+        preconditions, no duplicate checks, and no clock reads here.
+        Within one call the LAST effect per key wins (a catch-up batch
+        spans many revisions; the store jumps straight to the final
+        state). Nothing lands in the watch log: replayed history is a new
+        lineage for watchers (same contract as a snapshot restore), and
+        ``unlogged_revision`` advances so incremental graph updates
+        restart from the recovered point."""
+        with self._lock:
+            revision = int(revision)
+            if revision <= self.revision:
+                raise StoreError(
+                    f"apply_effects revision {revision} is not past "
+                    f"current revision {self.revision}")
+            idx = self._ensure_index()
+            final: dict[tuple, Optional[float]] = {}
+            journaled: list[dict] = []
+            for eff in effects:
+                rel = eff["rel"]
+                if isinstance(rel, dict):
+                    rel = Relationship(**rel)
+                key = self._intern_rel(rel)
+                if eff["op"] == "delete":
+                    final[key] = None
+                else:
+                    final[key] = (float(rel.expiration)
+                                  if rel.expiration is not None
+                                  else float(NO_EXPIRATION))
+                journaled.append({"op": eff["op"], "rel": asdict(rel)})
+            new_rows: list[tuple[tuple, float]] = []
+            for key, exp in final.items():
+                pos = idx.get(key, self._alive)
+                if pos is not None:
+                    self._alive[pos[0]][pos[1]] = False
+                if exp is not None:
+                    new_rows.append((key, exp))
+            if new_rows:
+                keys = np.array([k for k, _ in new_rows], dtype=np.int32)
+                exp_col = np.array([e for _, e in new_rows],
+                                   dtype=np.float64)
+                self._append_rows(Columns(
+                    keys[:, 0].copy(), keys[:, 1].copy(), keys[:, 2].copy(),
+                    keys[:, 3].copy(), keys[:, 4].copy(), keys[:, 5].copy(),
+                    exp_col,
+                ))
+                if not self._has_finite_exp and np.isfinite(exp_col).any():
+                    self._has_finite_exp = True
+            self._expiry_bounds = None
+            self.revision = revision
+            self.unlogged_revision = revision
+            # watchers from before the jump must re-list (their revisions
+            # describe history this store never logged) — same contract
+            # as a snapshot restore
+            self._watch_oldest_rev = revision
+            if self.journal is not None:
+                self.journal({"kind": "apply", "rev": revision,
+                              "effects": journaled}, None)
+            self._watch_cond.notify_all()
 
     def next_expiry(self, now: float) -> float:
         """Earliest expiration boundary strictly after ``now`` among live
@@ -665,15 +764,10 @@ class Store:
 
     # -- durability ---------------------------------------------------------
 
-    def save(self, path: str) -> None:
-        """Persist the store to one compressed npz: live rows compacted
-        into a single chunk plus the interner string tables. The watch log
-        is NOT persisted — a watcher resuming against a restored store gets
-        the kube "resourceVersion too old" treatment (re-list + re-watch),
-        the same contract as crossing the in-memory retention horizon."""
-        import json
-        import os
-
+    def _collect_state(self) -> tuple["Columns", dict]:
+        """(compacted live columns, meta) under the lock — the snapshot
+        payload shared by file saves and the follower full-state wire
+        transfer."""
         with self._lock:
             live = [cols.take(np.flatnonzero(alive))
                     for cols, alive in zip(self._chunks, self._alive)
@@ -686,6 +780,20 @@ class Store:
                 "objects": {str(tid): it.strings()
                             for tid, it in self.objects.items()},
             }
+        return cols, meta
+
+    def save(self, path: str) -> int:
+        """Persist the store to one compressed npz: live rows compacted
+        into a single chunk plus the interner string tables; returns the
+        saved revision (the checkpointer stamps it into the snapshot file
+        name). The watch log is NOT persisted — a watcher resuming
+        against a restored store gets the kube "resourceVersion too old"
+        treatment (re-list + re-watch), the same contract as crossing the
+        in-memory retention horizon."""
+        import json
+        import os
+
+        cols, meta = self._collect_state()
         import tempfile
 
         # unique temp per save (mkstemp, not pid-keyed: concurrent saves in
@@ -702,6 +810,12 @@ class Store:
                     meta=np.frombuffer(json.dumps(meta).encode(),
                                        dtype=np.uint8),
                 )
+                # data blocks must be durable BEFORE the rename publishes
+                # the file: the checkpointer prunes WAL segments on the
+                # strength of this snapshot existing, and a power loss
+                # must not leave a directory entry pointing at page cache
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except BaseException:
             try:
@@ -709,19 +823,66 @@ class Store:
             except OSError:
                 pass
             raise
+        return int(meta["revision"])
+
+    @staticmethod
+    def encode_state(cols: "Columns", meta: dict) -> bytes:
+        """Serialize a ``_collect_state`` pair to the snapshot npz
+        format. Static and lock-free on purpose: the collected arrays
+        are immutable copies, so a caller holding ordering-critical
+        locks (the mirror lock during follower catch-up) can collect
+        under the lock and pay the compression outside it."""
+        import io
+        import json
+
+        bio = io.BytesIO()
+        np.savez_compressed(
+            bio, rt=cols.rt, rid=cols.rid, rl=cols.rl, st=cols.st,
+            sid=cols.sid, srl=cols.srl, exp=cols.exp,
+            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        )
+        return bio.getvalue()
+
+    def state_bytes(self) -> tuple[int, bytes]:
+        """(revision, full-state payload): the save() npz, in memory —
+        the leader->follower catch-up transfer when the follower's
+        resume revision predates the leader's retained watch history
+        (engine/remote.py mirror_subscribe from_revision)."""
+        cols, meta = self._collect_state()
+        return int(meta["revision"]), self.encode_state(cols, meta)
+
+    @staticmethod
+    def _parse_state(z) -> tuple[dict, "Columns"]:
+        import json
+
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        cols = Columns(
+            z["rt"].astype(np.int32), z["rid"].astype(np.int32),
+            z["rl"].astype(np.int32), z["st"].astype(np.int32),
+            z["sid"].astype(np.int32), z["srl"].astype(np.int32),
+            z["exp"].astype(np.float64),
+        )
+        return meta, cols
 
     def load(self, path: str) -> None:
         """Replace this store's contents with a saved snapshot."""
-        import json
-
         with np.load(path) as z:
-            meta = json.loads(bytes(z["meta"].tobytes()).decode())
-            cols = Columns(
-                z["rt"].astype(np.int32), z["rid"].astype(np.int32),
-                z["rl"].astype(np.int32), z["st"].astype(np.int32),
-                z["sid"].astype(np.int32), z["srl"].astype(np.int32),
-                z["exp"].astype(np.float64),
-            )
+            meta, cols = self._parse_state(z)
+        self._install_state(meta, cols)
+
+    def load_state_bytes(self, payload: bytes) -> None:
+        """Replace this store's contents from a :meth:`state_bytes`
+        payload (follower full-state catch-up). Journaled as a
+        ``load_state`` record so a follower restart recovers the
+        transferred baseline too."""
+        import io
+
+        with np.load(io.BytesIO(payload)) as z:
+            meta, cols = self._parse_state(z)
+        self._install_state(meta, cols, journal_payload=payload)
+
+    def _install_state(self, meta: dict, cols: "Columns",
+                       journal_payload: Optional[bytes] = None) -> None:
         with self._lock:
             self.epoch = uuid.uuid4().hex  # cached id maps are now invalid
             self.types = Interner()
@@ -752,6 +913,9 @@ class Store:
             # (their revisions describe a different store lineage) — make
             # watch_since raise instead of silently returning no events
             self._watch_oldest_rev = self.revision
+            if self.journal is not None and journal_payload is not None:
+                self.journal({"kind": "load_state", "rev": self.revision},
+                             journal_payload)
             self._watch_cond.notify_all()
 
     def snapshot(self) -> Snapshot:

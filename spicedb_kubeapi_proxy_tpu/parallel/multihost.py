@@ -132,6 +132,69 @@ class MirroredEngine:
                 self._joined.set()
         return q
 
+    def subscribe_with_catchup(self, from_revision: int):
+        """(queue, catch-up meta, optional state payload) for a RESUMING
+        follower (``mirror_subscribe`` with ``from_revision``).
+
+        The queue registers FIRST — a plain :meth:`subscribe`, so the
+        join barrier counts this follower immediately and a leader
+        parked in ``_publish`` waiting for it can proceed (taking the
+        mirror lock before subscribing would deadlock that barrier).
+        The consistent cut then happens under the mirror lock, which
+        excludes in-flight publish+execute pairs: the catch-up state
+        reflects every action sequenced at or before ``meta["seq"]``,
+        and the follower SKIPS queued frames with ``seq <=`` that value
+        (they are already inside the catch-up) — nothing double-applies,
+        nothing is missed.
+
+        Catch-up forms, cheapest first: already-current (nothing),
+        effects replay from the leader's retained watch history, or a
+        full compacted state transfer (the follower's revision predates
+        retained history or a bulk load)."""
+        from dataclasses import asdict
+
+        from ..engine.store import OP_DELETE, StoreError
+
+        q = self.subscribe()
+        with self._lock:
+            with self._subs_lock:
+                seq = self._seq
+            store = self.engine.store
+            rev = store.revision
+            if from_revision == rev:
+                return q, {"revision": rev, "seq": seq}, None
+            if from_revision > rev:
+                # the follower claims MORE history than the leader has:
+                # a lost leader disk or a rolled-back fsync window — the
+                # lineages diverged, and "already current" would freeze
+                # the divergence. Force a full state transfer onto the
+                # leader's lineage (the source of truth for serving).
+                log.warning(
+                    "follower resume revision %d is ahead of leader "
+                    "revision %d (diverged lineage); sending full state",
+                    from_revision, rev)
+            elif from_revision >= store.unlogged_revision:
+                try:
+                    records = store.watch_since(from_revision)
+                except StoreError:
+                    records = None
+                if records is not None:
+                    effects = [
+                        {"op": "delete" if r.op == OP_DELETE else "touch",
+                         "rel": asdict(r.rel)}
+                        for r in records
+                    ]
+                    return q, {"revision": rev, "seq": seq,
+                               "effects": effects}, None
+            # full state transfer: COLLECT under the lock (the arrays are
+            # immutable copies cut consistently with `seq`)...
+            cols, meta = store._collect_state()
+        # ...but compress OUTSIDE it — savez_compressed over a multi-GB
+        # store must not stall every leader write and mirrored query
+        payload = store.encode_state(cols, meta)
+        return q, {"revision": int(meta["revision"]), "seq": seq,
+                   "state": True}, payload
+
     def unsubscribe(self, q) -> None:
         with self._subs_lock:
             if q in self._subs:
@@ -210,14 +273,16 @@ class MirroredEngine:
             return self.engine.delete_relationships(f, list(preconditions))
 
     def bulk_load(self, rels_cols):
-        # columnar payloads can be huge; mirror them as plain lists (the
-        # one-time load path, not the hot path)
+        # columnar payloads are huge: ride the binary-payload frame (the
+        # npz columnar codec, persistence/codec.py) like the hot
+        # check_bulk batches do, instead of serializing one JSON string
+        # per cell — a 1M-relationship load is one C-speed encode, built
+        # LAZILY so a subscriber-less leader pays nothing
+        from ..persistence.codec import encode_bulk_cols
+
         with self._lock:
-            self._publish("bulk_load", {
-                "cols": {k: [str(x) for x in v] if k != "expiration"
-                         else [None if x != x else float(x) for x in v]
-                         for k, v in rels_cols.items()},
-            })
+            self._publish("bulk_load", {},
+                          blob=lambda: encode_bulk_cols(rels_cols))
             return self.engine.bulk_load(rels_cols)
 
     # -- mirrored queries ----------------------------------------------------
@@ -383,17 +448,23 @@ def _apply_one(engine, frame: dict, m: str,
             [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
              for p in frame.get("preconditions", [])])
     elif m == "bulk_load":
-        import numpy as np
+        if blob is not None:
+            from ..persistence.codec import decode_bulk_cols
 
-        cols = {}
-        for k, v in frame["cols"].items():
-            if k == "expiration":
-                cols[k] = np.asarray(
-                    [np.nan if x is None else x for x in v],
-                    dtype=np.float64)
-            else:
-                cols[k] = np.asarray(v, dtype=object)
-        engine.bulk_load(cols)
+            engine.bulk_load(decode_bulk_cols(blob))
+        else:
+            # legacy JSON-list frame from an older leader
+            import numpy as np
+
+            cols = {}
+            for k, v in frame["cols"].items():
+                if k == "expiration":
+                    cols[k] = np.asarray(
+                        [np.nan if x is None else x for x in v],
+                        dtype=np.float64)
+                else:
+                    cols[k] = np.asarray(v, dtype=object)
+            engine.bulk_load(cols)
     elif m == "check_bulk":
         items = decode_check_items(blob) if blob is not None \
             else [CheckItem(*it) for it in frame["items"]]
@@ -407,16 +478,47 @@ def _apply_one(engine, frame: dict, m: str,
         raise MultiHostError(f"unknown mirror method {m!r}")
 
 
+def apply_catchup(engine, meta: dict, blob: Optional[bytes]) -> None:
+    """Apply a leader catch-up frame on the follower: a full compacted
+    state transfer (binary payload) or a concrete effects replay, both
+    landing the store exactly at the leader's revision. No-op when the
+    follower was already current."""
+    if blob is not None:
+        engine.store.load_state_bytes(blob)
+        # a diverged-lineage transfer can land on the SAME revision
+        # number with different rows — the revision check alone would
+        # keep serving the old lineage's compiled graph
+        if hasattr(engine, "_compiled"):
+            with engine._lock:
+                engine._compiled = None
+        log.info("catch-up: installed leader state at revision %d",
+                 engine.store.revision)
+        return
+    effects = meta.get("effects")
+    if effects:
+        engine.store.apply_effects(effects, int(meta["revision"]))
+        log.info("catch-up: applied %d effects to revision %d",
+                 len(effects), engine.store.revision)
+
+
 def follower_loop(engine, leader_host: str, leader_port: int,
                   token: Optional[str] = None,
                   ssl_context=None,
-                  server_hostname: Optional[str] = None) -> None:
+                  server_hostname: Optional[str] = None,
+                  from_revision: Optional[int] = None) -> None:
     """Blocking follower: subscribe to the leader's mirror stream and
     replay every action on the local engine — the device dispatches then
     meet the leader's inside the shard_map collectives. Returns when
     the leader closes the connection; raises on protocol errors.
     ``ssl_context`` wraps the subscription in TLS (the leader serves the
-    ordinary engine endpoint, which is TLS unless --engine-insecure)."""
+    ordinary engine endpoint, which is TLS unless --engine-insecure).
+
+    ``from_revision`` (a restarting follower's own recovered revision —
+    ``engine.revision`` after ``enable_persistence``) asks the leader for
+    catch-up: the delta since that revision arrives as the stream's first
+    frame (effects replay or a full state transfer) before live mirror
+    frames, so rejoining needs no manual bulk_load and no unbroken
+    process-lifetime stream."""
     import socket
     import struct
     import time as _time
@@ -450,6 +552,8 @@ def follower_loop(engine, leader_host: str, leader_port: int,
     # supervisor)
     s.settimeout(EngineServer.PUSH_HEARTBEAT * 3 + 5.0)
     msg = {"op": "mirror_subscribe"}
+    if from_revision is not None:
+        msg["from_revision"] = int(from_revision)
     if token:
         msg["token"] = token
     try:
@@ -458,6 +562,7 @@ def follower_loop(engine, leader_host: str, leader_port: int,
         if isinstance(ack, tuple) or not ack.get("ok"):
             raise MultiHostError(f"mirror subscribe rejected: {ack}")
         expect = None
+        skip_upto = None
         while True:
             frame = _read_frame_sync(s)
             blob = None
@@ -469,6 +574,12 @@ def follower_loop(engine, leader_host: str, leader_port: int,
                 raise MultiHostError(f"mirror stream error: {frame}")
             if frame.get("hb"):
                 continue  # idle-stream liveness heartbeat
+            if "catchup" in frame:
+                apply_catchup(engine, frame["catchup"], blob)
+                # actions sequenced at or before the cut are inside the
+                # catch-up state; queued frames up to it must be skipped
+                skip_upto = frame["catchup"].get("seq")
+                continue
             payload = frame["frame"]
             # first frame sets the baseline (a leader cannot have served
             # traffic before followers joined — its collectives would
@@ -479,6 +590,8 @@ def follower_loop(engine, leader_host: str, leader_port: int,
                 raise MultiHostError(
                     f"mirror gap: expected seq {expect}, "
                     f"got {payload['seq']}")
+            if skip_upto is not None and payload["seq"] <= skip_upto:
+                continue  # already covered by the catch-up cut
             apply_mirror_frame(engine, payload, blob)
     except (ConnectionResetError, struct.error):
         return  # leader went away: the process set restarts as a unit

@@ -1,0 +1,119 @@
+"""Persistence manager: the one object an engine owns when ``--data-dir``
+is configured.
+
+``Persistence.open(store, data_dir)`` runs crash recovery (recovery.py),
+opens the WAL for append (wal.py), installs the store's journal hook so
+every subsequent revision-advancing mutation is logged before its
+transaction returns, and starts the background checkpointer
+(snapshot.py). ``close()`` unhooks, takes a final checkpoint (so the
+next boot replays nothing), and fsyncs.
+
+Directory layout::
+
+    <data-dir>/
+      wal/        wal-<first-revision>.seg ...
+      snapshots/  snapshot-<revision>.npz ...
+      dtx.sqlite  (the dual-write workflow DB, wired by proxy options)
+
+Persistence is strictly opt-in: with no data dir configured the store
+behaves exactly as before — in-memory, revision counter reset on boot —
+which is what every existing test and the embedded engine get.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from .recovery import RecoveryResult, recover
+from .snapshot import (
+    Checkpointer,
+    DEFAULT_CHECKPOINT_WAL_BYTES,
+    DEFAULT_CHECKPOINT_WAL_RECORDS,
+    DEFAULT_KEEP,
+)
+from .wal import DEFAULT_FSYNC, DEFAULT_SEGMENT_BYTES, WriteAheadLog
+
+log = logging.getLogger("sdbkp.persistence")
+
+
+class Persistence:
+    """Owns the WAL + checkpointer for one store. Construct via
+    :meth:`open`."""
+
+    def __init__(self, store, data_dir: str, wal: WriteAheadLog,
+                 checkpointer: Optional[Checkpointer],
+                 recovery: RecoveryResult):
+        self.store = store
+        self.data_dir = data_dir
+        self.wal = wal
+        self.checkpointer = checkpointer
+        self.recovery = recovery
+        self._closed = False
+
+    @classmethod
+    def open(cls, store, data_dir: str,
+             wal_fsync: str = DEFAULT_FSYNC,
+             segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+             checkpoint_wal_bytes: int = DEFAULT_CHECKPOINT_WAL_BYTES,
+             checkpoint_wal_records: int = DEFAULT_CHECKPOINT_WAL_RECORDS,
+             checkpoint_keep: int = DEFAULT_KEEP,
+             auto_checkpoint: bool = True) -> "Persistence":
+        os.makedirs(data_dir, exist_ok=True)
+        wal_dir = os.path.join(data_dir, "wal")
+        snap_dir = os.path.join(data_dir, "snapshots")
+        res = recover(store, data_dir)
+        wal = WriteAheadLog(wal_dir, fsync=wal_fsync,
+                            segment_bytes=segment_bytes)
+        cp = None
+        if auto_checkpoint:
+            cp = Checkpointer(store, wal, snap_dir,
+                              wal_bytes=checkpoint_wal_bytes,
+                              wal_records=checkpoint_wal_records,
+                              keep=checkpoint_keep)
+            wal.on_append = cp.notify
+            if res.replayed_records >= checkpoint_wal_records:
+                # a crash left a long un-checkpointed tail; fold it into
+                # a snapshot asynchronously so the NEXT boot is fast
+                cp.request()
+        p = cls(store, data_dir, wal, cp, res)
+        store.journal = p._journal
+        return p
+
+    # -- the store's journal hook (called under the store write lock) --------
+
+    def _journal(self, meta: dict, blob: Optional[bytes] = None) -> None:
+        self.wal.append(meta, blob)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def checkpoint_now(self) -> int:
+        """Synchronous checkpoint (graceful shutdown, tests)."""
+        if self.checkpointer is not None:
+            return self.checkpointer.checkpoint()
+        from .snapshot import write_snapshot
+
+        self.wal.sync()
+        rev, _ = write_snapshot(self.store,
+                                os.path.join(self.data_dir, "snapshots"))
+        return rev
+
+    def close(self, final_checkpoint: bool = True) -> None:
+        """Detach from the store and shut the WAL down cleanly. With
+        ``final_checkpoint`` the store is snapshotted first so the next
+        boot loads one file and replays zero records."""
+        if self._closed:
+            return
+        self._closed = True
+        if getattr(self.store, "journal", None) is self._journal:
+            self.store.journal = None
+        try:
+            if final_checkpoint and self.wal.appended_records:
+                self.checkpoint_now()
+        except Exception:
+            log.exception("final checkpoint failed; WAL tail remains "
+                          "authoritative")
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+        self.wal.close()

@@ -43,6 +43,13 @@ def main(argv=None) -> int:
         if opts.snapshot_path and hasattr(cfg.engine, "save_snapshot"):
             cfg.engine.save_snapshot(opts.snapshot_path)
             logging.info("saved snapshot to %s", opts.snapshot_path)
+        if opts.data_dir and hasattr(cfg.engine, "close_persistence"):
+            # final checkpoint + WAL fsync (persistence/manager.py) so
+            # the next boot loads one snapshot and replays nothing
+            await asyncio.get_running_loop().run_in_executor(
+                None, cfg.engine.close_persistence)
+            logging.info("persistence closed (checkpointed %s)",
+                         opts.data_dir)
 
     asyncio.run(serve())
     return 0

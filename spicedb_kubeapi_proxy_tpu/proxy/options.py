@@ -156,12 +156,23 @@ class Options:
     oidc_signing_algs: str = "RS256"  # comma-separated
     # repeatable key=value pairs every token must carry verbatim
     oidc_required_claims: list = field(default_factory=list)
-    # dual-write
-    workflow_database_path: str = DEFAULT_WORKFLOW_DB
+    # dual-write. None resolves to <data_dir>/dtx.sqlite when a data dir
+    # is configured (durable dual-writes live WITH the durable store),
+    # else the historical default — an explicit path always wins
+    workflow_database_path: Optional[str] = None
     lock_mode: str = LOCK_MODE_PESSIMISTIC
     # relationship-store snapshot: loaded at boot when the file exists,
     # saved on graceful shutdown (in-process engines only)
     snapshot_path: Optional[str] = None
+    # durable persistence (persistence/): write-ahead log + snapshot
+    # checkpoints + crash recovery under this directory. Unset = the
+    # in-memory store (today's behavior; every existing test).
+    # In-process engines only — a tcp:// engine host owns its own disk.
+    data_dir: Optional[str] = None
+    wal_fsync: str = "interval:100"  # always | interval:<ms> | off
+    checkpoint_wal_bytes: int = 64 << 20
+    checkpoint_wal_records: int = 50_000
+    checkpoint_keep: int = 2
     # >0 coalesces concurrent list prefilters into fused device dispatches
     # (seconds of added latency traded for per-dispatch amortization)
     lookup_batch_window: float = 0.0
@@ -248,6 +259,27 @@ class Options:
             raise OptionsError(
                 "snapshot-path applies to in-process engines; pass it to "
                 "the tcp:// engine host instead")
+        if remote and self.data_dir:
+            raise OptionsError(
+                "data-dir applies to in-process engines; pass it to "
+                "the tcp:// engine host instead")
+        if self.data_dir and self.snapshot_path:
+            raise OptionsError(
+                "data-dir and snapshot-path are mutually exclusive (the "
+                "data dir owns snapshots AND the write-ahead log)")
+        if self.data_dir:
+            from ..persistence.wal import WalError, parse_fsync_policy
+
+            try:
+                parse_fsync_policy(self.wal_fsync)
+            except WalError as e:
+                raise OptionsError(str(e)) from None
+            if self.checkpoint_wal_bytes < 1 \
+                    or self.checkpoint_wal_records < 1:
+                raise OptionsError(
+                    "checkpoint-wal-bytes/records must be >= 1")
+            if self.checkpoint_keep < 1:
+                raise OptionsError("checkpoint-keep must be >= 1")
         if remote and self.lookup_batch_window > 0:
             raise OptionsError(
                 "lookup-batch-window applies to in-process engines; batch "
@@ -419,7 +451,14 @@ class Options:
 
                 mesh = make_mesh(**_parse_mesh_spec(self.engine_mesh))
             engine = Engine(bootstrap=bootstrap or None, mesh=mesh)
-            engine.load_snapshot_if_exists(self.snapshot_path)
+            if self.data_dir:
+                engine.enable_persistence(
+                    self.data_dir, wal_fsync=self.wal_fsync,
+                    checkpoint_wal_bytes=self.checkpoint_wal_bytes,
+                    checkpoint_wal_records=self.checkpoint_wal_records,
+                    checkpoint_keep=self.checkpoint_keep)
+            else:
+                engine.load_snapshot_if_exists(self.snapshot_path)
             if self.lookup_batch_window > 0:
                 engine.enable_lookup_batching(self.lookup_batch_window)
             if self.authz_cache:
@@ -461,7 +500,18 @@ class Options:
                 breaker_failure_threshold=self.breaker_failure_threshold,
                 breaker_reset_seconds=self.breaker_reset_seconds,
             )
-        workflow = WorkflowEngine(db_path=self.workflow_database_path)
+        # durable dual-writes live with the durable store: an unset path
+        # lands the workflow DB inside --data-dir when one is configured
+        wf_db = self.workflow_database_path
+        if wf_db is None:
+            if self.data_dir:
+                import os as _os2
+
+                _os2.makedirs(self.data_dir, exist_ok=True)
+                wf_db = _os2.path.join(self.data_dir, "dtx.sqlite")
+            else:
+                wf_db = DEFAULT_WORKFLOW_DB
+        workflow = WorkflowEngine(db_path=wf_db)
         register_workflows(workflow)
         ActivityHandler(engine, upstream).register(workflow)
         discovery_cache = None
@@ -543,6 +593,8 @@ class Options:
         "upstream_url", "upstream_insecure", "kubeconfig",
         "kubeconfig_context", "bind_host", "bind_port",
         "workflow_database_path", "lock_mode", "snapshot_path",
+        "data_dir", "wal_fsync", "checkpoint_wal_bytes",
+        "checkpoint_wal_records", "checkpoint_keep",
         "authz_cache", "authz_cache_size", "authz_cache_mask_bytes",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
@@ -654,10 +706,35 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         default=[], dest="oidc_required_claims",
                         help="key=value a token must carry verbatim "
                              "(repeatable)")
-    parser.add_argument("--workflow-database-path", default=DEFAULT_WORKFLOW_DB)
+    parser.add_argument("--workflow-database-path", default=None,
+                        help="dual-write workflow DB (sqlite). Default: "
+                             "<data-dir>/dtx.sqlite when --data-dir is "
+                             f"set, else {DEFAULT_WORKFLOW_DB}")
     parser.add_argument("--snapshot-path",
                         help="relationship-store snapshot file: loaded at "
-                             "boot if present, saved on graceful shutdown")
+                             "boot if present, saved on graceful shutdown "
+                             "(superseded by --data-dir, which also "
+                             "survives SIGKILL)")
+    parser.add_argument("--data-dir",
+                        help="durable persistence directory: write-ahead "
+                             "log + snapshot checkpoints; crash recovery "
+                             "replays the WAL tail at boot. Unset = "
+                             "in-memory store. In-process engines only")
+    parser.add_argument("--wal-fsync", default="interval:100",
+                        help="WAL fsync policy: always | interval:<ms> | "
+                             "off (default interval:100)")
+    parser.add_argument("--checkpoint-wal-bytes", type=int,
+                        default=64 << 20,
+                        help="snapshot-checkpoint the store once this "
+                             "many WAL bytes accumulate since the last "
+                             "checkpoint")
+    parser.add_argument("--checkpoint-wal-records", type=int,
+                        default=50_000,
+                        help="...or this many WAL records, whichever "
+                             "comes first")
+    parser.add_argument("--checkpoint-keep", type=int, default=2,
+                        help="snapshot generations to retain (the WAL is "
+                             "pruned only up to the oldest kept one)")
     parser.add_argument("--lookup-batch-window", type=float, default=0.0,
                         help="seconds to hold a list prefilter for fusing "
                              "concurrent lookups into one device dispatch "
@@ -777,6 +854,11 @@ def options_from_args(args: argparse.Namespace) -> Options:
         workflow_database_path=args.workflow_database_path,
         lock_mode=args.lock_mode,
         snapshot_path=args.snapshot_path,
+        data_dir=args.data_dir,
+        wal_fsync=args.wal_fsync,
+        checkpoint_wal_bytes=args.checkpoint_wal_bytes,
+        checkpoint_wal_records=args.checkpoint_wal_records,
+        checkpoint_keep=args.checkpoint_keep,
         lookup_batch_window=args.lookup_batch_window,
         authz_cache=args.authz_cache,
         authz_cache_size=args.authz_cache_size,

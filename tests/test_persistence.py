@@ -1,0 +1,816 @@
+"""Durable persistence: WAL framing/rotation, torn-tail truncation,
+snapshot checkpoints + corrupted-snapshot fallback, SIGKILL crash
+recovery, revision monotonicity across restarts, and follower catch-up
+over the mirror protocol (`mirror_subscribe` with `from_revision`)."""
+
+import asyncio
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.engine import (
+    CheckItem,
+    Engine,
+    RelationshipFilter,
+    WriteOp,
+)
+from spicedb_kubeapi_proxy_tpu.engine.store import Store, StoreError
+from spicedb_kubeapi_proxy_tpu.models import parse_schema
+from spicedb_kubeapi_proxy_tpu.models.tuples import (
+    Relationship,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.persistence import (
+    Persistence,
+    WalError,
+    WriteAheadLog,
+    decode_bulk_cols,
+    encode_bulk_cols,
+    list_snapshots,
+    parse_fsync_policy,
+    recover,
+)
+from spicedb_kubeapi_proxy_tpu.persistence import wal as walmod
+
+SCHEMA = parse_schema("""
+use expiration
+
+definition user {}
+definition group { relation member: user }
+definition ns {
+  relation viewer: user | group#member | user with expiration
+  relation banned: user
+  permission view = viewer - banned
+}
+""")
+
+
+def rel(i, u="u0", exp=None):
+    return Relationship("ns", f"n{i}", "viewer", "user", u, None, exp)
+
+
+def all_reads(store):
+    return sorted(str(r) for r in store.read(RelationshipFilter()))
+
+
+# -- WAL ---------------------------------------------------------------------
+
+
+def test_fsync_policy_parse():
+    assert parse_fsync_policy("always") == ("always", 0.0)
+    assert parse_fsync_policy("off") == ("off", 0.0)
+    mode, iv = parse_fsync_policy("interval:250")
+    assert mode == "interval" and iv == pytest.approx(0.25)
+    for bad in ("", "sometimes", "interval:", "interval:-5", "interval:x"):
+        with pytest.raises(WalError):
+            parse_fsync_policy(bad)
+
+
+def test_wal_round_trip_with_blobs(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, fsync="off")
+    w.append({"kind": "write", "rev": 1, "effects": [{"op": "touch"}]})
+    w.append({"kind": "bulk_load", "rev": 2}, b"\x00\x01binary\xffblob")
+    w.append({"kind": "write", "rev": 3, "effects": []})
+    w.close()
+    got = list(walmod.replay(d))
+    assert [m["rev"] for m, _ in got] == [1, 2, 3]
+    assert got[1][1] == b"\x00\x01binary\xffblob"
+    assert got[0][1] is None
+    # from_revision filters strictly-greater
+    assert [m["rev"] for m, _ in walmod.replay(d, from_revision=2)] == [3]
+
+
+def test_wal_rotation_and_prune(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, fsync="off", segment_bytes=256)
+    for i in range(1, 41):
+        w.append({"kind": "write", "rev": i,
+                  "effects": [{"pad": "x" * 64}]})
+    segs = walmod.list_segments(d)
+    assert len(segs) > 2, "expected rotation at 256-byte segments"
+    # every record survives across segment boundaries, in order
+    assert [m["rev"] for m, _ in walmod.replay(d)] == list(range(1, 41))
+    # prune everything provably <= rev 20; the active segment stays
+    w.prune_upto(20)
+    kept = walmod.list_segments(d)
+    assert kept and kept[0][0] <= 21
+    assert [m["rev"] for m, _ in walmod.replay(d, from_revision=20)] \
+        == list(range(21, 41))
+    w.close()
+
+
+def test_wal_torn_tail_truncates_cleanly(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, fsync="off")
+    for i in range(1, 6):
+        w.append({"kind": "write", "rev": i, "effects": []})
+    w.close()
+    path = walmod.list_segments(d)[-1][1]
+    # kill-style torn tail: a partial frame (valid length header, short
+    # payload) at the end of the newest segment
+    with open(path, "ab") as f:
+        f.write(struct.pack(">II", 1000, 0) + b"short")
+    size_torn = os.path.getsize(path)
+    got = [m["rev"] for m, _ in walmod.replay(d)]
+    assert got == [1, 2, 3, 4, 5]
+    assert os.path.getsize(path) < size_torn, "torn tail not truncated"
+    # a second replay sees a clean log (no re-truncation needed)
+    assert [m["rev"] for m, _ in walmod.replay(d)] == [1, 2, 3, 4, 5]
+    # appends after recovery land in a FRESH segment and replay fine
+    w2 = WriteAheadLog(d, fsync="off")
+    w2.append({"kind": "write", "rev": 6, "effects": []})
+    w2.close()
+    assert [m["rev"] for m, _ in walmod.replay(d)] == [1, 2, 3, 4, 5, 6]
+
+
+def test_wal_torn_first_frame_removes_segment(tmp_path):
+    """A tear that takes a segment's FIRST frame removes the file
+    entirely — a kept-but-empty segment would collide with the re-append
+    of the revision it is named after."""
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, fsync="off", segment_bytes=64)
+    w.append({"kind": "write", "rev": 1,
+              "effects": [{"pad": "x" * 64}]})
+    w.append({"kind": "write", "rev": 2, "effects": []})  # second segment
+    w.close()
+    segs = walmod.list_segments(d)
+    assert len(segs) == 2
+    # chop the second segment back to magic + partial header
+    with open(segs[-1][1], "r+b") as f:
+        f.truncate(len(walmod.MAGIC) + 3)
+    assert [m["rev"] for m, _ in walmod.replay(d)] == [1]
+    assert not os.path.exists(segs[-1][1])
+    # revision 2 re-appends into a segment of the SAME name, cleanly
+    w2 = WriteAheadLog(d, fsync="off")
+    w2.append({"kind": "write", "rev": 2, "effects": []})
+    w2.close()
+    assert [m["rev"] for m, _ in walmod.replay(d)] == [1, 2]
+
+
+def test_wal_rejects_oversized_frame(tmp_path, monkeypatch):
+    """append() refuses frames replay would classify as torn garbage —
+    an oversized record must fail loudly at write time, not be silently
+    truncated away at the next recovery."""
+    monkeypatch.setattr(walmod, "MAX_WAL_FRAME", 64)
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+    with pytest.raises(WalError, match="frame bound"):
+        w.append({"kind": "bulk_load", "rev": 1}, b"x" * 128)
+    w.close()
+
+
+def test_recovery_fails_closed_on_mid_history_corruption(tmp_path):
+    """Corruption in a SEALED (non-final) segment must refuse to boot:
+    serving would strand every later acknowledged write as permanently
+    unreplayable while reporting healthy."""
+    from spicedb_kubeapi_proxy_tpu.persistence import RecoveryError
+
+    d = str(tmp_path / "data")
+    s = Store()
+    p = Persistence.open(s, d, wal_fsync="off", segment_bytes=64,
+                         auto_checkpoint=False)
+    for i in range(6):
+        s.write([WriteOp("touch", rel(i))])
+    p.wal.sync()
+    p.close(final_checkpoint=False)
+    segs = walmod.list_segments(os.path.join(d, "wal"))
+    assert len(segs) >= 3
+    with open(segs[1][1], "r+b") as f:  # a sealed, non-final segment
+        f.seek(len(walmod.MAGIC) + 2)
+        b = f.read(1)
+        f.seek(len(walmod.MAGIC) + 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(RecoveryError, match="mid-history"):
+        recover(Store(), d)
+
+
+def test_wal_corrupt_crc_detected(tmp_path):
+    d = str(tmp_path / "wal")
+    w = WriteAheadLog(d, fsync="off")
+    w.append({"kind": "write", "rev": 1, "effects": []})
+    w.append({"kind": "write", "rev": 2, "effects": []})
+    w.close()
+    path = walmod.list_segments(d)[-1][1]
+    # flip one payload byte of the LAST frame: CRC catches it, replay
+    # treats it as torn tail
+    data = bytearray(open(path, "rb").read())
+    data[-3] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    assert [m["rev"] for m, _ in walmod.replay(d)] == [1]
+
+
+# -- store journal + recovery ------------------------------------------------
+
+
+def test_recover_write_delete_bulk_and_expiry(tmp_path):
+    d = str(tmp_path / "data")
+    s = Store()
+    p = Persistence.open(s, d, wal_fsync="off", auto_checkpoint=False)
+    now = time.time()
+    for i in range(10):
+        s.write([WriteOp("touch", rel(i, f"u{i % 3}"))])
+    s.write([WriteOp("touch", rel(99, "exp-user", now + 3600))])
+    s.write([WriteOp("touch", rel(98, "dead-user", now - 10))])  # expired
+    s.delete_by_filter(RelationshipFilter(resource_id="n3"))
+    s.bulk_load({"resource_type": ["pod"] * 3,
+                 "resource_id": ["a", "b", "c"],
+                 "relation": ["viewer"] * 3,
+                 "subject_type": ["user"] * 3,
+                 "subject_id": ["x", "y", "z"]})
+    s.write([WriteOp("delete", rel(1, "u1"))])
+    p.wal.sync()
+    want_rev, want_reads, want_len = s.revision, all_reads(s), len(s)
+    # crash: no close, no checkpoint
+    s2 = Store()
+    res = recover(s2, d)
+    assert res.snapshot_path is None
+    assert res.replayed_records == res.revision == want_rev
+    assert s2.revision == want_rev
+    assert len(s2) == want_len
+    assert all_reads(s2) == want_reads
+    # next write continues STRICTLY past the recovered revision
+    r = s2.write([WriteOp("touch", rel(500))])
+    assert r == want_rev + 1
+    p.close(final_checkpoint=False)
+
+
+def test_snapshot_checkpoint_then_tail_replay(tmp_path):
+    d = str(tmp_path / "data")
+    s = Store()
+    p = Persistence.open(s, d, wal_fsync="off", auto_checkpoint=False)
+    for i in range(5):
+        s.write([WriteOp("touch", rel(i))])
+    cp_rev = p.checkpoint_now()
+    assert cp_rev == 5
+    assert [r for r, _ in list_snapshots(os.path.join(d, "snapshots"))] \
+        == [5]
+    for i in range(5, 8):
+        s.write([WriteOp("touch", rel(i))])
+    p.wal.sync()
+    want = all_reads(s)
+    s2 = Store()
+    res = recover(s2, d)
+    assert res.snapshot_revision == 5
+    assert res.replayed_records == 3  # only the tail past the snapshot
+    assert s2.revision == 8 and all_reads(s2) == want
+    p.close(final_checkpoint=False)
+
+
+def test_corrupt_snapshot_falls_back_to_previous(tmp_path):
+    d = str(tmp_path / "data")
+    s = Store()
+    p = Persistence.open(s, d, wal_fsync="off", auto_checkpoint=False)
+    for i in range(4):
+        s.write([WriteOp("touch", rel(i))])
+    p.checkpoint_now()
+    for i in range(4, 9):
+        s.write([WriteOp("touch", rel(i))])
+    p.checkpoint_now()
+    s.write([WriteOp("touch", rel(100, "tail-user"))])
+    p.wal.sync()
+    want_rev, want = s.revision, all_reads(s)
+    snaps = list_snapshots(os.path.join(d, "snapshots"))
+    assert len(snaps) == 2
+    # mangle the NEWEST snapshot in place
+    newest = snaps[-1][1]
+    with open(newest, "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef" * 8)
+    s2 = Store()
+    res = recover(s2, d)
+    assert res.corrupt_snapshots == [newest]
+    assert res.snapshot_revision == snaps[0][0]
+    # the longer WAL tail (retained back to the OLDEST snapshot) rebuilt
+    # the full state anyway
+    assert s2.revision == want_rev and all_reads(s2) == want
+    p.close(final_checkpoint=False)
+
+
+def test_checkpointer_auto_triggers_and_prunes(tmp_path):
+    d = str(tmp_path / "data")
+    s = Store()
+    p = Persistence.open(s, d, wal_fsync="off", segment_bytes=512,
+                         checkpoint_wal_records=10, checkpoint_keep=1)
+    for i in range(25):
+        s.write([WriteOp("touch", rel(i))])
+    deadline = time.monotonic() + 10
+    snap_dir = os.path.join(d, "snapshots")
+    while time.monotonic() < deadline and not list_snapshots(snap_dir):
+        time.sleep(0.05)
+    snaps = list_snapshots(snap_dir)
+    assert snaps, "threshold checkpoint never ran"
+    p.close()  # final checkpoint at rev 25
+    snaps = list_snapshots(snap_dir)
+    assert snaps[-1][0] == 25
+    # keep=1: WAL segments behind the kept snapshot are pruned
+    s2 = Store()
+    res = recover(s2, d)
+    assert s2.revision == 25 and res.replayed_records == 0
+    assert len(s2) == 25
+
+
+def test_final_checkpoint_makes_next_boot_replay_free(tmp_path):
+    d = str(tmp_path / "data")
+    s = Store()
+    p = Persistence.open(s, d, wal_fsync="off", auto_checkpoint=False)
+    for i in range(6):
+        s.write([WriteOp("touch", rel(i))])
+    p.close()  # graceful shutdown: final checkpoint
+    s2 = Store()
+    res = recover(s2, d)
+    assert res.replayed_records == 0 and res.snapshot_revision == 6
+    assert s2.revision == 6 and len(s2) == 6
+
+
+# -- engine-level differential restart ---------------------------------------
+
+
+def engine_checks(e, now=None):
+    return [e.check(CheckItem("ns", n, "view", "user", u), now=now)
+            for n, u in (("dev", "alice"), ("dev", "bob"),
+                         ("prod", "carol"), ("tmp", "dave"),
+                         ("dev", "nobody"))]
+
+
+def test_differential_engine_restart(tmp_path):
+    """A write/delete/expire workload replayed after 'restart' produces
+    byte-identical check/lookup results and a revision >= pre-crash."""
+    d = str(tmp_path / "data")
+    e = Engine(schema=SCHEMA)
+    e.enable_persistence(d, wal_fsync="off", auto_checkpoint=False)
+    now = time.time()
+    e.write_relationships(
+        [WriteOp("touch", parse_relationship(r)) for r in (
+            "group:eng#member@user:alice",
+            "ns:dev#viewer@group:eng#member",
+            "ns:dev#viewer@user:bob",
+            "ns:dev#banned@user:bob",
+            "ns:tmp#viewer@user:dave",
+        )])
+    # expiring grant (future) and an already-dead one (past)
+    e.write_relationships([WriteOp("touch", Relationship(
+        "ns", "prod", "viewer", "user", "carol", None, now + 3600))])
+    e.write_relationships([WriteOp("touch", Relationship(
+        "ns", "prod", "viewer", "user", "eve", None, now - 5))])
+    e.delete_relationships(RelationshipFilter(resource_id="tmp"))
+    e.persistence.wal.sync()
+    pre_rev = e.revision
+    pin = time.time()  # one clock for both sides of the comparison
+    want_checks = engine_checks(e, now=pin)
+    want_lookup = sorted(e.lookup_resources("ns", "view", "user", "alice"))
+    want_reads = all_reads(e.store)
+
+    e2 = Engine(schema=SCHEMA)
+    p2 = e2.enable_persistence(d, wal_fsync="off", auto_checkpoint=False)
+    assert p2.recovery.replayed_records == pre_rev
+    assert e2.revision == pre_rev
+    assert all_reads(e2.store) == want_reads
+    assert engine_checks(e2, now=pin) == want_checks
+    assert sorted(e2.lookup_resources("ns", "view", "user", "alice")) \
+        == want_lookup
+    # revisions stay strictly monotonic across the restart: a new write
+    # can never mint a revision a pre-crash decision cache already keyed
+    assert e2.write_relationships(
+        [WriteOp("touch", parse_relationship("ns:new#viewer@user:zed"))]
+    ) == pre_rev + 1
+    e2.close_persistence(final_checkpoint=False)
+
+
+def test_load_snapshot_refused_with_persistence(tmp_path):
+    e = Engine(schema=SCHEMA)
+    path = str(tmp_path / "snap.npz")
+    e.save_snapshot(path)
+    e.enable_persistence(str(tmp_path / "data"), wal_fsync="off",
+                         auto_checkpoint=False)
+    with pytest.raises(StoreError):
+        e.load_snapshot(path)
+    e.close_persistence(final_checkpoint=False)
+
+
+# -- SIGKILL crash test ------------------------------------------------------
+
+_CHILD = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from spicedb_kubeapi_proxy_tpu.engine.store import Store, WriteOp
+from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+from spicedb_kubeapi_proxy_tpu.persistence import Persistence
+
+s = Store()
+p = Persistence.open(s, {data_dir!r}, wal_fsync="always",
+                     auto_checkpoint=False)
+i = 0
+while True:
+    rev = s.write([WriteOp("touch", Relationship(
+        "ns", "n%d" % i, "viewer", "user", "u%d" % (i % 7)))])
+    # the ack: only printed AFTER the journaled write returned
+    print("ACK %d %d" % (rev, i), flush=True)
+    i += 1
+"""
+
+
+def test_sigkill_mid_write_load_recovers_every_acked_write(tmp_path):
+    """Hard process death: SIGKILL a writer mid-load; recovery must
+    contain EVERY acknowledged write with strictly monotonic revisions
+    resuming above the highest acked one."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path / "data")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD.format(repo=repo, data_dir=d)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    acked = []
+    try:
+        deadline = time.monotonic() + 60
+        while len(acked) < 25 and time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("ACK "):
+                _, rev, i = line.split()
+                acked.append((int(rev), int(i)))
+        assert len(acked) >= 25, (acked, proc.stderr.read())
+    finally:
+        proc.kill()  # SIGKILL, mid-write by construction
+        proc.wait(timeout=30)
+
+    s = Store()
+    res = recover(s, d)
+    max_rev = max(r for r, _ in acked)
+    # every acked write is present...
+    for _, i in acked:
+        assert s.exists(RelationshipFilter(
+            resource_type="ns", resource_id=f"n{i}")), f"lost acked n{i}"
+    # ...revisions were strictly monotonic in the log and resume above
+    revs = [r for r, _ in acked]
+    assert revs == sorted(set(revs))
+    assert s.revision >= max_rev
+    assert res.revision == s.revision
+    new_rev = s.write([WriteOp("touch", rel(10_000))])
+    assert new_rev > max_rev
+
+
+# -- columnar codec + mirror bulk_load ---------------------------------------
+
+
+def test_bulk_cols_codec_round_trip():
+    cols = {
+        "resource_type": ["pod", "pod", "ns"],
+        "resource_id": np.asarray(["a", "b", "c"]),
+        "relation": ["viewer"] * 3,
+        "subject_type": ["user"] * 3,
+        # trust boundary: bytes and non-str elements normalize
+        "subject_id": [b"x", "y", 7],
+        "expiration": [None, 123.5, float("nan")],
+    }
+    out = decode_bulk_cols(encode_bulk_cols(cols))
+    assert [str(x) for x in out["resource_id"]] == ["a", "b", "c"]
+    assert [str(x) for x in out["subject_id"]] == ["x", "y", "7"]
+    exp = out["expiration"]
+    assert np.isnan(exp[0]) and exp[1] == 123.5 and np.isnan(exp[2])
+
+
+def _frame_from_wire(wire):
+    (n,) = struct.unpack(">I", wire[:4])
+    body = wire[4:4 + n]
+    if body[:1] == b"\x00":
+        import json
+
+        (m,) = struct.unpack(">I", body[1:5])
+        return json.loads(body[5:5 + m]), body[5 + m:]
+    import json
+
+    return json.loads(body), None
+
+
+def test_mirror_bulk_load_rides_binary_frame():
+    """Satellite: MirroredEngine.bulk_load publishes the columnar payload
+    on the binary-frame path (one npz encode), not one JSON string per
+    cell — and the follower replay reproduces the store exactly."""
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        apply_mirror_frame,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA))
+    q = leader.subscribe()
+    n = 500
+    leader.bulk_load({
+        "resource_type": ["ns"] * n,
+        "resource_id": np.asarray([f"n{i}" for i in range(n)]),
+        "relation": ["viewer"] * n,
+        "subject_type": ["user"] * n,
+        "subject_id": [f"u{i % 13}" for i in range(n)],
+    })
+    msg, blob = _frame_from_wire(q.get_nowait())
+    assert blob is not None, "bulk_load frame should carry a binary blob"
+    assert "cols" not in msg["frame"], "per-cell JSON lists are retired"
+    follower = Engine(schema=SCHEMA)
+    apply_mirror_frame(follower, msg["frame"], blob)
+    assert len(follower.store) == len(leader.engine.store)
+    assert all_reads(follower.store) == all_reads(leader.engine.store)
+
+
+# -- follower catch-up -------------------------------------------------------
+
+
+def test_subscribe_with_catchup_atomic_cut():
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        apply_catchup,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA))
+    for i in range(6):
+        leader.write_relationships([WriteOp("touch", rel(i))])
+    leader.delete_relationships(RelationshipFilter(resource_id="n2"))
+    follower = Engine(schema=SCHEMA)
+    q, meta, payload = leader.subscribe_with_catchup(follower.revision)
+    assert payload is None and meta["effects"]
+    apply_catchup(follower, meta, payload)
+    assert follower.revision == leader.engine.revision
+    assert all_reads(follower.store) == all_reads(leader.engine.store)
+    # live frames continue exactly where the catch-up landed
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        apply_mirror_frame,
+    )
+
+    leader.write_relationships([WriteOp("touch", rel(50, "late"))])
+    msg, blob = _frame_from_wire(q.get_nowait())
+    apply_mirror_frame(follower, msg["frame"], blob)
+    assert all_reads(follower.store) == all_reads(leader.engine.store)
+    assert follower.revision == leader.engine.revision
+
+
+def test_subscribe_with_catchup_full_state_after_bulk_load():
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        apply_catchup,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA))
+    leader.bulk_load({
+        "resource_type": ["ns"] * 4,
+        "resource_id": ["a", "b", "c", "d"],
+        "relation": ["viewer"] * 4,
+        "subject_type": ["user"] * 4,
+        "subject_id": ["u1"] * 4,
+    })
+    leader.write_relationships([WriteOp("touch", rel(9, "u9"))])
+    follower = Engine(schema=SCHEMA)
+    # the bulk load predates the follower's revision horizon -> a state
+    # transfer, not an effects replay
+    q, meta, payload = leader.subscribe_with_catchup(0)
+    assert payload is not None and meta.get("state")
+    apply_catchup(follower, meta, payload)
+    assert follower.revision == leader.engine.revision
+    assert all_reads(follower.store) == all_reads(leader.engine.store)
+
+
+def test_catchup_subscribe_satisfies_join_barrier():
+    """A leader parked in _publish waiting for its join barrier must be
+    released by a catch-up subscription (the queue registers BEFORE the
+    consistent cut takes the mirror lock) — and the seq-skip protocol
+    keeps the frames queued during the cut from double-applying."""
+    import threading
+
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        apply_catchup,
+        apply_mirror_frame,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA), min_subscribers=1,
+                            join_timeout=30.0)
+    done = threading.Event()
+
+    def first_write():
+        leader.write_relationships([WriteOp("touch", rel(0))])
+        done.set()
+
+    t = threading.Thread(target=first_write, daemon=True)
+    t.start()
+    time.sleep(0.2)  # the writer is parked on the join barrier
+    assert not done.is_set()
+    follower = Engine(schema=SCHEMA)
+    q, meta, payload = leader.subscribe_with_catchup(follower.revision)
+    assert done.wait(10), "catch-up subscribe did not satisfy the barrier"
+    t.join(10)
+    apply_catchup(follower, meta, payload)
+    # frames sequenced at or before the cut are covered by the catch-up;
+    # anything after it replays live (exactly the follower_loop skip)
+    skip_upto = meta["seq"]
+    leader.write_relationships([WriteOp("touch", rel(1))])
+    while not q.empty():
+        msg, blob = _frame_from_wire(q.get_nowait())
+        payload_frame = msg["frame"]
+        if payload_frame["seq"] <= skip_upto:
+            continue
+        apply_mirror_frame(follower, payload_frame, blob)
+    assert follower.revision == leader.engine.revision
+    assert all_reads(follower.store) == all_reads(leader.engine.store)
+
+
+def test_catchup_diverged_follower_gets_full_state():
+    """A follower AHEAD of the leader (lost leader disk / rolled-back
+    fsync window) must be forced onto the leader's lineage by a full
+    state transfer, not told 'already current'."""
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        apply_catchup,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA))
+    leader.write_relationships([WriteOp("touch", rel(0, "leader-only"))])
+    follower = Engine(schema=SCHEMA)
+    for i in range(5):  # divergent history the leader never saw
+        follower.write_relationships([WriteOp("touch", rel(i, "ghost"))])
+    assert follower.revision > leader.engine.revision
+    q, meta, payload = leader.subscribe_with_catchup(follower.revision)
+    assert payload is not None and meta.get("state")
+    apply_catchup(follower, meta, payload)
+    assert follower.revision == leader.engine.revision
+    assert all_reads(follower.store) == all_reads(leader.engine.store)
+
+
+def test_follower_catchup_over_tcp_converges_without_bulk_load(tmp_path):
+    """Acceptance: a restarting follower resubscribes with from_revision
+    (its recovered revision) and converges to the leader over the real
+    mirror protocol — no manual bulk_load."""
+    from spicedb_kubeapi_proxy_tpu.engine.remote import EngineServer
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        follower_loop,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA))
+    for i in range(8):
+        leader.write_relationships([WriteOp("touch", rel(i, f"u{i % 2}"))])
+    leader.delete_relationships(RelationshipFilter(resource_id="n5"))
+
+    # the "restarting" follower: recovered some prefix of history from
+    # its own data dir (simulated by replaying the first writes locally)
+    follower = Engine(schema=SCHEMA)
+    follower.enable_persistence(str(tmp_path / "fdata"), wal_fsync="off",
+                                auto_checkpoint=False)
+    for i in range(3):
+        follower.write_relationships(
+            [WriteOp("touch", rel(i, f"u{i % 2}"))])
+    assert all_reads(follower.store) != all_reads(leader.engine.store)
+
+    async def go():
+        server = EngineServer(leader, token="t")
+        port = await server.start()
+        loop_task = asyncio.create_task(asyncio.to_thread(
+            follower_loop, follower, "127.0.0.1", port, "t",
+            None, None, follower.revision))
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if follower.revision == leader.engine.revision and \
+                        all_reads(follower.store) \
+                        == all_reads(leader.engine.store):
+                    break
+                await asyncio.sleep(0.05)
+            assert all_reads(follower.store) \
+                == all_reads(leader.engine.store)
+            # live traffic keeps flowing after catch-up
+            leader.write_relationships(
+                [WriteOp("touch", rel(77, "after"))])
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    follower.revision != leader.engine.revision:
+                await asyncio.sleep(0.05)
+            assert all_reads(follower.store) \
+                == all_reads(leader.engine.store)
+        finally:
+            await server.stop()
+            await loop_task  # leader gone -> follower_loop returns
+    try:
+        asyncio.run(go())
+    finally:
+        follower.close_persistence(final_checkpoint=False)
+
+
+def test_follower_persistence_survives_catchup_restart(tmp_path):
+    """A follower that caught up via a full state transfer journals it
+    (load_state record): its NEXT restart recovers the transferred
+    baseline from its own data dir."""
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        apply_catchup,
+    )
+
+    leader = MirroredEngine(Engine(schema=SCHEMA))
+    leader.bulk_load({
+        "resource_type": ["ns"] * 3, "resource_id": ["a", "b", "c"],
+        "relation": ["viewer"] * 3, "subject_type": ["user"] * 3,
+        "subject_id": ["u1", "u2", "u3"],
+    })
+    d = str(tmp_path / "fdata")
+    follower = Engine(schema=SCHEMA)
+    p = follower.enable_persistence(d, wal_fsync="off",
+                                    auto_checkpoint=False)
+    q, meta, payload = leader.subscribe_with_catchup(0)
+    apply_catchup(follower, meta, payload)
+    p.wal.sync()
+    follower.close_persistence(final_checkpoint=False)
+
+    reborn = Engine(schema=SCHEMA)
+    p2 = reborn.enable_persistence(d, wal_fsync="off",
+                                   auto_checkpoint=False)
+    assert reborn.revision == leader.engine.revision
+    assert all_reads(reborn.store) == all_reads(leader.engine.store)
+    reborn.close_persistence(final_checkpoint=False)
+    assert p2.recovery.replayed_records >= 1
+
+
+# -- options / CLI wiring ----------------------------------------------------
+
+
+def test_options_data_dir_validation(tmp_path):
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options,
+        OptionsError,
+    )
+
+    base = dict(rule_content="x", upstream_url="http://x")
+    with pytest.raises(OptionsError, match="data-dir"):
+        Options(engine_endpoint="tcp://h:1", data_dir=str(tmp_path),
+                **base).validate()
+    with pytest.raises(OptionsError, match="mutually exclusive"):
+        Options(data_dir=str(tmp_path), snapshot_path="s.npz",
+                **base).validate()
+    with pytest.raises(OptionsError, match="fsync"):
+        Options(data_dir=str(tmp_path), wal_fsync="sometimes",
+                **base).validate()
+    with pytest.raises(OptionsError, match="checkpoint"):
+        Options(data_dir=str(tmp_path), checkpoint_wal_records=0,
+                **base).validate()
+    Options(data_dir=str(tmp_path), **base).validate()
+
+
+def test_options_data_dir_wires_engine_and_workflow_db(tmp_path):
+    """Satellite: --data-dir makes the store durable AND lands the dtx
+    workflow sqlite inside it; without a data dir the historical default
+    path is kept."""
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        DEFAULT_WORKFLOW_DB,
+        Options,
+    )
+
+    rules = open(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy", "rules.yaml")).read()
+    d = str(tmp_path / "data")
+    cfg = Options(rule_content=rules, upstream=object(),
+                  data_dir=d, wal_fsync="off", bind_port=0).complete()
+    try:
+        assert cfg.workflow.db_path == os.path.join(d, "dtx.sqlite")
+        assert os.path.exists(cfg.workflow.db_path)
+        assert cfg.engine.persistence is not None
+        rev0 = cfg.engine.revision
+        cfg.engine.write_relationships([WriteOp(
+            "touch",
+            parse_relationship("namespace:persist#creator@user:alice"))])
+        cfg.engine.persistence.wal.sync()
+    finally:
+        cfg.engine.close_persistence(final_checkpoint=False)
+
+    # a second boot on the same data dir recovers the write
+    cfg2 = Options(rule_content=rules, upstream=object(),
+                   data_dir=d, wal_fsync="off", bind_port=0).complete()
+    try:
+        assert cfg2.engine.revision == rev0 + 1
+        assert cfg2.engine.store.exists(RelationshipFilter(
+            resource_type="namespace", resource_id="persist"))
+    finally:
+        cfg2.engine.close_persistence(final_checkpoint=False)
+
+    # explicit path and no-data-dir defaults are untouched
+    explicit = Options(rule_content="x", upstream_url="http://x",
+                       workflow_database_path="/tmp/elsewhere.sqlite")
+    assert explicit.workflow_database_path == "/tmp/elsewhere.sqlite"
+    assert Options(rule_content="x", upstream_url="http://x"
+                   ).workflow_database_path is None
+    assert DEFAULT_WORKFLOW_DB  # the unset/no-data-dir fallback
+
+
+def test_engine_host_cli_data_dir_flags():
+    """The engine-host CLI rejects --data-dir + --snapshot-path and bad
+    fsync specs at argparse time (no engine built, no sockets)."""
+    from spicedb_kubeapi_proxy_tpu.engine import remote as remote_mod
+
+    with pytest.raises(SystemExit):
+        remote_mod.main(["--engine-insecure", "--data-dir", "/tmp/x",
+                         "--snapshot-path", "/tmp/y.npz"])
+    with pytest.raises(SystemExit):
+        remote_mod.main(["--engine-insecure", "--data-dir", "/tmp/x",
+                         "--wal-fsync", "never"])

@@ -68,8 +68,12 @@ lint:
 	fi
 
 # the one command matching the harness: lint + the tier-1 pytest line
-# from ROADMAP.md (same flags, same timeout, same pass-count echo)
+# from ROADMAP.md (same flags, same timeout, same pass-count echo).
+# CHAOS=1 additionally runs the failpoint chaos suite first (a superset
+# of what tier-1 already selects, but isolated: chaos failures surface
+# on their own before the big run).
 verify: lint
+	@if [ "$(CHAOS)" = "1" ]; then $(MAKE) chaos; fi
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

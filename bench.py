@@ -815,6 +815,20 @@ def _measure(args, result: dict) -> None:
     except Exception as ex:  # noqa: BLE001 - aux measurement only
         log(f"restart-recovery section failed (non-fatal): {ex}")
 
+    # -- leader failover: SIGKILL the leader under write load --
+    # The robustness headline (ISSUE 4): a replicated engine set
+    # (--peers, parallel/failover.py) loses its leader mid-traffic; the
+    # follower promotes with a fenced term and the client fails over.
+    # Reported: wall time from the kill to the first post-failover ack,
+    # plus how the window's requests split between fail-closed errors
+    # (the proxy's 503 family) and successes. Skipped on --tiny (the
+    # contract-test smoke must not pay two engine-host boots).
+    if not args.tiny:
+        try:
+            _failover_phase(result, quick)
+        except Exception as ex:  # noqa: BLE001 - aux measurement only
+            log(f"failover section failed (non-fatal): {ex}")
+
     if args.remote_compare:
         # remote (tcp:// packed-bitmask wire) vs in-process list filter:
         # the directive-3 acceptance measurement — the remote hot path
@@ -889,6 +903,154 @@ def _measure(args, result: dict) -> None:
 
     if args.suite:
         run_suite(quick, result)
+
+
+_FAILOVER_WORKER = r"""
+import os, sys
+peer_id, port0, port1, data_dir, repo = sys.argv[1:6]
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, repo)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spicedb_kubeapi_proxy_tpu.engine.remote import main
+sys.exit(main([
+    "--peers", "127.0.0.1:%s,127.0.0.1:%s" % (port0, port1),
+    "--peer-id", peer_id,
+    "--bind-port", port0 if peer_id == "0" else port1,
+    "--token", "bench-fo", "--engine-insecure",
+    "--data-dir", data_dir, "--wal-fsync", "always",
+    "--mirror-heartbeat-seconds", "0.25",
+    "--failover-boot-grace", "30",
+]))
+"""
+
+
+def _failover_phase(result: dict, quick: bool) -> None:
+    """Kill-the-leader under load: two CPU engine-host subprocesses in a
+    --peers replication set, a FailoverEngine client writing at a fixed
+    cadence, SIGKILL on the leader, and the wall-clock until writes ack
+    again. Always CPU subprocesses — the phase measures failover
+    machinery, and must not contend for the chip the headline owns."""
+    import shutil
+    import socket as _socket
+    import tempfile
+    import threading as _threading
+
+    from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        FailoverEngine,
+        RemoteEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+    from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+        DependencyUnavailable,
+    )
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="bench-failover-")
+    script = os.path.join(tmp, "worker.py")
+    with open(script, "w") as f:
+        f.write(_FAILOVER_WORKER)
+    port0, port1 = free_port(), free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def boot(pid):
+        return subprocess.Popen(
+            [sys.executable, script, str(pid), str(port0), str(port1),
+             os.path.join(tmp, f"data{pid}"), repo],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env, cwd=repo)
+
+    def leader_port(budget=90.0):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            for port in (port0, port1):
+                probe = RemoteEngine("127.0.0.1", port, token="bench-fo",
+                                     timeout=2.0, connect_timeout=2.0,
+                                     retries=0)
+                try:
+                    if probe.failover_state()["role"] == "leader":
+                        return port
+                except Exception:  # noqa: BLE001 - still booting
+                    pass
+                finally:
+                    probe.close()
+            time.sleep(0.3)
+        raise RuntimeError("failover bench: no leader elected")
+
+    procs = {0: boot(0), 1: boot(1)}
+    client = None
+    try:
+        lport = leader_port()
+        client = FailoverEngine(
+            [("127.0.0.1", port0), ("127.0.0.1", port1)],
+            token="bench-fo", connect_timeout=2.0, timeout=20.0,
+            retries=0, probe_timeout=2.0, resolve_deadline=45.0)
+        acked, failed_closed = [], [0]
+        stop = _threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                try:
+                    client.write_relationships([WriteOp(
+                        "touch", Relationship(
+                            "namespace", f"fo{i}", "creator", "user",
+                            "bench", None, None))])
+                    acked.append(time.monotonic())
+                except (DependencyUnavailable, OSError):
+                    failed_closed[0] += 1  # the proxy's 503 family
+                i += 1
+                time.sleep(0.02)
+
+        t = _threading.Thread(target=writer, daemon=True)
+        t.start()
+        warm = 2.0 if quick else 5.0
+        time.sleep(warm)
+        if not acked:
+            raise RuntimeError("failover bench: no writes acked pre-kill")
+        pre_kill_acked = len(acked)
+        victim = 0 if lport == port0 else 1
+        t_kill = time.monotonic()
+        procs[victim].kill()
+        deadline = time.monotonic() + 60
+        while (not acked or acked[-1] <= t_kill) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        t.join(30)
+        post = [a for a in acked if a > t_kill]
+        if not post:
+            raise RuntimeError("failover bench: writes never resumed")
+        ready_s = post[0] - t_kill
+        log(f"leader failover: time-to-ready {ready_s * 1e3:.0f}ms after "
+            f"SIGKILL ({pre_kill_acked} acks pre-kill, {len(post)} post, "
+            f"{failed_closed[0]} requests failed closed in the window, "
+            "0 dropped silently)")
+        result["failover_time_to_ready_s"] = round(ready_s, 3)
+        result["failover_requests_failed_closed"] = failed_closed[0]
+        result["failover_requests_acked_post"] = len(post)
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> None:

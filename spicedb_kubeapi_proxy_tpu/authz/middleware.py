@@ -82,12 +82,16 @@ def _always_allowed(req: ProxyRequest) -> bool:
 
 async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
     """The authorization chain, with fail-closed dependency degradation:
-    an open circuit breaker or exhausted deadline — upstream kube or the
-    remote TPU engine — maps to a bounded, RETRYABLE kube Status 503
-    with a ``Retry-After`` header. Never a hang (deadlines bound every
-    dependency wait) and never a fail-open 200 (an unanswerable check is
-    a denial-shaped error, mirroring how SpiceDB failures surface as
-    retryable statuses in dtx/workflow.py kube_conflict_resp)."""
+    an open circuit breaker, an exhausted deadline, or an engine host
+    mid-leader-failover (``NotLeaderError`` / no reachable leader, both
+    in the DependencyUnavailable family) — upstream kube or the remote
+    TPU engine — maps to a bounded, RETRYABLE kube Status 503 with a
+    ``Retry-After`` header. Never a hang (deadlines bound every
+    dependency wait) and never a fail-open 200 OR a stale verdict (an
+    unanswerable check is a denial-shaped error, mirroring how SpiceDB
+    failures surface as retryable statuses in dtx/workflow.py
+    kube_conflict_resp; a deposed engine's answers are refused at the
+    source by term fencing, parallel/failover.py)."""
     try:
         return await _authorize_inner(req, deps)
     except DependencyUnavailable as e:

@@ -53,7 +53,12 @@ from typing import Optional
 from ..utils.failpoints import FailPointError, failpoints
 from ..utils.metrics import metrics
 from ..utils.net import drain_server
-from ..utils.resilience import CircuitBreaker, Deadline, RetryPolicy
+from ..utils.resilience import (
+    CircuitBreaker,
+    Deadline,
+    DependencyUnavailable,
+    RetryPolicy,
+)
 
 from ..models.tuples import Relationship
 from .engine import CheckItem, Engine, SchemaViolation, WatchEvent
@@ -74,10 +79,49 @@ MAX_FRAME = 256 * 1024 * 1024
 # an unauthenticated socket could make the server buffer 256MiB per frame.
 MAX_FRAME_PREAUTH = 1024 * 1024
 
+class _EngineView:
+    """The ONE attribute the ``_op_*`` handlers touch, pinned at
+    role-gate time: handlers run as plain functions against this view,
+    so a failover demotion swapping ``server.engine`` mid-request can
+    never reroute an op onto the deposed leader's bare engine."""
+
+    __slots__ = ("engine",)
+
+    def __init__(self, engine):
+        self.engine = engine
+
+
+class _Demoted(Exception):
+    """Server-internal: the role gate re-check at op-execution time found
+    the host demoted after the event-loop gate passed (EngineServer.
+    _dispatch maps it to the ``not_leader`` wire kind)."""
+
+    def __init__(self, role, term):
+        super().__init__(f"demoted to {role} (term {term})")
+        self.role = role
+        self.term = term
+
+
+class NotLeaderError(DependencyUnavailable):
+    """The engine host answered but is not the replication leader
+    (role-gated follower, or a deposed leader mid-demotion). Subclasses
+    :class:`~..utils.resilience.DependencyUnavailable` so the authz
+    middleware fails it CLOSED as a retryable kube 503 + Retry-After;
+    the failover client treats it as a re-resolve trigger — the op was
+    rejected BEFORE dispatch, so even a write is safe to re-aim."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(
+            "engine-leader",
+            message or "engine host is not the replication leader",
+            retry_after=1.0)
+
+
 _ERROR_KINDS = {
     "precondition": PreconditionFailed,
     "schema": SchemaViolation,
     "store": StoreError,
+    "not_leader": NotLeaderError,
 }
 
 # ops that are safe to retry after a transport failure even if the
@@ -200,13 +244,25 @@ class EngineServer:
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
                  port: int = 0, token: Optional[str] = None,
-                 ssl_context=None, max_workers: int = 64):
+                 ssl_context=None, max_workers: int = 64,
+                 failover_status=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.engine = engine
         self.host = host
         self.port = port
         self.token = token
+        # replication role provider (parallel/failover.py coordinator):
+        # a callable returning {role, term, revision, peer_id, lag}.
+        # When set, every op except failover_state is ROLE-GATED — a
+        # follower (or electing) host rejects with kind "not_leader"
+        # instead of answering from possibly-stale state. None = the
+        # single-host default: this process IS the leader of itself.
+        self.failover_status = failover_status
+        # heartbeat cadence on idle mirror streams; failover deployments
+        # shrink it so followers detect a dead leader in seconds, not
+        # PUSH_HEARTBEAT multiples
+        self.mirror_heartbeat = self.PUSH_HEARTBEAT
         # an ssl.SSLContext makes every connection TLS (utils/tlsconf.py:
         # the reference's remote endpoint is TLS-by-default,
         # options.go:325-369); None serves plaintext — the standalone CLI
@@ -301,8 +357,9 @@ class EngineServer:
                 if not isinstance(resp, BinaryResult) and resp.get("ok") \
                         and req.get("op") == "mirror_subscribe":
                     # multi-host follower: stream every mirrored engine
-                    # action (parallel/multihost.py MirroredEngine)
-                    await self._push_mirror(writer, req)
+                    # action (parallel/multihost.py MirroredEngine);
+                    # the reader now carries only follower acks
+                    await self._push_mirror(reader, writer, req)
                     return
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
@@ -325,10 +382,43 @@ class EngineServer:
             if fn is None:
                 return {"ok": False, "kind": "proto",
                         "error": f"unknown op {op!r}"}
+            if self.failover_status is not None and op != "failover_state":
+                st = self.failover_status()
+                if st.get("role") != "leader":
+                    # fail CLOSED, never stale: a follower's store trails
+                    # the leader and a deposed leader's may be fenced off
+                    return {"ok": False, "kind": "not_leader",
+                            "error": f"engine host is {st.get('role')} "
+                                     f"(term {st.get('term')}), not the "
+                                     "replication leader"}
+                # PIN the gate-approved engine for the op's whole
+                # execution: ops dereference `self.engine` at call time,
+                # so a demotion landing between this gate and the worker
+                # slot would otherwise run a write against the freshly-
+                # unwrapped BARE engine of a deposed leader (no mirror
+                # frame, no term stamp, no replication floor). Running
+                # the handler against an _EngineView closes that: even
+                # if the op races a demotion, it goes through the term-
+                # stamped mirrored wrapper — whose frames a newer
+                # lineage fences and whose floored writes fail closed.
+                view = _EngineView(self.engine)
+                inner_fn = getattr(type(self), f"_op_{op}")
+
+                def fn(r):  # noqa: F811 - deliberate gated shadow
+                    st2 = self.failover_status()
+                    if st2.get("role") != "leader":
+                        # demotion already visible: reject rather than
+                        # run a doomed (fenced) op to completion
+                        raise _Demoted(st2.get("role"), st2.get("term"))
+                    return inner_fn(view, r)
             result = await self._in_worker(fn, req)
             if isinstance(result, BinaryResult):
                 return result
             return {"ok": True, "result": result}
+        except _Demoted as e:
+            return {"ok": False, "kind": "not_leader",
+                    "error": f"engine host was demoted to {e.role} "
+                             f"(term {e.term}) before the op dispatched"}
         except PreconditionFailed as e:
             return {"ok": False, "kind": "precondition", "error": str(e)}
         except SchemaViolation as e:
@@ -465,21 +555,56 @@ class EngineServer:
             if not hasattr(self.engine, "subscribe_with_catchup"):
                 raise StoreError(
                     "engine host does not support follower catch-up")
-        return {"subscribed": True}
+        return {"subscribed": True,
+                "term": int(getattr(self.engine, "term", 0) or 0)}
 
-    async def _push_mirror(self, writer: asyncio.StreamWriter,
+    async def _mirror_ack_reader(self, reader: asyncio.StreamReader,
+                                 q, eng) -> None:
+        """Drain follower acknowledgements off the (otherwise one-way)
+        mirror stream: ``{"ack": seq, "term": t}`` frames credit the
+        subscriber's replication progress — the leader's sync-replicated
+        writes wait on them (MirroredEngine._wait_replicated). ``eng``
+        is the engine object PINNED by _push_mirror at subscribe time:
+        acks belong to that wrapper's subscription, not to whatever a
+        failover demotion may have swapped into self.engine since."""
+        while True:
+            frame = await _read_frame(reader)
+            if frame is None:
+                return
+            seq = frame.get("ack")
+            if seq is not None and hasattr(eng, "record_ack"):
+                eng.record_ack(q, int(seq), frame.get("term"))
+
+    async def _push_mirror(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter,
                            req: dict) -> None:
         import queue as _queue
 
+        # pin the engine object: a failover demotion swaps self.engine
+        # mid-stream, and the queue must be unsubscribed from the SAME
+        # wrapper that registered it
+        engine = self.engine
+        if not hasattr(engine, "subscribe"):
+            # demoted between the gated mirror_subscribe ack and this
+            # push loop: the bare engine has no mirror surface — close
+            # with the honest rejection, not an AttributeError
+            writer.write(_pack({"ok": False, "kind": "not_leader",
+                                "error": "engine host was demoted before "
+                                         "the mirror stream started"}))
+            await writer.drain()
+            return
         if "from_revision" in req:
             # atomic cut (multihost.py subscribe_with_catchup): the
             # catch-up lands the follower at exactly the revision the
             # queued live frames continue from
             q, meta, payload = await self._in_worker(
-                self.engine.subscribe_with_catchup,
-                int(req["from_revision"]))
+                partial(engine.subscribe_with_catchup,
+                        int(req["from_revision"]),
+                        subscriber_term=req.get("term")))
         else:
-            q, meta, payload = self.engine.subscribe(), None, None
+            q, meta, payload = engine.subscribe(), None, None
+        acks = asyncio.get_running_loop().create_task(
+            self._mirror_ack_reader(reader, q, engine))
         try:
             if meta is not None:
                 frame = {"ok": True, "catchup": meta}
@@ -491,17 +616,33 @@ class EngineServer:
             while True:
                 try:
                     wire = await self._in_worker(
-                        q.get, True, self.PUSH_HEARTBEAT)
+                        q.get, True, self.mirror_heartbeat)
                 except _queue.Empty:
-                    writer.write(_pack({"ok": True, "hb": True}))
+                    if failpoints.branch("mirror.heartbeat"):
+                        continue  # chaos: suppressed liveness heartbeat
+                    hb = {"ok": True, "hb": True}
+                    term = int(getattr(engine, "term", 0) or 0)
+                    if term:
+                        hb["term"] = term
+                    seq = getattr(engine, "mirror_seq", None)
+                    if seq is not None:
+                        hb["seq"] = int(seq)
+                    writer.write(_pack(hb))
                     await writer.drain()
                     continue
+                if wire is None:
+                    # replication-timeout drop sentinel (MirroredEngine.
+                    # _wait_replicated): close so the follower SEES it
+                    return
+                if failpoints.branch("mirror.partition"):
+                    continue  # chaos: this frame falls into the void
                 # pre-packed once by MirroredEngine._publish: the same
                 # bytes object fans out to every follower
                 writer.write(wire)
                 await writer.drain()
         finally:
-            self.engine.unsubscribe(q)
+            acks.cancel()
+            engine.unsubscribe(q)
 
     def _op_watch_since(self, req: dict):
         return [
@@ -517,6 +658,18 @@ class EngineServer:
 
     def _op_revision(self, req: dict):
         return self.engine.revision
+
+    def _op_failover_state(self, req: dict):
+        """Replication-set introspection: NEVER role-gated — election
+        probes and client-side failover resolution both depend on being
+        able to ask a follower (or a deposed leader) what it is. A host
+        with no coordinator is the leader of itself."""
+        if self.failover_status is not None:
+            return dict(self.failover_status())
+        eng = self.engine
+        return {"role": "leader",
+                "term": int(getattr(eng, "term", 0) or 0),
+                "revision": eng.revision, "peer_id": None, "lag": 0}
 
     def _op_exists(self, req: dict):
         return self.engine.store.exists(_filter_from_dict(req["filter"]))
@@ -970,6 +1123,308 @@ class RemoteEngine:
     def revision(self) -> int:
         return self._call("revision")
 
+    def failover_state(self) -> dict:
+        """Replication role/term/revision of this endpoint (one
+        single-attempt round trip — deliberately NOT in the idempotent
+        retry set: resolution probes must answer fast about dead hosts,
+        not burn a retry budget against them)."""
+        return self._call("failover_state")
+
+
+# -- client-side engine failover ----------------------------------------------
+
+
+class _PrimaryBreakerView:
+    """The breaker surface (/readyz reasons, dual-write fast-fail) of
+    whichever endpoint is CURRENTLY primary. A dead former leader's
+    permanently-open breaker must not keep a successfully failed-over
+    replica unready forever."""
+
+    def __init__(self, fe: "FailoverEngine"):
+        self._fe = fe
+
+    @property
+    def dependency(self) -> str:
+        return self._fe._primary().breaker.dependency
+
+    def open_reason(self):
+        return self._fe._primary().breaker.open_reason()
+
+    def check_open(self) -> None:
+        self._fe._primary().breaker.check_open()
+
+
+class _FailoverStoreShim:
+    """The sliver of Store the proxy touches, over the failover client."""
+
+    def __init__(self, fe: "FailoverEngine"):
+        self._fe = fe
+
+    def exists(self, f: RelationshipFilter) -> bool:
+        return self._fe._invoke(lambda c: c.store.exists(f))
+
+
+class FailoverEngine:
+    """A RemoteEngine over a LIST of engine endpoints (``--engine-endpoint
+    tcp://h1:p1,h2:p2,...``): every call goes to the current primary;
+    when the primary stops answering — transport death, open breaker,
+    exhausted deadline, or a role-gated ``not_leader`` rejection — the
+    client re-resolves by probing every endpoint's ``failover_state``
+    and re-aims at the leader with the highest term.
+
+    Retry discipline under failover mirrors the single-endpoint client's:
+    reads re-issue against the new primary transparently; writes re-issue
+    ONLY when the failed attempt provably never dispatched (a not_leader
+    rejection or an open breaker) — a write that died mid-transport may
+    have been applied and surfaces its error instead. While no leader is
+    reachable, calls raise :class:`~..utils.resilience.
+    DependencyUnavailable`, which the authz middleware maps to the
+    fail-closed kube 503 + Retry-After."""
+
+    def __init__(self, endpoints: list, token: Optional[str] = None,
+                 probe_timeout: float = 5.0,
+                 resolve_deadline: float = 30.0, **client_kw):
+        if not endpoints:
+            raise RemoteEngineError("failover engine needs >= 1 endpoint")
+        self.endpoints = [(h, int(p)) for h, p in endpoints]
+        self.token = token
+        self._clients = [RemoteEngine(h, p, token=token, **client_kw)
+                         for h, p in self.endpoints]
+        # dedicated probe clients: short budgets, single attempt, and a
+        # breaker that never opens — resolution must stay able to ask a
+        # freshly-recovered host "are you the leader yet?" even after
+        # thousands of failed probes
+        probe_kw = dict(client_kw)
+        probe_kw.pop("breaker", None)
+        probe_kw["timeout"] = probe_timeout
+        probe_kw["connect_timeout"] = min(
+            probe_timeout, client_kw.get("connect_timeout", probe_timeout))
+        probe_kw["retries"] = 0
+        self._probes = [
+            RemoteEngine(h, p, token=token,
+                         breaker=CircuitBreaker(
+                             f"engine-probe:{h}:{p}",
+                             failure_threshold=1 << 30),
+                         **probe_kw)
+            for h, p in self.endpoints]
+        self._resolve_deadline = resolve_deadline
+        self._lock = threading.Lock()
+        self._primary_idx = 0
+        self._last_status: dict = {}
+        # resolution singleflight: during a failover every blocked
+        # request thread wants a resolution pass; one prober at a time
+        # runs it and waiters piggyback on its outcome instead of
+        # stampeding N-endpoint probe storms at the surviving host
+        self._resolve_flight = threading.Lock()
+        self._resolve_gen = 0
+        self._resolve_ok = False
+        # monotonic term floor: once this client has SEEN term T, no
+        # endpoint claiming leadership at a lower term is ever followed
+        # again — a deposed leader partitioned away from its peers still
+        # answers "leader", and aiming reads at its fenced-off state
+        # would serve stale verdicts (fail closed instead)
+        self._max_term = 0
+        self.dependency = "engine-failover:" + ",".join(
+            f"{h}:{p}" for h, p in self.endpoints)
+        self.breaker = _PrimaryBreakerView(self)
+        self.store = _FailoverStoreShim(self)
+
+    def _primary(self) -> RemoteEngine:
+        with self._lock:
+            return self._clients[self._primary_idx]
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve(self) -> bool:
+        """One resolution pass, singleflighted: callers that arrive
+        while another thread is mid-pass wait for IT and share its
+        outcome rather than launching a redundant probe storm."""
+        gen = self._resolve_gen
+        with self._resolve_flight:
+            if self._resolve_gen != gen:
+                return self._resolve_ok  # piggyback on the finished pass
+            ok = self._resolve_once()
+            self._resolve_gen += 1
+            self._resolve_ok = ok
+            return ok
+
+    def _resolve_once(self) -> bool:
+        """Probe every endpoint once and re-aim at the best reachable
+        LEADER (highest term; ties by list order). Probing happens
+        OUTSIDE the primary-index lock — healthy callers reading the
+        index must not stall behind a resolution pass's connect
+        timeouts."""
+        t0 = time.monotonic()
+        states = []
+        for i, probe in enumerate(self._probes):
+            try:
+                st = probe.failover_state()
+            except Exception as e:  # noqa: BLE001 - unreachable peer
+                log.debug("failover probe %s:%s failed: %s",
+                          *self.endpoints[i], e)
+                continue
+            states.append((i, st))
+            self._max_term = max(self._max_term,
+                                 int(st.get("term", 0) or 0))
+        best = None
+        for i, st in states:
+            if st.get("role") != "leader":
+                continue
+            term = int(st.get("term", 0) or 0)
+            if term < self._max_term:
+                # a reachable-but-deposed leader (partitioned from its
+                # peers, so it never demoted): following it would serve
+                # its fenced-off lineage — stay unresolved (fail closed)
+                log.warning(
+                    "ignoring %s:%s claiming leadership at deposed term "
+                    "%d (highest seen: %d)", *self.endpoints[i], term,
+                    self._max_term)
+                continue
+            key = (-term, i)
+            if best is None or key < best[0]:
+                best = (key, i, st)
+        if best is None:
+            return False
+        _, idx, st = best
+        with self._lock:
+            old = self._primary_idx
+            self._primary_idx = idx
+            self._last_status = dict(st)
+        if idx != old:
+            metrics.counter("failover_total").inc()
+            metrics.histogram("failover_duration_seconds").observe(
+                time.monotonic() - t0)
+            log.warning(
+                "engine failover: primary %s:%s -> %s:%s (term %s)",
+                *self.endpoints[old], *self.endpoints[idx],
+                st.get("term"))
+        return True
+
+    def _invoke(self, call, write: bool = False):
+        c = self._primary()
+        try:
+            return call(c)
+        except NotLeaderError as e:
+            cause, retry_ok = e, True  # rejected BEFORE dispatch
+        except DependencyUnavailable as e:
+            # BreakerOpen = no attempt reached the wire (safe even for a
+            # write); an exhausted deadline may have dispatched
+            from ..utils.resilience import BreakerOpen
+
+            cause, retry_ok = e, (not write) or isinstance(e, BreakerOpen)
+        except TRANSPORT_ERRORS as e:
+            cause, retry_ok = e, not write
+        if not retry_ok:
+            # the outcome cannot change by waiting (the write MAY have
+            # been applied): kick ONE resolution pass so the system
+            # heals for subsequent calls, then surface the truth now —
+            # never park a kube write for a whole election window just
+            # to raise the same error
+            self._resolve()
+            raise cause
+        # re-resolve (bounded by resolve_deadline — an election takes
+        # heartbeat-timeout + promotion time) and re-issue
+        deadline = time.monotonic() + self._resolve_deadline
+        while not self._resolve():
+            if time.monotonic() >= deadline:
+                raise DependencyUnavailable(
+                    self.dependency,
+                    "no engine replication leader reachable among "
+                    f"{len(self.endpoints)} endpoints "
+                    "(failover in progress?)",
+                    retry_after=1.0) from cause
+            time.sleep(0.2)
+        return call(self._primary())
+
+    # -- engine surface (the slice the proxy consumes) -----------------------
+
+    def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
+        return self.check_bulk([item], now=now)[0]
+
+    def check_bulk(self, items: list, now: Optional[float] = None) -> list:
+        return self._invoke(lambda c: c.check_bulk(items, now=now))
+
+    def lookup_resources(self, resource_type: str, permission: str,
+                         subject_type: str, subject_id: str,
+                         subject_relation: Optional[str] = None,
+                         now: Optional[float] = None) -> list:
+        return self._invoke(lambda c: c.lookup_resources(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now))
+
+    def lookup_resources_mask(self, resource_type: str, permission: str,
+                              subject_type: str, subject_id: str,
+                              subject_relation: Optional[str] = None,
+                              now: Optional[float] = None):
+        return self._invoke(lambda c: c.lookup_resources_mask(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now))
+
+    def write_relationships(self, ops: list,
+                            preconditions: list = ()) -> int:
+        return self._invoke(
+            lambda c: c.write_relationships(ops, preconditions),
+            write=True)
+
+    def delete_relationships(self, f: RelationshipFilter,
+                             preconditions: list = ()) -> int:
+        return self._invoke(
+            lambda c: c.delete_relationships(f, preconditions),
+            write=True)
+
+    def read_relationships(self, f: RelationshipFilter):
+        return self._invoke(lambda c: c.read_relationships(f))
+
+    def watch_since(self, revision: int) -> list:
+        return self._invoke(lambda c: c.watch_since(revision))
+
+    def watch_push_stream(self, from_revision: int) -> RemoteWatchStream:
+        return self._invoke(lambda c: c.watch_push_stream(from_revision))
+
+    def watch_gate(self, resource_type: str, name: str):
+        return self._invoke(lambda c: c.watch_gate(resource_type, name))
+
+    @property
+    def revision(self) -> int:
+        return self._invoke(lambda c: c.revision)
+
+    def _probe_primary(self) -> Optional[dict]:
+        c = self._primary()
+        if c.breaker.open_reason() is not None:
+            return None  # known-dead: don't stack a connect timeout
+        try:
+            st = self._probes[self._clients.index(c)].failover_state()
+        except Exception:  # noqa: BLE001 - unreachable primary
+            return None
+        term = int(st.get("term", 0) or 0)
+        self._max_term = max(self._max_term, term)
+        if st.get("role") != "leader" or term < self._max_term:
+            return None  # demoted, or a deposed straggler still leading
+        with self._lock:
+            self._last_status = dict(st)
+        return st
+
+    def replication_status(self) -> dict:
+        """{role, term, lag} of the current primary, for /readyz. When
+        the primary looks dead or demoted, attempt a resolution pass
+        first: an IDLE proxy has no data traffic to trigger _invoke's
+        re-resolve, and without this its /readyz would stay unready
+        forever after a failover — unreadiness would then keep the
+        traffic away that could have healed it (the same trap the
+        breaker's probe-eligible /readyz rule avoids)."""
+        st = self._probe_primary()
+        if st is None and self._resolve():
+            st = self._probe_primary()
+        if st is None:
+            return {"role": "electing",
+                    "term": self._last_status.get("term"), "lag": None}
+        return {"role": st.get("role"), "term": st.get("term"),
+                "lag": st.get("lag")}
+
+    def close(self) -> None:
+        for c in self._clients + self._probes:
+            c.close()
+
 
 def main(argv=None) -> int:
     """Standalone engine host: ``python -m
@@ -1039,6 +1494,34 @@ def main(argv=None) -> int:
     ap.add_argument("--mirror-leader",
                     help="(follower processes) host:port of process 0's "
                          "engine endpoint to subscribe to")
+    ap.add_argument("--peers",
+                    help="replicated-set mode with AUTOMATIC leader "
+                         "failover: comma-separated host:port of EVERY "
+                         "engine host in the set, in peer-id order "
+                         "(mutually exclusive with --distributed; see "
+                         "docs/operations.md 'Leader failover')")
+    ap.add_argument("--peer-id", type=int, default=0,
+                    help="this process's index into --peers")
+    ap.add_argument("--mirror-heartbeat-seconds", type=float, default=2.0,
+                    help="(--peers) leader heartbeat cadence on the "
+                         "mirror stream; followers detect a dead leader "
+                         "within ~3x this")
+    ap.add_argument("--mirror-heartbeat-timeout", type=float, default=0.0,
+                    help="(--peers) follower's dead-leader window "
+                         "(0 = 3x heartbeat + 1s)")
+    ap.add_argument("--replication-timeout", type=float, default=10.0,
+                    help="(--peers) how long an acked write waits for "
+                         "follower acknowledgement before the laggard "
+                         "is dropped to catch-up")
+    ap.add_argument("--min-sync-replicas", type=int, default=0,
+                    help="(--peers) durability floor: with fewer live "
+                         "followers than this, writes FAIL CLOSED "
+                         "instead of acking unreplicated (0 = keep "
+                         "serving when the last follower dies — "
+                         "availability over redundancy)")
+    ap.add_argument("--failover-boot-grace", type=float, default=20.0,
+                    help="(--peers) boot-time wait for the rest of the "
+                         "set before electing from partial visibility")
     ap.add_argument("--lookup-batch-window", type=float, default=0.0,
                     help="fuse concurrent lookup_mask requests (across "
                          "ALL connected proxies) into shared device "
@@ -1076,6 +1559,23 @@ def main(argv=None) -> int:
     if args.engine_insecure and args.tls_cert_file:
         ap.error("--engine-insecure and --tls-cert-file are mutually "
                  "exclusive")
+    peers = None
+    if args.peers:
+        from ..parallel.failover import FailoverError, parse_peers
+
+        if args.distributed:
+            ap.error("--peers (automatic failover) and --distributed "
+                     "(SPMD lockstep) are mutually exclusive deployment "
+                     "shapes")
+        try:
+            peers = parse_peers(args.peers)
+        except FailoverError as e:
+            ap.error(str(e))
+        if not 0 <= args.peer_id < len(peers):
+            ap.error(f"--peer-id {args.peer_id} out of range for "
+                     f"{len(peers)} peers")
+        if args.mirror_heartbeat_seconds <= 0:
+            ap.error("--mirror-heartbeat-seconds must be > 0")
     # a mirror FOLLOWER never serves — it only dials the leader — so the
     # refuse-plaintext-serving check must not force cert/key on it
     is_follower = False
@@ -1196,6 +1696,21 @@ def main(argv=None) -> int:
             engine, min_subscribers=_jax.process_count() - 1)
     server = EngineServer(engine, args.bind_host, args.bind_port,
                           token=args.token, ssl_context=server_ssl)
+    coordinator = None
+    if peers is not None:
+        from ..parallel.failover import FailoverCoordinator
+
+        coordinator = FailoverCoordinator(
+            engine, server, peers, args.peer_id,
+            token=args.token, data_dir=args.data_dir,
+            heartbeat_interval=args.mirror_heartbeat_seconds,
+            heartbeat_timeout=(args.mirror_heartbeat_timeout or None),
+            replication_timeout=args.replication_timeout,
+            min_sync_replicas=args.min_sync_replicas,
+            client_ssl=mirror_ssl,
+            boot_grace=args.failover_boot_grace)
+        log.info("failover set: peer %d of %d (term %d)", args.peer_id,
+                 len(peers), coordinator.term)
 
     async def serve():
         stop = asyncio.Event()
@@ -1203,7 +1718,14 @@ def main(argv=None) -> int:
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
         await server.start()
+        if coordinator is not None:
+            # the role state machine runs beside the asyncio server: it
+            # swaps server.engine between the bare engine (follower,
+            # role-gated) and the term-stamped mirror wrapper (leader)
+            coordinator.start()
         await stop.wait()
+        if coordinator is not None:
+            coordinator.stop()
         await server.stop()
         if args.snapshot_path:
             engine.save_snapshot(args.snapshot_path)

@@ -739,6 +739,11 @@ class Store:
         elapses — then ``[]``), and return them. Push-latency watch
         consumption: one waiting thread per hub, zero polling."""
         with self._watch_cond:
+            if revision > self.revision:
+                # from-the-future guard (see watch_since): never park a
+                # stale-lineage watcher until the numbers happen to
+                # overlap — it would silently miss the whole window
+                return self.watch_since(revision)
             if self.revision <= revision:
                 self._watch_cond.wait(timeout)
             if self.revision <= revision:
@@ -748,8 +753,18 @@ class Store:
     def watch_since(self, revision: int) -> list[WatchRecord]:
         """Watch events with revision > the given revision. Binary-searched
         (records are appended in revision order); raises if the requested
-        revision predates the retained history."""
+        revision predates the retained history — or runs AHEAD of it: a
+        revision from the future can only come from a superseded lineage
+        (a leader-failover rebase can move this store to a LOWER revision
+        than the one it served before), and blocking until the new
+        lineage's numbers catch up would silently skip every event in
+        the overlap, revocations included."""
         with self._lock:
+            if revision > self.revision:
+                raise StoreError(
+                    f"watch revision {revision} is ahead of the store "
+                    f"(revision {self.revision}); the watched lineage "
+                    "was superseded — re-list and re-watch")
             if revision < self._watch_oldest_rev:
                 raise StoreError(
                     f"watch history before revision {self._watch_oldest_rev} "

@@ -40,6 +40,14 @@ Deploy the process set as a unit; an orchestrator restart heals it
 test_multihost_follower_death_blocks_leader_restart_heals). Reads that
 touch no device (store reads, watch_gate, revision) are served
 leader-locally without mirroring.
+
+The SAME mirror machinery also carries the primary/replica FAILOVER
+deployment (`--peers`, parallel/failover.py): there MirroredEngine
+runs with ``mirror_queries=False`` (no SPMD lockstep — queries serve
+leader-locally) and ``sync_replication=True`` (a write's ack waits for
+every live follower to apply AND journal its frame), every frame/
+heartbeat/catch-up/ack carries a fenced ``term``, and a dead LEADER is
+survivable: a follower promotes and clients re-resolve.
 """
 
 from __future__ import annotations
@@ -51,11 +59,43 @@ from typing import Optional
 
 import jax
 
+from ..utils.metrics import metrics
+
 log = logging.getLogger("sdbkp.multihost")
 
 
 class MultiHostError(RuntimeError):
     pass
+
+
+class StaleTermError(MultiHostError):
+    """A mirror frame (or subscription ack) carried a term OLDER than the
+    one this process has already adopted: a deposed leader's late output.
+    Fencing rejects it — applying it would fork the store lineages."""
+
+
+class LeaderLost(MultiHostError):
+    """The mirror stream's leader stopped heartbeating (or the connection
+    died) while the follower was configured to treat that as a failover
+    trigger rather than an orchestrator-restart event."""
+
+
+def fence_term(frame_term, current_term: int) -> int:
+    """The ONE fencing check: given the term stamped on an incoming
+    mirror artifact (frame, heartbeat, catch-up cut, subscription ack;
+    ``None`` = a pre-term peer) and the highest term this process has
+    adopted, return the possibly-advanced current term — or raise
+    :class:`StaleTermError` (counting it) when the artifact belongs to a
+    deposed lineage."""
+    if frame_term is None:
+        return current_term
+    frame_term = int(frame_term)
+    if frame_term < current_term:
+        metrics.counter("mirror_frames_rejected_stale_term_total").inc()
+        raise StaleTermError(
+            f"rejecting mirror frame from deposed term {frame_term} "
+            f"(current term {current_term})")
+    return frame_term
 
 
 def parse_distributed_spec(spec: str) -> tuple[str, int, int]:
@@ -105,12 +145,49 @@ class MirroredEngine:
     lookup_resources[_mask], write/delete/read, watch, store, gate)."""
 
     def __init__(self, engine, min_subscribers: int = 0,
-                 join_timeout: float = 300.0):
+                 join_timeout: float = 300.0, term: int = 0,
+                 mirror_queries: bool = True,
+                 sync_replication: bool = False,
+                 replication_timeout: float = 10.0,
+                 min_sync_replicas: int = 0):
         self.engine = engine
         self._lock = threading.Lock()
         self._subs: list[queue.Queue] = []
         self._subs_lock = threading.Lock()
         self._seq = 0
+        # fenced term (leader failover, parallel/failover.py): stamped
+        # into every published frame, heartbeat, and catch-up cut so a
+        # deposed leader's late output is rejectable. 0 = the legacy SPMD
+        # lockstep deployment, which never changes leaders.
+        self.term = int(term)
+        # revision at promotion: shared history ends here. A subscriber
+        # resuming from a REVISION past this point with a TERM before
+        # ours lived through writes this lineage fenced off — the
+        # general form of PR 3's "follower ahead of leader" rule.
+        self.baseline_revision = int(engine.revision)
+        # failover (primary/replica) mode mirrors only MUTATIONS: there
+        # is no SPMD collective lockstep to feed, so queries serve
+        # leader-locally (decision cache and batching stay effective)
+        self._mirror_queries = mirror_queries
+        # sync replication: a mutation does not return to the caller
+        # until every live subscriber has ACKED its frame (having
+        # journaled it under the follower's own fsync policy) — the
+        # no-acked-write-lost guarantee leader SIGKILL failover needs
+        self._sync_replication = sync_replication
+        self._replication_timeout = replication_timeout
+        # durability floor: with fewer live subscribers than this, writes
+        # FAIL CLOSED instead of acking unreplicated (the window a
+        # partitioned leader would otherwise silently lose on demotion).
+        # 0 = availability over redundancy (a 1-of-2 set keeps serving).
+        self._min_sync_replicas = int(min_sync_replicas)
+        self._ack_cond = threading.Condition(self._subs_lock)
+        self._acked: dict[int, int] = {}  # id(queue) -> highest acked seq
+        # id(queue) -> catch-up cut seq: frames at or before the cut are
+        # NOT this subscriber's responsibility (the transfer covers
+        # them) — but that is responsibility accounting, not durability:
+        # only a real ack (the follower applied AND journaled) counts
+        # toward the min-sync floor
+        self._join_cut: dict[int, int] = {}
         # JOIN BARRIER: a leader must not execute (or drop!) any action
         # before every follower is subscribed — writes never touch the
         # device, so nothing else would stop an early client write from
@@ -124,15 +201,28 @@ class MirroredEngine:
 
     # -- follower stream -----------------------------------------------------
 
+    @property
+    def mirror_seq(self) -> int:
+        with self._subs_lock:
+            return self._seq
+
     def subscribe(self) -> "queue.Queue[dict]":
         q: queue.Queue = queue.Queue()
         with self._subs_lock:
             self._subs.append(q)
+            # frames sequenced before this join are not the new
+            # subscriber's RESPONSIBILITY (they were never sent to it;
+            # a catch-up cut supersedes this with its own seq) — but
+            # responsibility is not durability: _acked starts at 0 and
+            # only real acks ever satisfy the min-sync floor
+            self._acked[id(q)] = 0
+            self._join_cut[id(q)] = self._seq
             if len(self._subs) >= self._min_subs:
                 self._joined.set()
         return q
 
-    def subscribe_with_catchup(self, from_revision: int):
+    def subscribe_with_catchup(self, from_revision: int,
+                               subscriber_term: Optional[int] = None):
         """(queue, catch-up meta, optional state payload) for a RESUMING
         follower (``mirror_subscribe`` with ``from_revision``).
 
@@ -159,11 +249,38 @@ class MirroredEngine:
         with self._lock:
             with self._subs_lock:
                 seq = self._seq
+                # the catch-up cut covers every frame at or before it,
+                # and the follower rightly never acks frames it skips —
+                # record the cut so a sync-replicated write racing this
+                # join neither stalls a full replication timeout nor
+                # kicks the freshly joined follower. This is NOT an ack:
+                # the transfer hasn't reached the follower yet, so it
+                # must not count toward the min-sync durability floor
+                # (the follower acks the cut itself once the catch-up
+                # is applied and journaled — follower_loop).
+                self._join_cut[id(q)] = seq
+                self._ack_cond.notify_all()
             store = self.engine.store
             rev = store.revision
-            if from_revision == rev:
-                return q, {"revision": rev, "seq": seq}, None
-            if from_revision > rev:
+            # the general fencing form of the "follower ahead of leader"
+            # rule below: a subscriber from a DEPOSED term whose revision
+            # runs past our promotion baseline lived through writes this
+            # lineage fenced off — its revision NUMBERS overlap ours but
+            # name different history, so neither "already current" nor an
+            # effects replay is sound. Full state, unconditionally.
+            deposed = (subscriber_term is not None and self.term
+                       and int(subscriber_term) < self.term
+                       and from_revision > self.baseline_revision)
+            if deposed:
+                log.warning(
+                    "subscriber resumes from deposed term %s at revision "
+                    "%d past promotion baseline %d (term %d); sending "
+                    "full state", subscriber_term, from_revision,
+                    self.baseline_revision, self.term)
+            if not deposed and from_revision == rev:
+                return q, {"revision": rev, "seq": seq,
+                           "term": self.term}, None
+            if not deposed and from_revision > rev:
                 # the follower claims MORE history than the leader has:
                 # a lost leader disk or a rolled-back fsync window — the
                 # lineages diverged, and "already current" would freeze
@@ -173,7 +290,7 @@ class MirroredEngine:
                     "follower resume revision %d is ahead of leader "
                     "revision %d (diverged lineage); sending full state",
                     from_revision, rev)
-            elif from_revision >= store.unlogged_revision:
+            elif not deposed and from_revision >= store.unlogged_revision:
                 try:
                     records = store.watch_since(from_revision)
                 except StoreError:
@@ -185,6 +302,7 @@ class MirroredEngine:
                         for r in records
                     ]
                     return q, {"revision": rev, "seq": seq,
+                               "term": self.term,
                                "effects": effects}, None
             # full state transfer: COLLECT under the lock (the arrays are
             # immutable copies cut consistently with `seq`)...
@@ -193,21 +311,130 @@ class MirroredEngine:
         # store must not stall every leader write and mirrored query
         payload = store.encode_state(cols, meta)
         return q, {"revision": int(meta["revision"]), "seq": seq,
-                   "state": True}, payload
+                   "term": self.term, "state": True}, payload
 
     def unsubscribe(self, q) -> None:
         with self._subs_lock:
             if q in self._subs:
                 self._subs.remove(q)
+            self._acked.pop(id(q), None)
+            self._join_cut.pop(id(q), None)
+            # a write parked in _wait_replicated stops waiting for a
+            # subscriber that no longer exists
+            self._ack_cond.notify_all()
+
+    def close_subscribers(self) -> None:
+        """Terminate every mirror stream (deposed-leader demotion,
+        parallel/failover.py): a follower still subscribed here would
+        otherwise keep receiving valid old-term heartbeats from the
+        frozen wrapper and never notice the leadership change. The None
+        sentinel makes each connection handler close its stream; the
+        follower sees LeaderLost and re-elects toward the new lineage."""
+        with self._subs_lock:
+            for q in self._subs:
+                q.put(None)
+            self._subs.clear()
+            self._acked.clear()
+            self._join_cut.clear()
+            self._ack_cond.notify_all()
+
+    def record_ack(self, q, seq: int, term: Optional[int] = None) -> None:
+        """A follower acknowledged every frame up to ``seq`` (having
+        applied AND journaled them). Cross-subscription confusion is
+        impossible by construction (``q`` is the connection's own queue
+        object, not a wire-carried id), so only a FUTURE-term ack is
+        rejected as nonsensical — an older-term ack is legitimate
+        lineage continuity when an equal-term conflict bumped this
+        wrapper's term mid-flight, and dropping it would stall the
+        write and kick a healthy follower."""
+        if term is not None and self.term and int(term) > self.term:
+            return
+        with self._subs_lock:
+            if id(q) in self._acked:
+                self._acked[id(q)] = max(self._acked[id(q)], int(seq))
+                self._ack_cond.notify_all()
+
+    def _wait_replicated(self, seq: int) -> None:
+        """Block until every LIVE subscriber has acked ``seq``. A
+        subscriber that dies mid-wait stops being waited on when its
+        connection handler unsubscribes it; one that stalls past the
+        replication timeout is dropped (it rejoins through catch-up) so a
+        wedged follower bounds, not wedges, the leader's write path.
+        When dropping laggards leaves fewer acked replicas than the
+        ``min_sync_replicas`` floor, the write FAILS instead of acking —
+        the mutation is applied locally (outcome: unknown to the
+        caller, exactly like a write whose response connection died),
+        never acknowledged as durable when it is not."""
+        import time as _time
+
+        deadline = _time.monotonic() + self._replication_timeout
+        # ids observed acking >= seq at ANY point — an ack is a durable
+        # journal entry on that replica, so it still counts toward the
+        # floor if the follower then rotates away; a follower that
+        # UNSUBSCRIBES WITHOUT acking (connection died mid-frame) never
+        # enters this set, so the floor check below catches it even
+        # though the no-laggards exit fires the moment it departs
+        satisfied: set[int] = set()
+        with self._subs_lock:
+            while True:
+                laggards = []
+                for q in self._subs:
+                    if self._acked.get(id(q), 0) >= seq:
+                        satisfied.add(id(q))
+                    elif self._join_cut.get(id(q), -1) >= seq:
+                        # the frame is inside this joiner's catch-up cut:
+                        # not a laggard (don't stall or kick it), but not
+                        # durably acked either — it joins `satisfied`
+                        # only via its real post-catch-up cut ack
+                        pass
+                    else:
+                        laggards.append(q)
+                # done only when nobody is behind AND the durability
+                # floor is met — a joiner mid-catch-up is not a laggard
+                # but hasn't journaled yet, so a floored write keeps
+                # waiting (bounded) for its post-catch-up ack
+                if not laggards \
+                        and len(satisfied) >= self._min_sync_replicas:
+                    break
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    for q in laggards:
+                        log.warning(
+                            "dropping mirror subscriber %d frames behind "
+                            "after %.1fs replication timeout (it can "
+                            "rejoin via catch-up)",
+                            seq - self._acked.get(id(q), 0),
+                            self._replication_timeout)
+                        self._subs.remove(q)
+                        self._acked.pop(id(q), None)
+                        # a None sentinel makes the connection handler
+                        # close the stream — the follower must SEE the
+                        # drop (a silently unfed queue would heartbeat
+                        # forever while diverging)
+                        q.put(None)
+                    self._ack_cond.notify_all()
+                    break
+                self._ack_cond.wait(left)
+        if len(satisfied) < self._min_sync_replicas:
+            from ..engine.store import StoreError
+
+            raise StoreError(
+                f"write replicated to only {len(satisfied)} replica(s) "
+                f"within {self._replication_timeout:.1f}s, below the "
+                f"min-sync-replicas floor of {self._min_sync_replicas}; "
+                "treating the outcome as unknown (applied locally, not "
+                "acknowledged as durable)")
 
     def _publish(self, method: str, payload: dict,
-                 blob: Optional[bytes] = None) -> None:
+                 blob: Optional[bytes] = None) -> Optional[int]:
         """Serialize the action ONCE into wire bytes and fan the same
         bytes object out to every subscriber queue — at N followers the
         leader must not pay N JSON encodes per device dispatch (measured
         -33%/-52% leader throughput at 1/3 followers before this;
         bench_results/multihost_r5_cpu.json). ``blob`` rides a binary
-        frame (meta + payload) for the hot check_bulk item batches."""
+        frame (meta + payload) for the hot check_bulk item batches.
+        Returns the frame's sequence number, or None when nobody was
+        subscribed (nothing to wait replicated on)."""
         from ..engine.remote import BinaryResult, _pack, _pack_binary
 
         if not self._joined.wait(self._join_timeout):
@@ -225,13 +452,15 @@ class MirroredEngine:
                 # seq still advances; a later joiner baselines on the
                 # first frame it receives (and must join before traffic
                 # to share store state, per the join-barrier contract)
-                return
+                return None
         # serialize OUTSIDE _subs_lock: a multi-MB check_bulk encode must
         # not block subscribe()/unsubscribe() (a rejoining follower's join
         # barrier would wait out encode time per batch). Frame ordering is
         # unaffected — every _publish call site already serializes on the
         # engine-level self._lock.
         frame = {"seq": seq, "method": method, **payload}
+        if self.term:
+            frame["term"] = self.term
         if blob is None:
             wire = _pack({"ok": True, "frame": frame})
         else:
@@ -240,15 +469,33 @@ class MirroredEngine:
                 BinaryResult({"ok": True, "frame": frame}, blob))
         for q in subs:
             q.put(wire)
+        return seq
 
     # -- mirrored mutations --------------------------------------------------
+
+    def _require_replicas(self) -> None:
+        """Fail a mutation CLOSED when the live subscriber count is below
+        the configured durability floor — an ack the leader could not
+        replicate is an ack a failover may silently discard."""
+        if not self._sync_replication or self._min_sync_replicas <= 0:
+            return
+        from ..engine.store import StoreError
+
+        with self._subs_lock:
+            n = len(self._subs)
+        if n < self._min_sync_replicas:
+            raise StoreError(
+                f"only {n} live replica(s), below the min-sync-replicas "
+                f"floor of {self._min_sync_replicas}: refusing the write "
+                "(an unreplicated ack would not survive leader failover)")
 
     def write_relationships(self, ops, preconditions=()):
         from ..engine.remote import _rel_to_dict
         from dataclasses import asdict
 
+        self._require_replicas()
         with self._lock:
-            self._publish("write_relationships", {
+            seq = self._publish("write_relationships", {
                 "ops": [{"op": o.op, "rel": _rel_to_dict(o.rel)}
                         for o in ops],
                 "preconditions": [
@@ -256,21 +503,27 @@ class MirroredEngine:
                      "must_exist": p.must_exist}
                     for p in preconditions],
             })
-            return self.engine.write_relationships(
+            result = self.engine.write_relationships(
                 list(ops), list(preconditions))
+        self._maybe_wait(seq)
+        return result
 
     def delete_relationships(self, f, preconditions=()):
         from dataclasses import asdict
 
+        self._require_replicas()
         with self._lock:
-            self._publish("delete_relationships", {
+            seq = self._publish("delete_relationships", {
                 "filter": asdict(f),
                 "preconditions": [
                     {"filter": asdict(p.filter),
                      "must_exist": p.must_exist}
                     for p in preconditions],
             })
-            return self.engine.delete_relationships(f, list(preconditions))
+            result = self.engine.delete_relationships(
+                f, list(preconditions))
+        self._maybe_wait(seq)
+        return result
 
     def bulk_load(self, rels_cols):
         # columnar payloads are huge: ride the binary-payload frame (the
@@ -280,10 +533,36 @@ class MirroredEngine:
         # LAZILY so a subscriber-less leader pays nothing
         from ..persistence.codec import encode_bulk_cols
 
+        self._require_replicas()
         with self._lock:
-            self._publish("bulk_load", {},
-                          blob=lambda: encode_bulk_cols(rels_cols))
-            return self.engine.bulk_load(rels_cols)
+            seq = self._publish("bulk_load", {},
+                                blob=lambda: encode_bulk_cols(rels_cols))
+            result = self.engine.bulk_load(rels_cols)
+        self._maybe_wait(seq)
+        return result
+
+    def _maybe_wait(self, seq: Optional[int]) -> None:
+        # outside the mirror lock on purpose: waiting for follower acks
+        # must not serialize every other mirrored op behind one write's
+        # replication round trip
+        if not self._sync_replication:
+            return
+        if seq is None:
+            # nobody was subscribed at publish time. _require_replicas
+            # ran before the mirror lock, so the last follower can
+            # vanish in between — the floor must hold on the PUBLISH
+            # outcome too, or that race acks an unreplicated write
+            if self._min_sync_replicas > 0:
+                from ..engine.store import StoreError
+
+                raise StoreError(
+                    "write published to 0 replicas (the last follower "
+                    "left mid-write), below the min-sync-replicas floor "
+                    f"of {self._min_sync_replicas}; treating the outcome "
+                    "as unknown (applied locally, not acknowledged as "
+                    "durable)")
+            return
+        self._wait_replicated(seq)
 
     # -- mirrored queries ----------------------------------------------------
 
@@ -293,6 +572,10 @@ class MirroredEngine:
     def check_bulk_async(self, items, now=None):
         import time as _time
 
+        if not self._mirror_queries:
+            # failover (primary/replica) mode: no SPMD lockstep to feed —
+            # queries serve leader-locally (cache/batching stay live)
+            return self.engine.check_bulk_async(items, now=now)
         if now is None:
             now = _time.time()  # concrete BEFORE publishing
         # normalize ONCE and execute the normalized items locally too —
@@ -333,6 +616,10 @@ class MirroredEngine:
                                     subject_relation=None, now=None):
         import time as _time
 
+        if not self._mirror_queries:
+            return self.engine.lookup_resources_mask_async(
+                resource_type, permission, subject_type, subject_id,
+                subject_relation, now=now)
         if now is None:
             now = _time.time()
         with self._lock:
@@ -484,13 +771,27 @@ def apply_catchup(engine, meta: dict, blob: Optional[bytes]) -> None:
     landing the store exactly at the leader's revision. No-op when the
     follower was already current."""
     if blob is not None:
-        engine.store.load_state_bytes(blob)
+        persistence = getattr(engine, "_persistence", None)
+        if persistence is not None:
+            # a full-state transfer is a NEW LINEAGE BASELINE: the local
+            # WAL + snapshots describe superseded (possibly fenced-off)
+            # history whose revision numbers may overlap the incoming
+            # ones — keeping them would make the next boot's replay see
+            # revisions go backwards. Rebase: wipe, install, re-journal
+            # the baseline as the fresh log's first record.
+            persistence.rebase(blob)
+        else:
+            engine.store.load_state_bytes(blob)
         # a diverged-lineage transfer can land on the SAME revision
         # number with different rows — the revision check alone would
-        # keep serving the old lineage's compiled graph
+        # keep serving the old lineage's compiled graph (and the old
+        # lineage's decision-cache verdicts under colliding revisions)
         if hasattr(engine, "_compiled"):
             with engine._lock:
                 engine._compiled = None
+        cache = getattr(engine, "_decision_cache", None)
+        if cache is not None:
+            cache.clear()
         log.info("catch-up: installed leader state at revision %d",
                  engine.store.revision)
         return
@@ -501,11 +802,24 @@ def apply_catchup(engine, meta: dict, blob: Optional[bytes]) -> None:
                  len(effects), engine.store.revision)
 
 
+# mirror frames that mutate store state (and therefore get follower
+# acks under sync replication — query frames advance nothing durable)
+MUTATION_METHODS = frozenset(
+    {"write_relationships", "delete_relationships", "bulk_load"})
+
+
 def follower_loop(engine, leader_host: str, leader_port: int,
                   token: Optional[str] = None,
                   ssl_context=None,
                   server_hostname: Optional[str] = None,
-                  from_revision: Optional[int] = None) -> None:
+                  from_revision: Optional[int] = None,
+                  current_term: int = 0,
+                  heartbeat_timeout: Optional[float] = None,
+                  ack: bool = False,
+                  fail_on_loss: bool = False,
+                  on_term=None,
+                  on_progress=None,
+                  connect_deadline: float = 120.0) -> None:
     """Blocking follower: subscribe to the leader's mirror stream and
     replay every action on the local engine — the device dispatches then
     meet the leader's inside the shard_map collectives. Returns when
@@ -518,7 +832,19 @@ def follower_loop(engine, leader_host: str, leader_port: int,
     catch-up: the delta since that revision arrives as the stream's first
     frame (effects replay or a full state transfer) before live mirror
     frames, so rejoining needs no manual bulk_load and no unbroken
-    process-lifetime stream."""
+    process-lifetime stream.
+
+    Failover-mode knobs (parallel/failover.py is the one caller):
+    ``current_term`` fences every term-stamped artifact on the stream
+    (:func:`fence_term`; ``on_term`` fires when a HIGHER term is adopted
+    so the caller can persist it); ``heartbeat_timeout`` shrinks the
+    dead-leader detection window and surfaces it as :class:`LeaderLost`
+    (as does a dropped connection, when ``fail_on_loss``); ``ack`` sends
+    per-mutation acknowledgements back up the stream (the leader's sync
+    replication waits on them — the frame is applied AND journaled under
+    this store's fsync policy before the ack leaves); ``on_progress``
+    receives the follower's lag in frames behind the leader's heartbeat
+    sequence."""
     import socket
     import struct
     import time as _time
@@ -527,7 +853,7 @@ def follower_loop(engine, leader_host: str, leader_port: int,
 
     # the leader binds its port AFTER the symmetric jax.distributed
     # startup, so the follower may dial first: retry refusals briefly
-    deadline = _time.monotonic() + 120
+    deadline = _time.monotonic() + connect_deadline
     while True:
         try:
             s = socket.create_connection((leader_host, leader_port),
@@ -550,21 +876,43 @@ def follower_loop(engine, leader_host: str, leader_port: int,
     # slower means a dead leader, not an idle one (a None timeout would
     # leave a partitioned follower blocked forever, invisible to its
     # supervisor)
-    s.settimeout(EngineServer.PUSH_HEARTBEAT * 3 + 5.0)
+    if heartbeat_timeout is None:
+        heartbeat_timeout = EngineServer.PUSH_HEARTBEAT * 3 + 5.0
+    s.settimeout(heartbeat_timeout)
     msg = {"op": "mirror_subscribe"}
     if from_revision is not None:
         msg["from_revision"] = int(from_revision)
+    if current_term:
+        msg["term"] = int(current_term)
     if token:
         msg["token"] = token
+
+    def adopt(frame_term):
+        nonlocal current_term
+        new = fence_term(frame_term, current_term)
+        if new > current_term:
+            current_term = new
+            if on_term is not None:
+                on_term(new)
+
     try:
         s.sendall(_pack(msg))
-        ack = _read_frame_sync(s)
-        if isinstance(ack, tuple) or not ack.get("ok"):
-            raise MultiHostError(f"mirror subscribe rejected: {ack}")
+        ack_frame = _read_frame_sync(s)
+        if isinstance(ack_frame, tuple) or not ack_frame.get("ok"):
+            raise MultiHostError(f"mirror subscribe rejected: {ack_frame}")
+        adopt((ack_frame.get("result") or {}).get("term"))
         expect = None
         skip_upto = None
+        applied_seq = 0
         while True:
-            frame = _read_frame_sync(s)
+            try:
+                frame = _read_frame_sync(s)
+            except TimeoutError:
+                metrics.counter("mirror_heartbeat_misses_total").inc()
+                raise LeaderLost(
+                    f"leader {leader_host}:{leader_port} missed its "
+                    f"heartbeat window ({heartbeat_timeout:.1f}s)"
+                ) from None
             blob = None
             if isinstance(frame, tuple):
                 # binary mirror frame: (meta, payload) — the hot
@@ -573,14 +921,29 @@ def follower_loop(engine, leader_host: str, leader_port: int,
             if not frame.get("ok"):
                 raise MultiHostError(f"mirror stream error: {frame}")
             if frame.get("hb"):
+                adopt(frame.get("term"))
+                hb_seq = frame.get("seq")
+                if on_progress is not None and hb_seq is not None:
+                    on_progress(max(0, int(hb_seq) - applied_seq))
                 continue  # idle-stream liveness heartbeat
             if "catchup" in frame:
+                adopt(frame["catchup"].get("term"))
                 apply_catchup(engine, frame["catchup"], blob)
                 # actions sequenced at or before the cut are inside the
                 # catch-up state; queued frames up to it must be skipped
                 skip_upto = frame["catchup"].get("seq")
+                applied_seq = int(skip_upto or 0)
+                if ack and applied_seq:
+                    # the transfer is applied AND journaled (rebase /
+                    # effects both run the store's journal hook): every
+                    # frame the cut covers is now durable HERE — ack it
+                    # so floored writes that raced the join get their
+                    # durability credit
+                    s.sendall(_pack({"ack": applied_seq,
+                                     "term": current_term}))
                 continue
             payload = frame["frame"]
+            adopt(payload.get("term"))
             # first frame sets the baseline (a leader cannot have served
             # traffic before followers joined — its collectives would
             # have blocked — so nothing real precedes it); after that the
@@ -593,7 +956,17 @@ def follower_loop(engine, leader_host: str, leader_port: int,
             if skip_upto is not None and payload["seq"] <= skip_upto:
                 continue  # already covered by the catch-up cut
             apply_mirror_frame(engine, payload, blob)
+            applied_seq = int(payload["seq"])
+            if ack and payload["method"] in MUTATION_METHODS:
+                # applied AND journaled (the store's journal hook runs
+                # under its write lock inside the apply): safe to credit
+                s.sendall(_pack({"ack": applied_seq,
+                                 "term": current_term}))
     except (ConnectionResetError, struct.error):
+        if fail_on_loss:
+            raise LeaderLost(
+                f"leader {leader_host}:{leader_port} closed the mirror "
+                "stream") from None
         return  # leader went away: the process set restarts as a unit
     finally:
         s.close()

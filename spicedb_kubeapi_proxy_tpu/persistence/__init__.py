@@ -18,7 +18,7 @@ catch-up).
 """
 
 from .codec import decode_bulk_cols, encode_bulk_cols
-from .manager import Persistence
+from .manager import Persistence, load_term, store_term
 from .recovery import RecoveryError, RecoveryResult, recover
 from .snapshot import Checkpointer, list_snapshots, write_snapshot
 from .wal import WalError, WriteAheadLog, parse_fsync_policy
@@ -33,7 +33,9 @@ __all__ = [
     "decode_bulk_cols",
     "encode_bulk_cols",
     "list_snapshots",
+    "load_term",
     "parse_fsync_policy",
     "recover",
+    "store_term",
     "write_snapshot",
 ]

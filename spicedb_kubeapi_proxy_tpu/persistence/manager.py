@@ -37,6 +37,53 @@ from .wal import DEFAULT_FSYNC, DEFAULT_SEGMENT_BYTES, WriteAheadLog
 
 log = logging.getLogger("sdbkp.persistence")
 
+# replication-term file (leader failover, parallel/failover.py): one
+# line of JSON, written atomically + fsynced on every bump so a fencing
+# decision survives SIGKILL — a restarted process must never come back
+# believing an older term than the one it acted under
+TERM_FILE = "term"
+
+
+def load_term(data_dir: str) -> int:
+    """The highest replication term this data dir has adopted (0 when
+    never set / no durable state)."""
+    import json
+
+    try:
+        with open(os.path.join(data_dir, TERM_FILE)) as f:
+            return int(json.load(f)["term"])
+    except (OSError, ValueError, KeyError, TypeError):
+        # TypeError: valid-JSON-but-not-an-object content ("5", "[7]")
+        return 0
+
+
+def store_term(data_dir: str, term: int) -> None:
+    """Durably adopt ``term`` (atomic tmp + rename + fsync): after this
+    returns, no crash can roll the process back into accepting frames
+    from a lineage it already fenced off."""
+    import json
+    import tempfile
+
+    os.makedirs(data_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=data_dir, prefix=TERM_FILE + ".tmp.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump({"term": int(term)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(data_dir, TERM_FILE))
+        dfd = os.open(data_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
 
 class Persistence:
     """Owns the WAL + checkpointer for one store. Construct via
@@ -51,6 +98,10 @@ class Persistence:
         self.checkpointer = checkpointer
         self.recovery = recovery
         self._closed = False
+        # construction parameters, kept so a lineage rebase (leader
+        # failover: a full-state catch-up superseding local history) can
+        # reopen a byte-fresh WAL + checkpointer with identical policy
+        self._params: dict = {}
 
     @classmethod
     def open(cls, store, data_dir: str,
@@ -78,6 +129,12 @@ class Persistence:
                 # a snapshot asynchronously so the NEXT boot is fast
                 cp.request()
         p = cls(store, data_dir, wal, cp, res)
+        p._params = dict(
+            wal_fsync=wal_fsync, segment_bytes=segment_bytes,
+            checkpoint_wal_bytes=checkpoint_wal_bytes,
+            checkpoint_wal_records=checkpoint_wal_records,
+            checkpoint_keep=checkpoint_keep,
+            auto_checkpoint=auto_checkpoint)
         store.journal = p._journal
         return p
 
@@ -85,6 +142,65 @@ class Persistence:
 
     def _journal(self, meta: dict, blob: Optional[bytes] = None) -> None:
         self.wal.append(meta, blob)
+
+    # -- lineage rebase (leader failover) ------------------------------------
+
+    def rebase(self, state_payload: bytes) -> None:
+        """Adopt a full-state catch-up transfer as a NEW LINEAGE
+        baseline (parallel/multihost.py ``apply_catchup``): a demoted
+        leader's (or far-behind follower's) local WAL + snapshots
+        describe superseded history whose revision numbers may overlap
+        the incoming lineage's — keeping them would make the next boot's
+        replay see revisions go backwards and fail closed. Discard them,
+        install the transferred state, and restart the log with that
+        baseline as its first (journaled, fsynced) record.
+
+        Crash window: a kill between the wipe and the re-journal leaves
+        an empty data dir — the follower then rejoins from revision 0
+        and re-fetches the same transfer. Nothing of the NEW lineage is
+        ever lost, and everything discarded of the old one was fenced
+        off by a higher term already."""
+        store = self.store
+        # bound-method EQUALITY, not identity: each attribute access
+        # mints a fresh bound-method object, so `is` never matches
+        detached = getattr(store, "journal", None) == self._journal
+        if detached:
+            store.journal = None  # install must not journal mid-rebase
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+        self.wal.close()
+        wal_dir = os.path.join(self.data_dir, "wal")
+        snap_dir = os.path.join(self.data_dir, "snapshots")
+        removed = 0
+        for d in (wal_dir, snap_dir):
+            try:
+                names = os.listdir(d)
+            except FileNotFoundError:
+                continue
+            for name in names:
+                try:
+                    os.unlink(os.path.join(d, name))
+                    removed += 1
+                except OSError:
+                    log.exception("rebase: failed to remove %s/%s", d,
+                                  name)
+        store.load_state_bytes(state_payload)
+        self.wal = WriteAheadLog(wal_dir, fsync=self._params["wal_fsync"],
+                                 segment_bytes=self._params["segment_bytes"])
+        if self._params.get("auto_checkpoint", True):
+            self.checkpointer = Checkpointer(
+                store, self.wal, snap_dir,
+                wal_bytes=self._params["checkpoint_wal_bytes"],
+                wal_records=self._params["checkpoint_wal_records"],
+                keep=self._params["checkpoint_keep"])
+            self.wal.on_append = self.checkpointer.notify
+        self._journal({"kind": "load_state", "rev": store.revision},
+                      state_payload)
+        self.wal.sync()  # the baseline is the lineage: make it durable NOW
+        if detached:
+            store.journal = self._journal
+        log.info("rebased lineage at revision %d (%d old files discarded)",
+                 store.revision, removed)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -106,7 +222,8 @@ class Persistence:
         if self._closed:
             return
         self._closed = True
-        if getattr(self.store, "journal", None) is self._journal:
+        # == not `is`: attribute access mints fresh bound-method objects
+        if getattr(self.store, "journal", None) == self._journal:
             self.store.journal = None
         try:
             if final_checkpoint and self.wal.appended_records:

@@ -227,21 +227,29 @@ class Options:
     breaker_failure_threshold: int = 5
     breaker_reset_seconds: float = 10.0
 
-    def _parse_remote(self) -> Optional[tuple[str, int]]:
-        """(host, port) for tcp:// endpoints, None otherwise; raises on a
-        malformed tcp:// endpoint."""
+    def _parse_remote(self) -> Optional[list[tuple[str, int]]]:
+        """[(host, port), ...] for tcp:// endpoints, None otherwise;
+        raises on a malformed endpoint. A COMMA-SEPARATED list
+        (``tcp://h1:p1,h2:p2`` — repeating the tcp:// prefix is
+        tolerated) names a replicated engine set with automatic
+        client-side leader failover (engine/remote.py FailoverEngine).
+        The host:port list grammar itself has ONE owner —
+        ``parallel/failover.py parse_peers`` (the engine host's --peers
+        flag) — so the two flags can never drift apart."""
         if not self.engine_endpoint.startswith(REMOTE_ENDPOINT_PREFIX):
             return None
-        hostport = self.engine_endpoint[len(REMOTE_ENDPOINT_PREFIX):]
-        host, _, port = hostport.rpartition(":")
-        if not host or not port.isdigit() or not 0 < int(port) < 65536:
+        from ..parallel.failover import FailoverError, parse_peers
+
+        stripped = ",".join(
+            p.strip()[len(REMOTE_ENDPOINT_PREFIX):]
+            if p.strip().startswith(REMOTE_ENDPOINT_PREFIX) else p.strip()
+            for p in self.engine_endpoint.split(","))
+        try:
+            return parse_peers(stripped)
+        except FailoverError:
             raise OptionsError(
                 f"invalid engine endpoint {self.engine_endpoint!r} "
-                "(expected tcp://host:port)")
-        # bracketed IPv6 literals: tcp://[::1]:50051
-        if host.startswith("[") and host.endswith("]"):
-            host = host[1:-1]
-        return host, int(port)
+                "(expected tcp://host:port[,host2:port2,...])") from None
 
     def validate(self) -> None:
         remote = self._parse_remote()
@@ -401,7 +409,7 @@ class Options:
         matcher = MapMatcher.from_yaml(rule_text)
         remote = self._parse_remote()
         if remote is not None:
-            from ..engine.remote import RemoteEngine
+            from ..engine.remote import FailoverEngine, RemoteEngine
 
             ssl_context = None
             if not self.engine_insecure:
@@ -417,8 +425,7 @@ class Options:
                         self.engine_client_key_file)
                 except TLSConfigError as e:
                     raise OptionsError(str(e)) from None
-            engine = RemoteEngine(
-                *remote, token=self.engine_token,
+            client_kw = dict(
                 ssl_context=ssl_context,
                 server_hostname=self.engine_server_name,
                 connect_timeout=self.engine_connect_timeout,
@@ -426,6 +433,15 @@ class Options:
                 retries=self.engine_retries,
                 breaker_failure_threshold=self.breaker_failure_threshold,
                 breaker_reset_seconds=self.breaker_reset_seconds)
+            if len(remote) == 1:
+                engine = RemoteEngine(*remote[0],
+                                      token=self.engine_token,
+                                      **client_kw)
+            else:
+                # a replicated engine set: route to the current leader,
+                # re-resolve on its death (kill-the-leader failover)
+                engine = FailoverEngine(remote, token=self.engine_token,
+                                        **client_kw)
         else:
             import os as _os
 
@@ -630,7 +646,11 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     """CLI flags (reference AddFlags, options.go:196-207)."""
     parser.add_argument("--engine-endpoint", default=TPU_ENDPOINT,
                         help="embedded:// | tpu:// (in-process TPU engine) "
-                             "| tcp://host:port (remote engine host)")
+                             "| tcp://host:port (remote engine host) | "
+                             "tcp://h1:p1,h2:p2,... (a replicated engine "
+                             "set: requests follow the leader, with "
+                             "automatic client-side failover when it "
+                             "dies — see docs/operations.md)")
     parser.add_argument("--engine-token",
                         help="bearer token for tcp:// engine endpoints")
     parser.add_argument("--engine-insecure", action="store_true",

@@ -108,13 +108,33 @@ class Server:
             reasons = [(b.dependency, r)
                        for b in getattr(self.deps, "breakers", ())
                        if (r := b.open_reason()) is not None]
+            # replicated engine set: report role|term|lag so an
+            # orchestrator can gate traffic THROUGH the failover window
+            # (role != leader means requests would only 503 fail-closed)
+            repl_line = None
+            repl_fn = getattr(self.deps.engine, "replication_status", None)
+            if repl_fn is not None:
+                try:
+                    # to_thread: the status probe is one blocking socket
+                    # round trip — it must not park the event loop
+                    st = await asyncio.to_thread(repl_fn)
+                except Exception:  # noqa: BLE001 - readyz must answer
+                    st = {"role": "electing", "term": None, "lag": None}
+                detail = (f"role={st.get('role')} term={st.get('term')} "
+                          f"lag={st.get('lag')}")
+                if st.get("role") == "leader":
+                    repl_line = f"replication: {detail}"
+                else:
+                    reasons.append(("replication", detail))
             if reasons:
                 body = "".join(f"[-]{dep}: {reason}\n"
                                for dep, reason in reasons)
                 return ProxyResponse(
                     status=503, headers={"Content-Type": "text/plain"},
                     body=body.encode())
-            return ProxyResponse(status=200, body=b"ok")
+            body = b"ok" if repl_line is None \
+                else f"[+]{repl_line}\nok".encode()
+            return ProxyResponse(status=200, body=body)
         if req.path == "/metrics":
             return ProxyResponse(
                 status=200, headers={"Content-Type": "text/plain"},

@@ -73,6 +73,19 @@ class _Registry:
                 self._armed[name] = left - 1
         raise FailPointError(name)
 
+    def branch(self, name: str) -> bool:
+        """Like :meth:`hit` but RETURNS True (consuming one budget unit)
+        instead of raising — for sites that model dropped or suppressed
+        work rather than a surfaced error: ``mirror.partition`` drops a
+        mirror frame on the floor, ``mirror.heartbeat`` suppresses a
+        liveness heartbeat (engine/remote.py `_push_mirror`), so election
+        paths are testable without real network chaos."""
+        try:
+            self.hit(name)
+        except FailPointError:
+            return True
+        return False
+
     def armed(self, name: str) -> bool:
         with self._lock:
             return name in self._armed

@@ -391,6 +391,106 @@ def test_open_upstream_breaker_fails_dual_write_fast_with_503(tmp_path):
     asyncio.run(go())
 
 
+# -- mirror-stream chaos: partitions and heartbeat loss -----------------------
+
+
+def test_mirror_partition_failpoint_drops_frame_and_follower_detects_gap():
+    """`mirror.partition` drops mirror frames on the floor (a one-sided
+    network partition). The follower must DETECT the gap and fail shut
+    (MultiHostError) rather than silently diverge."""
+    import threading
+    import time as _time
+
+    from spicedb_kubeapi_proxy_tpu.engine import WriteOp
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        MirroredEngine,
+        MultiHostError,
+        follower_loop,
+    )
+
+    async def go():
+        inner = Engine()
+        leader = MirroredEngine(inner, term=1, mirror_queries=False)
+        srv = EngineServer(leader)
+        srv.mirror_heartbeat = 0.2
+        port = await srv.start()
+        follower = Engine()
+        result: dict = {}
+
+        def run_follower():
+            try:
+                follower_loop(follower, "127.0.0.1", port,
+                              from_revision=0, current_term=1,
+                              heartbeat_timeout=10.0, fail_on_loss=True)
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+
+        t = threading.Thread(target=run_follower, daemon=True)
+        t.start()
+        # deterministic ordering: the follower must be SUBSCRIBED before
+        # the first write, so that write arrives as a live frame (the
+        # gap check baselines on live frames, not the catch-up cut)
+        deadline = _time.monotonic() + 10
+        while not leader._subs and _time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert leader._subs, "follower never subscribed"
+
+        def write(i):
+            leader.write_relationships([WriteOp("touch", parse_relationship(
+                f"namespace:n{i}#creator@user:u1"))])
+
+        await asyncio.to_thread(write, 1)  # seq 1: sets the baseline
+        for _ in range(100):
+            if follower.revision >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert follower.revision == 1
+        failpoints.enable("mirror.partition", 1)
+        await asyncio.to_thread(write, 2)  # seq 2: dropped by the void
+        await asyncio.to_thread(write, 3)  # seq 3: exposes the gap
+        deadline = _time.monotonic() + 10
+        while "err" not in result and _time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert isinstance(result.get("err"), MultiHostError), result
+        assert "gap" in str(result["err"])
+        # the partitioned frame NEVER applied: no silent divergence
+        assert follower.revision == 1
+        t.join(5)
+        await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_mirror_heartbeat_failpoint_surfaces_leader_loss():
+    """`mirror.heartbeat` suppresses liveness heartbeats on an idle
+    stream: the follower must classify the silence as a dead leader
+    (LeaderLost + `mirror_heartbeat_misses_total`) — the trigger the
+    election path runs on."""
+    from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+        LeaderLost,
+        MirroredEngine,
+        follower_loop,
+    )
+
+    async def go():
+        leader = MirroredEngine(Engine(), term=1, mirror_queries=False)
+        srv = EngineServer(leader)
+        srv.mirror_heartbeat = 0.1
+        port = await srv.start()
+        failpoints.enable("mirror.heartbeat", 1000)
+        follower = Engine()
+        with pytest.raises(LeaderLost):
+            await asyncio.to_thread(
+                follower_loop, follower, "127.0.0.1", port,
+                from_revision=0, current_term=1,
+                heartbeat_timeout=0.6, fail_on_loss=True)
+        misses = metrics.counter("mirror_heartbeat_misses_total")
+        assert misses.value >= 1
+        await srv.stop()
+
+    asyncio.run(go())
+
+
 # -- the acceptance pin: fail-closed 503 through the whole proxy --------------
 
 

@@ -1,0 +1,843 @@
+"""Leader failover for the mirrored engine: term fencing, deterministic
+election, sync replication acks, client-side endpoint failover, /readyz
+replication reporting — plus the end-to-end acceptance test (SIGKILL the
+leader under concurrent writes; a follower promotes with a higher term,
+no acked write lost under ``--wal-fsync always``, only fail-closed
+errors during the window; a resurrected old leader demotes and
+converges)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import queue
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp
+from spicedb_kubeapi_proxy_tpu.engine.remote import (
+    EngineServer,
+    FailoverEngine,
+    NotLeaderError,
+    RemoteEngine,
+    _pack,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+from spicedb_kubeapi_proxy_tpu.parallel.failover import (
+    FailoverError,
+    choose_candidate,
+    parse_peers,
+)
+from spicedb_kubeapi_proxy_tpu.parallel.multihost import (
+    MirroredEngine,
+    StaleTermError,
+    fence_term,
+    follower_loop,
+)
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+    DependencyUnavailable,
+)
+
+REJECTED = "mirror_frames_rejected_stale_term_total"
+
+
+def rel(i, who="alice"):
+    return parse_relationship(f"namespace:n{i}#creator@user:{who}")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- fencing ------------------------------------------------------------------
+
+
+def test_fence_term_semantics():
+    metrics.reset()
+    # missing term (pre-term peer): no fencing, no adoption
+    assert fence_term(None, 3) == 3
+    # equal and higher terms pass (higher is adopted by the caller)
+    assert fence_term(3, 3) == 3
+    assert fence_term(5, 3) == 5
+    assert metrics.counter(REJECTED).value == 0
+    # a stale term is rejected AND counted
+    with pytest.raises(StaleTermError):
+        fence_term(2, 3)
+    assert metrics.counter(REJECTED).value == 1
+
+
+def test_split_brain_stale_frame_rejected_over_the_wire():
+    """Deterministic split-brain: a follower that has adopted term 2
+    receives a frame stamped term 1 (a deposed leader's late write).
+    The frame must be REJECTED — observable via the metric — and must
+    not touch the store."""
+    metrics.reset()
+
+    def fake_old_leader(port, ready):
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        ready.set()
+        conn, _ = srv.accept()
+        # consume the subscribe request frame
+        hdr = conn.recv(4)
+        (n,) = struct.unpack(">I", hdr)
+        while n > 0:
+            n -= len(conn.recv(n))
+        # ack claiming term 2 (so the SUBSCRIPTION itself is accepted)...
+        conn.sendall(_pack({"ok": True,
+                            "result": {"subscribed": True, "term": 2}}))
+        # ...then a write frame stamped with the DEPOSED term 1
+        conn.sendall(_pack({"ok": True, "frame": {
+            "seq": 1, "term": 1, "method": "write_relationships",
+            "ops": [{"op": "touch", "rel": {
+                "resource_type": "namespace", "resource_id": "ghost",
+                "relation": "creator", "subject_type": "user",
+                "subject_id": "mallory", "subject_relation": None,
+                "expiration": None}}],
+            "preconditions": []}}))
+        time.sleep(2.0)  # hold the socket open while the client fences
+        conn.close()
+        srv.close()
+
+    port = _free_port()
+    ready = threading.Event()
+    t = threading.Thread(target=fake_old_leader, args=(port, ready),
+                         daemon=True)
+    t.start()
+    assert ready.wait(5)
+    follower = Engine()
+    with pytest.raises(StaleTermError):
+        follower_loop(follower, "127.0.0.1", port, current_term=2,
+                      heartbeat_timeout=5.0, fail_on_loss=True)
+    assert metrics.counter(REJECTED).value >= 1
+    assert follower.revision == 0, "a fenced frame must not apply"
+    t.join(5)
+
+
+def test_stale_subscription_ack_rejected():
+    """A follower that already adopted term 5 must refuse to FOLLOW a
+    leader still claiming term 3 (not just its frames)."""
+    metrics.reset()
+
+    def stale_leader(port, ready):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        ready.set()
+        conn, _ = srv.accept()
+        hdr = conn.recv(4)
+        (n,) = struct.unpack(">I", hdr)
+        while n > 0:
+            n -= len(conn.recv(n))
+        conn.sendall(_pack({"ok": True,
+                            "result": {"subscribed": True, "term": 3}}))
+        time.sleep(2.0)
+        conn.close()
+        srv.close()
+
+    port = _free_port()
+    ready = threading.Event()
+    t = threading.Thread(target=stale_leader, args=(port, ready),
+                         daemon=True)
+    t.start()
+    assert ready.wait(5)
+    with pytest.raises(StaleTermError):
+        follower_loop(Engine(), "127.0.0.1", port, current_term=5,
+                      heartbeat_timeout=5.0, fail_on_loss=True)
+    assert metrics.counter(REJECTED).value >= 1
+    t.join(5)
+
+
+def test_subscribe_with_catchup_deposed_term_forces_full_state():
+    """The general fencing form of PR 3's 'follower ahead of leader'
+    rule: a subscriber from a DEPOSED term whose revision runs past the
+    promotion baseline gets a full state transfer even when an effects
+    replay would normally satisfy it."""
+    inner = Engine()
+    for i in range(3):
+        inner.write_relationships([WriteOp("touch", rel(i))])
+    baseline = inner.revision
+    leader = MirroredEngine(inner, term=4)
+    assert leader.baseline_revision == baseline
+    leader.write_relationships([WriteOp("touch", rel(7))])
+    # same term, within history: cheap effects replay (no payload)
+    q, meta, payload = leader.subscribe_with_catchup(
+        baseline, subscriber_term=4)
+    assert payload is None and "effects" in meta
+    assert meta["term"] == 4
+    leader.unsubscribe(q)
+    # deposed term, revision past the baseline: forced full state
+    q, meta, payload = leader.subscribe_with_catchup(
+        baseline + 1, subscriber_term=3)
+    assert payload is not None and meta.get("state")
+    assert meta["term"] == 4
+    leader.unsubscribe(q)
+    # deposed term but still WITHIN shared history: effects replay is
+    # sound (divergence can only exist past the promotion baseline)
+    q, meta, payload = leader.subscribe_with_catchup(
+        baseline, subscriber_term=3)
+    assert payload is None and "effects" in meta
+    leader.unsubscribe(q)
+
+
+# -- election -----------------------------------------------------------------
+
+
+def test_choose_candidate_term_then_revision_then_lowest_id():
+    # highest revision wins within a term
+    assert choose_candidate({0: {"revision": 5}, 1: {"revision": 9},
+                             2: {"revision": 7}}) == 1
+    # tie on revision -> lowest peer id
+    assert choose_candidate({2: {"revision": 9}, 1: {"revision": 9},
+                             0: {"revision": 3}}) == 1
+    # TERM dominates revision: a deposed lineage's inflated revision
+    # count (its fenced-off writes) must not outrank the canonical
+    # newer-term candidate
+    assert choose_candidate({
+        0: {"term": 1, "revision": 100},   # old leader, unreplicated tail
+        1: {"term": 2, "revision": 95},    # canonical promoted follower
+    }) == 1
+    assert choose_candidate({}) is None
+
+
+def test_parse_peers():
+    assert parse_peers("a:1,b:2") == [("a", 1), ("b", 2)]
+    assert parse_peers("[::1]:50051") == [("::1", 50051)]
+    for bad in ("", "a", "a:0", "a:notaport", "a:70000"):
+        with pytest.raises(FailoverError):
+            parse_peers(bad)
+
+
+def test_term_persistence_round_trip(tmp_path):
+    from spicedb_kubeapi_proxy_tpu.persistence import (
+        load_term,
+        store_term,
+    )
+
+    d = str(tmp_path / "data")
+    assert load_term(d) == 0  # no dir, no file: term 0
+    store_term(d, 7)
+    assert load_term(d) == 7
+    store_term(d, 9)
+    assert load_term(d) == 9
+    # garbage file fails safe to 0 rather than crashing boot
+    with open(os.path.join(d, "term"), "w") as f:
+        f.write("not-json")
+    assert load_term(d) == 0
+
+
+# -- sync replication ---------------------------------------------------------
+
+
+def test_sync_replicated_write_waits_for_follower_ack():
+    inner = Engine()
+    m = MirroredEngine(inner, term=1, mirror_queries=False,
+                       sync_replication=True, replication_timeout=30.0)
+    q = m.subscribe()
+    done = threading.Event()
+
+    def writer():
+        m.write_relationships([WriteOp("touch", rel(1))])
+        done.set()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    # the frame is published but unacked: the write must NOT return
+    assert not done.wait(0.5)
+    wire = q.get_nowait()
+    assert isinstance(wire, bytes)  # the published frame reached the sub
+    m.record_ack(q, 1, term=1)
+    assert done.wait(5), "ack must release the writer"
+    t.join(5)
+    # acks from another term are a deposed subscription's stragglers
+    t2 = threading.Thread(
+        target=lambda: (m.write_relationships([WriteOp("touch", rel(2))]),
+                        done.set()), daemon=True)
+    done.clear()
+    t2.start()
+    assert not done.wait(0.3)
+    m.record_ack(q, 2, term=99)  # wrong term: ignored
+    assert not done.wait(0.3)
+    m.unsubscribe(q)  # a dead follower stops being waited on
+    assert done.wait(5)
+    t2.join(5)
+
+
+def test_sync_replication_timeout_drops_laggard():
+    inner = Engine()
+    m = MirroredEngine(inner, term=1, mirror_queries=False,
+                       sync_replication=True, replication_timeout=0.3)
+    q = m.subscribe()
+    t0 = time.monotonic()
+    m.write_relationships([WriteOp("touch", rel(1))])
+    assert time.monotonic() - t0 >= 0.25
+    # the laggard was dropped (and sent the close sentinel)
+    with m._subs_lock:
+        assert q not in m._subs
+    q.get_nowait()  # the frame
+    assert q.get_nowait() is None  # the drop sentinel
+
+
+def test_catchup_join_credits_the_cut_for_sync_replication():
+    """A follower joining via catch-up never acks the frames the cut
+    already covers — the leader must credit them at subscribe time, or
+    a write racing the join stalls its client a full replication
+    timeout and then kicks the freshly joined follower."""
+    inner = Engine()
+    m = MirroredEngine(inner, term=1, mirror_queries=False,
+                       sync_replication=True, replication_timeout=30.0)
+    # seq advances with no subscribers (frames skipped entirely)
+    m.write_relationships([WriteOp("touch", rel(1))])
+    m.write_relationships([WriteOp("touch", rel(2))])
+    assert m.mirror_seq == 2
+    q, meta, payload = m.subscribe_with_catchup(0, subscriber_term=1)
+    with m._subs_lock:
+        assert m._join_cut[id(q)] == meta["seq"] == 2
+    # a write AFTER the join still demands a real ack
+    done = threading.Event()
+    t = threading.Thread(
+        target=lambda: (m.write_relationships([WriteOp("touch", rel(3))]),
+                        done.set()), daemon=True)
+    t.start()
+    assert not done.wait(0.3)
+    m.record_ack(q, 3, term=1)
+    assert done.wait(5)
+    t.join(5)
+    m.unsubscribe(q)
+
+
+def test_floored_write_racing_a_join_waits_for_the_cut_ack():
+    """The cut is responsibility accounting, NOT durability: a
+    min-sync-replicas write whose frame the catch-up cut covers is
+    released only by the joiner's REAL post-catch-up ack (sent after
+    the transfer is applied and journaled) — never by the cut record
+    itself, which exists before the joiner holds any bytes."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import StoreError
+
+    inner = Engine()
+    m = MirroredEngine(inner, term=1, mirror_queries=False,
+                       sync_replication=True, replication_timeout=2.0,
+                       min_sync_replicas=1)
+    # joiner registers; its cut covers everything published so far
+    q = m.subscribe()
+    done = threading.Event()
+    outcome: list = []
+
+    def write():
+        try:
+            m.write_relationships([WriteOp("touch", rel(1))])
+            outcome.append("acked")
+        except StoreError as e:
+            outcome.append(e)
+        done.set()
+
+    # simulate the race: the write publishes seq 1 to the registered
+    # queue, then the catch-up cut lands at seq 1 (covering it)
+    t = threading.Thread(target=write, daemon=True)
+    t.start()
+    assert not done.wait(0.3), "floored write must not ack on the cut"
+    with m._subs_lock:
+        m._join_cut[id(q)] = m._seq  # the cut covers the frame...
+        m._ack_cond.notify_all()
+    assert not done.wait(0.5), "...but a cut is not a durable ack"
+    m.record_ack(q, 1, term=1)  # the joiner journaled the catch-up
+    assert done.wait(5)
+    t.join(5)
+    assert outcome == ["acked"]
+    m.unsubscribe(q)
+
+
+def test_min_sync_replicas_fails_writes_closed():
+    """--min-sync-replicas: a leader below its durability floor refuses
+    writes (an unreplicated ack would not survive failover) and resumes
+    the moment a follower is back."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import StoreError
+
+    inner = Engine()
+    m = MirroredEngine(inner, term=1, mirror_queries=False,
+                       sync_replication=True, replication_timeout=5.0,
+                       min_sync_replicas=1)
+    with pytest.raises(StoreError, match="min-sync-replicas"):
+        m.write_relationships([WriteOp("touch", rel(1))])
+    assert inner.revision == 0, "a refused write must not apply"
+    # a follower that DIES mid-wait (unsubscribes without acking) must
+    # not let the write slip through the floor via the no-laggards exit
+    q0 = m.subscribe()
+    errs: list = []
+    done0 = threading.Event()
+
+    def doomed():
+        try:
+            m.write_relationships([WriteOp("touch", rel(5))])
+        except StoreError as e:
+            errs.append(e)
+        done0.set()
+
+    t0 = threading.Thread(target=doomed, daemon=True)
+    t0.start()
+    assert not done0.wait(0.3)  # parked awaiting the ack
+    m.unsubscribe(q0)  # connection died before acking
+    assert done0.wait(5)
+    t0.join(5)
+    assert errs and "min-sync-replicas" in str(errs[0])
+    q = m.subscribe()
+    done = threading.Event()
+    t = threading.Thread(
+        target=lambda: (m.write_relationships([WriteOp("touch", rel(1))]),
+                        done.set()), daemon=True)
+    t.start()
+    assert not done.wait(0.3)  # published, awaiting the replica's ack
+    m.record_ack(q, m.mirror_seq, term=1)
+    assert done.wait(5)
+    t.join(5)
+    # revision 2: the doomed write above APPLIED locally before its
+    # floor error (outcome-unknown semantics, like a write whose
+    # response connection died) — only the ack was withheld
+    assert inner.revision == 2
+    m.unsubscribe(q)
+
+
+def test_equal_term_leader_conflict_resolves_deterministically():
+    """Two leaders at the SAME term (a crashed promotion's persisted
+    term reused by the next election): the lower peer id keeps the term
+    and bumps past it; the higher id demotes with a forced full-state
+    rejoin."""
+    from spicedb_kubeapi_proxy_tpu.parallel.failover import (
+        FailoverCoordinator,
+        ROLE_FOLLOWER,
+        ROLE_LEADER,
+    )
+
+    def coordinator(self_id):
+        eng = Engine()
+        srv = EngineServer(eng)  # never started: just the attr surface
+        c = FailoverCoordinator(
+            eng, srv, [("127.0.0.1", 1), ("127.0.0.1", 2)], self_id,
+            heartbeat_interval=0.01, boot_grace=0.0)
+        return c
+
+    # winner side (peer 0): sees peer 1 leading at its own term
+    c0 = coordinator(0)
+    c0.term = 2
+    c0._promote({})  # term -> 3, role leader
+    assert c0.role == ROLE_LEADER and c0.term == 3
+    probes = iter([
+        {1: {"role": "leader", "term": 3, "revision": 0, "peer_id": 1}},
+        {},  # conflict resolved: stop the lease loop
+    ])
+
+    def scripted_probe():
+        try:
+            return next(probes)
+        except StopIteration:
+            c0._stop.set()
+            return {}
+
+    c0._probe_all = scripted_probe
+    c0._lead()
+    assert c0.role == ROLE_LEADER
+    assert c0.term == 4, "the winner must bump PAST the conflicted term"
+    assert c0._mirrored.term == 4, "new frames must carry the bumped term"
+
+    # loser side (peer 1): sees peer 0 leading at its own term
+    c1 = coordinator(1)
+    c1.term = 2
+    c1._promote({})  # term -> 3
+    c1._probe_all = lambda: {
+        0: {"role": "leader", "term": 3, "revision": 0, "peer_id": 0}}
+    c1._lead()
+    assert c1.role == ROLE_FOLLOWER
+    assert c1._rejoin_full, "the loser's term-3 history is suspect"
+    # ...and the suspicion clears once it legitimately promotes again
+    c1._promote({})
+    assert not c1._rejoin_full
+
+
+def test_demotion_closes_deposed_wrapper_streams():
+    """A deposed leader's still-connected followers must SEE the
+    demotion (stream close -> LeaderLost -> election), not keep eating
+    its equal-term heartbeats forever."""
+    m = MirroredEngine(Engine(), term=3, mirror_queries=False,
+                       sync_replication=True)
+    q1, q2 = m.subscribe(), m.subscribe()
+    m.close_subscribers()
+    assert q1.get_nowait() is None and q2.get_nowait() is None
+    with m._subs_lock:
+        assert not m._subs and not m._acked and not m._join_cut
+    # plain subscribe() seeds responsibility, never durability
+    q3 = m.subscribe()
+    with m._subs_lock:
+        assert m._acked[id(q3)] == 0
+        assert m._join_cut[id(q3)] == m._seq
+
+
+def test_failover_mode_skips_query_mirroring():
+    inner = Engine()
+    inner.write_relationships([WriteOp("touch", rel(1))])
+    m = MirroredEngine(inner, term=1, mirror_queries=False,
+                       sync_replication=True, replication_timeout=5.0)
+    q = m.subscribe()
+    # queries serve leader-locally: nothing published, nothing awaited
+    assert m.check_bulk(
+        [CheckItem("namespace", "n1", "view", "user", "alice")]) == [True]
+    assert q.empty()
+    m.unsubscribe(q)
+
+
+# -- client-side failover -----------------------------------------------------
+
+
+def _status(role, term, rev=0, pid=0):
+    d = {"role": role, "term": term, "revision": rev, "peer_id": pid,
+         "lag": 0}
+
+    def fn():
+        d["revision"] = d.get("revision", 0)
+        return dict(d)
+
+    fn.d = d
+    return fn
+
+
+def test_failover_engine_reresolves_and_fails_closed():
+    metrics.reset()
+
+    async def go():
+        e_a, e_b = Engine(), Engine()
+        st_a = _status("leader", 1, pid=0)
+        st_b = _status("follower", 1, pid=1)
+        srv_a = EngineServer(e_a, failover_status=st_a)
+        srv_b = EngineServer(e_b, failover_status=st_b)
+        port_a, port_b = await srv_a.start(), await srv_b.start()
+        fe = FailoverEngine(
+            [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+            connect_timeout=1.0, timeout=5.0, retries=0,
+            probe_timeout=2.0, resolve_deadline=5.0)
+        w = [WriteOp("touch", rel(0))]
+        assert await asyncio.to_thread(fe.write_relationships, w) == 1
+        assert e_a.revision == 1 and e_b.revision == 0
+
+        # a follower answers not_leader: rejected BEFORE dispatch, so
+        # even a WRITE re-aims at the real leader transparently
+        fe._primary_idx = 1
+        w2 = [WriteOp("touch", rel(1))]
+        assert await asyncio.to_thread(fe.write_relationships, w2) == 2
+        assert e_a.revision == 2 and e_b.revision == 0
+        assert metrics.counter("failover_total").value >= 1
+
+        # the leader dies; B is promoted (term 2): a READ re-resolves
+        await srv_a.stop()
+        st_b.d.update(role="leader", term=2)
+        e_b.write_relationships([WriteOp("touch", rel(9, "bob"))])
+        got = await asyncio.to_thread(
+            fe.check_bulk,
+            [CheckItem("namespace", "n9", "view", "user", "bob")])
+        assert got == [True]
+        st = await asyncio.to_thread(fe.replication_status)
+        assert st["role"] == "leader" and st["term"] == 2
+
+        # an IDLE proxy (no data traffic since the failover) must still
+        # recover via /readyz's replication_status — it re-resolves on
+        # its own instead of reporting electing forever
+        fe_idle = FailoverEngine(
+            [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+            connect_timeout=0.5, timeout=5.0, retries=0,
+            probe_timeout=2.0, resolve_deadline=5.0)
+        st = await asyncio.to_thread(fe_idle.replication_status)
+        assert st["role"] == "leader" and st["term"] == 2
+        fe_idle.close()
+
+        # nobody leads: calls fail CLOSED with the 503-mapped family,
+        # never a stale answer from the demoted follower
+        st_b.d.update(role="electing")
+        fe2 = FailoverEngine(
+            [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+            connect_timeout=0.5, timeout=2.0, retries=0,
+            probe_timeout=1.0, resolve_deadline=1.0)
+        with pytest.raises(DependencyUnavailable):
+            await asyncio.to_thread(
+                fe2.check_bulk,
+                [CheckItem("namespace", "n9", "view", "user", "bob")])
+        fe.close()
+        fe2.close()
+        await srv_b.stop()
+
+    asyncio.run(go())
+
+
+def test_role_gate_rejects_everything_but_failover_state():
+    async def go():
+        e = Engine()
+        e.write_relationships([WriteOp("touch", rel(1))])
+        st = _status("follower", 3, pid=1)
+        srv = EngineServer(e, failover_status=st)
+        port = await srv.start()
+        remote = RemoteEngine("127.0.0.1", port, retries=0)
+        # introspection always answers...
+        info = await asyncio.to_thread(remote.failover_state)
+        assert info["role"] == "follower" and info["term"] == 3
+        # ...every data op fails closed, mapped to the 503 family
+        with pytest.raises(NotLeaderError):
+            await asyncio.to_thread(
+                remote.check_bulk,
+                [CheckItem("namespace", "n1", "view", "user", "alice")])
+        with pytest.raises(NotLeaderError):
+            await asyncio.to_thread(
+                remote.write_relationships, [WriteOp("touch", rel(2))])
+        assert e.revision == 1, "gated write must not dispatch"
+        remote.close()
+        await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_options_parse_engine_endpoint_list(tmp_path):
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options,
+        OptionsError,
+    )
+
+    o = Options(engine_endpoint="tcp://h1:50051,h2:50052,tcp://[::1]:7")
+    assert o._parse_remote() == [("h1", 50051), ("h2", 50052), ("::1", 7)]
+    with pytest.raises(OptionsError):
+        Options(engine_endpoint="tcp://h1:50051,,bad")._parse_remote()
+    with pytest.raises(OptionsError):
+        Options(engine_endpoint="tcp://h1:50051,h2:0")._parse_remote()
+
+
+def test_readyz_reports_replication_role(tmp_path):
+    from spicedb_kubeapi_proxy_tpu.proxy.inmemory import InMemoryClient
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    RULES = open(os.path.join(os.path.dirname(__file__), "..", "deploy",
+                              "rules.yaml")).read()
+    from fake_kube import FakeKube
+
+    async def go():
+        e = Engine()
+        srv = EngineServer(e)  # no coordinator: leader of itself
+        port = await srv.start()
+        cfg = Options(
+            engine_endpoint=f"tcp://127.0.0.1:{port},127.0.0.1:1",
+            engine_insecure=True,
+            engine_connect_timeout=0.5,
+            rule_content=RULES,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+        ).complete()
+        await cfg.workflow.resume_pending()
+        alice = InMemoryClient(cfg.server.handle, user="alice")
+        resp = await alice.get("/readyz")
+        assert resp.status == 200
+        assert b"[+]replication: role=leader" in resp.body
+        # the whole set goes dark: /readyz gates traffic with the role
+        await srv.stop()
+        resp = await alice.get("/readyz")
+        assert resp.status == 503
+        assert b"[-]replication: " in resp.body
+        assert b"role=electing" in resp.body
+        await cfg.workflow.shutdown()
+        cfg.engine.close()
+
+    asyncio.run(go())
+
+
+# -- the end-to-end acceptance test ------------------------------------------
+
+
+FAILOVER_WORKER = r"""
+import os, sys
+peer_id, port0, port1, data_dir, repo = (sys.argv[1], sys.argv[2],
+                                         sys.argv[3], sys.argv[4],
+                                         sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, repo)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+print("PEER %s STARTING" % peer_id, flush=True)
+sys.exit(main([
+    "--peers", "127.0.0.1:%s,127.0.0.1:%s" % (port0, port1),
+    "--peer-id", peer_id,
+    "--bind-port", port0 if peer_id == "0" else port1,
+    "--token", "fo-tok", "--engine-insecure",
+    "--data-dir", data_dir, "--wal-fsync", "always",
+    "--mirror-heartbeat-seconds", "0.3",
+    "--failover-boot-grace", "30",
+]))
+"""
+
+
+def test_leader_sigkill_promotes_follower_no_acked_write_lost(tmp_path):
+    """The acceptance pin: SIGKILL the leader under concurrent writes.
+    (a) a follower promotes and serves with a HIGHER term within the
+    heartbeat-timeout budget, (b) every write the old leader acked is
+    present after promotion (sync replication + fsync always), (c) the
+    resurrected old leader demotes to follower and converges; during
+    the window requests fail CLOSED (the 503-mapped family), never
+    answer wrong."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = str(tmp_path / "fo_worker.py")
+    with open(script, "w") as f:
+        f.write(FAILOVER_WORKER)
+    port0, port1 = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    def boot(peer_id):
+        return subprocess.Popen(
+            [sys.executable, script, str(peer_id), str(port0), str(port1),
+             str(tmp_path / f"data{peer_id}"), repo_root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo_root)
+
+    def state_of(port, timeout=2.0):
+        probe = RemoteEngine("127.0.0.1", port, token="fo-tok",
+                             timeout=timeout, connect_timeout=timeout,
+                             retries=0)
+        try:
+            return probe.failover_state()
+        finally:
+            probe.close()
+
+    def wait_for_leader(budget=120.0, procs=()):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            for p in procs:
+                assert p.poll() is None, p.communicate()[0][-3000:]
+            for port in (port0, port1):
+                try:
+                    st = state_of(port)
+                except Exception:
+                    continue
+                if st["role"] == "leader":
+                    return port, st
+            time.sleep(0.3)
+        raise AssertionError("no leader elected in time")
+
+    procs = {0: boot(0), 1: boot(1)}
+    client = None
+    try:
+        leader_port, st0 = wait_for_leader(procs=list(procs.values()))
+        term0 = st0["term"]
+        client = FailoverEngine(
+            [("127.0.0.1", port0), ("127.0.0.1", port1)], token="fo-tok",
+            connect_timeout=2.0, timeout=20.0, retries=0,
+            probe_timeout=2.0, resolve_deadline=45.0)
+
+        acked: list[int] = []
+        window_errors: list[BaseException] = []
+        stop_writes = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop_writes.is_set():
+                try:
+                    client.write_relationships(
+                        [WriteOp("touch", rel(i, "writer"))])
+                    acked.append(i)
+                except (DependencyUnavailable, OSError) as e:
+                    window_errors.append(e)  # fail-closed family: fine
+                i += 1
+                time.sleep(0.05)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        # let a batch of writes get acked through the original leader
+        deadline = time.monotonic() + 30
+        while len(acked) < 10 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(acked) >= 10, "no write traffic before the kill"
+
+        # SIGKILL the leader mid-stream
+        victim = 0 if leader_port == port0 else 1
+        survivor_port = port1 if victim == 0 else port0
+        t_kill = time.monotonic()
+        procs[victim].kill()
+        procs[victim].wait(timeout=10)
+
+        # a follower must promote and serve: the writer thread's acked
+        # list advancing past the kill proves end-to-end recovery
+        acked_at_kill = len(acked)
+        deadline = time.monotonic() + 45
+        while len(acked) <= acked_at_kill \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        t_ready = time.monotonic() - t_kill
+        stop_writes.set()
+        t.join(30)
+        assert len(acked) > acked_at_kill, \
+            f"writes never resumed after failover ({window_errors[-3:]})"
+        st1 = state_of(survivor_port)
+        assert st1["role"] == "leader"
+        assert st1["term"] > term0, "promotion must bump the term"
+        # the budget: heartbeat loss detection (~1s at 0.3s cadence) +
+        # election + promotion, with generous CI slack
+        assert t_ready < 45, f"failover took {t_ready:.1f}s"
+
+        # (b) EVERY acked write is present after promotion
+        items = [CheckItem("namespace", f"n{i}", "view", "user", "writer")
+                 for i in acked]
+        verdicts = client.check_bulk(items)
+        missing = [i for i, ok in zip(acked, verdicts) if not ok]
+        assert not missing, f"acked writes lost in failover: {missing}"
+
+        # (c) resurrect the old leader: it must DEMOTE and converge
+        procs[victim] = boot(victim)
+        victim_port = port0 if victim == 0 else port1
+        deadline = time.monotonic() + 120
+        converged = False
+        while time.monotonic() < deadline:
+            assert procs[victim].poll() is None, \
+                procs[victim].communicate()[0][-3000:]
+            try:
+                st_old = state_of(victim_port)
+                st_new = state_of(survivor_port)
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if (st_old["role"] == "follower"
+                    and st_old["term"] == st_new["term"]
+                    and st_old["revision"] == st_new["revision"]):
+                converged = True
+                break
+            time.sleep(0.5)
+        assert converged, "old leader never converged as a follower"
+        # and replication through the rejoined pair still works: this
+        # write is sync-acked by the demoted old leader
+        client.write_relationships([WriteOp("touch", rel(999, "writer"))])
+        st_old = state_of(victim_port)
+        st_new = state_of(survivor_port)
+        assert st_old["revision"] == st_new["revision"]
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 20
+        outs = []
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            outs.append(p.communicate()[0])
+    for out in outs:
+        assert "STARTING" in out, out[-1500:]

@@ -1,0 +1,38 @@
+# The serving image deploy/proxy.yaml references as
+# spicedb-kubeapi-proxy-tpu:latest (`make image`).
+#
+# CPU JAX by default so the image runs anywhere (development, the
+# in-memory demo, CI). TPU node pools build with the TPU extra instead:
+#
+#   docker build --build-arg JAX_EXTRA="tpu" -t spicedb-kubeapi-proxy-tpu .
+#
+# The native graph-builder core is compiled in a throwaway stage; the
+# runtime stage stays toolchain-free (ctypes loads the .so, with a numpy
+# fallback if the build is skipped).
+
+FROM python:3.11-slim AS native
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+COPY spicedb_kubeapi_proxy_tpu/native/graphcore.cpp /src/graphcore.cpp
+RUN g++ -O3 -std=c++17 -fPIC -shared -pthread /src/graphcore.cpp \
+    -o /src/libgraphcore.so
+
+FROM python:3.11-slim
+ARG JAX_EXTRA=cpu
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" numpy pyyaml
+WORKDIR /app
+COPY spicedb_kubeapi_proxy_tpu /app/spicedb_kubeapi_proxy_tpu
+COPY deploy /app/deploy
+COPY --from=native /src/libgraphcore.so \
+    /app/spicedb_kubeapi_proxy_tpu/native/libgraphcore.so
+ENV PYTHONPATH=/app PYTHONUNBUFFERED=1
+# /var/lib/proxy is the StatefulSet's persistent volume (dual-write DB,
+# snapshots/WAL, discovery cache); create it so a volume-less `docker
+# run` still works
+RUN mkdir -p /var/lib/proxy
+EXPOSE 8443
+ENTRYPOINT ["python", "-m", "spicedb_kubeapi_proxy_tpu.proxy.cli"]
+# no default CMD: deploy/proxy.yaml supplies the full flag set; a bare
+# `docker run` prints the flag reference via --help
+CMD ["--help"]

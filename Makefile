@@ -5,7 +5,7 @@ PY ?= python
 # `verify` uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: test test-quick chaos bench bench-quick bench-smoke serve-dev demo native lint verify clean
+.PHONY: test test-quick chaos bench bench-quick bench-smoke serve-dev demo native lint verify image clean
 
 # full suite on the virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -47,6 +47,15 @@ serve-dev:
 	  --bootstrap deploy/bootstrap.yaml \
 	  --upstream-url $${UPSTREAM_URL:?set UPSTREAM_URL} \
 	  --bind-port 8443 --enable-debug-config
+
+# build the serving image deploy/proxy.yaml references
+# (spicedb-kubeapi-proxy-tpu:latest). CPU JAX by default; TPU node pools
+# pass JAX_EXTRA=tpu. DOCKER=podman works too.
+DOCKER ?= docker
+JAX_EXTRA ?= cpu
+image:
+	$(DOCKER) build --build-arg JAX_EXTRA=$(JAX_EXTRA) \
+	  -t spicedb-kubeapi-proxy-tpu:latest .
 
 # (re)build the native graph-builder core explicitly
 native:

@@ -451,6 +451,41 @@ def probe_backend(args) -> tuple[bool, Optional[str]]:
     return True, last
 
 
+def reprobe_backend(result: dict, label: str, timeout: float = 60.0,
+                    retries: int = 2) -> bool:
+    """Between-phase backend liveness check (VERDICT Weak #1: five rounds
+    of driver captures went [DEGRADED: cpu] off a single mid-run hang).
+    The probe is a SUBPROCESS with its own per-attempt deadline plus one
+    retry, so a tunnel that died after the headline costs at most
+    ~2*timeout and a skipped phase — never a 120s in-process hang that
+    runs the watchdog out and relabels already-measured real-chip data.
+    Returns True when the next device-touching phase may proceed."""
+    if result.get("degraded"):
+        return True  # already on CPU: nothing left to lose mid-run
+    override = os.environ.get("BENCH_PROBE_CMD")
+    cmd = (["sh", "-c", override] if override
+           else [sys.executable, "-c", _PROBE_CODE])
+    for attempt in range(1, retries + 1):
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout)
+            words = (p.stdout or "").strip().split()
+            if p.returncode == 0 and any(
+                    w in _TPU_PLATFORMS for w in words):
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        except OSError:
+            break
+        log(f"re-probe before {label} failed (attempt {attempt}/{retries})")
+    # record, don't relabel: phases measured BEFORE the loss keep their
+    # provenance; phases after it are skipped instead of hanging
+    result.setdefault("backend_lost_midrun", []).append(label)
+    log(f"backend unresponsive before {label}: skipping the phase "
+        "(earlier numbers keep their provenance)")
+    return False
+
+
 def _measure(args, result: dict) -> None:
     """The benchmark body; fills ``result`` in place so the caller can emit
     whatever was measured even if a later stage dies."""
@@ -645,6 +680,9 @@ def _measure(args, result: dict) -> None:
         e.disable_lookup_batching()
 
     try:
+        if not reprobe_backend(result, "chained-estimate",
+                               timeout=min(args.probe_timeout, 60.0)):
+            raise RuntimeError("backend lost mid-run")
         chain_est, p50_w1, p50_wk, k = _chained_device_estimate(
             e, subjects, trials=max(args.trials // 2, 5))
         log(f"chained-dispatch slope: wall(1)={p50_w1:.2f}ms "
@@ -829,6 +867,19 @@ def _measure(args, result: dict) -> None:
         except Exception as ex:  # noqa: BLE001 - aux measurement only
             log(f"failover section failed (non-fatal): {ex}")
 
+    # -- admission control: overload behavior at 2x offered load --
+    # (ISSUE 5 acceptance: goodput, per-class p99, per-tenant fairness,
+    # shed accounting.) Skipped on --tiny like the failover phase.
+    if not args.tiny:
+        try:
+            _admission_phase(result, quick)
+        except Exception as ex:  # noqa: BLE001 - aux measurement only
+            log(f"admission section failed (non-fatal): {ex}")
+
+    if args.remote_compare and not reprobe_backend(
+            result, "remote-compare",
+            timeout=min(args.probe_timeout, 60.0)):
+        args.remote_compare = False
     if args.remote_compare:
         # remote (tcp:// packed-bitmask wire) vs in-process list filter:
         # the directive-3 acceptance measurement — the remote hot path
@@ -902,7 +953,11 @@ def _measure(args, result: dict) -> None:
             log(f"remote-compare failed (non-fatal): {ex}")
 
     if args.suite:
-        run_suite(quick, result)
+        if reprobe_backend(result, "suite",
+                           timeout=min(args.probe_timeout, 60.0)):
+            run_suite(quick, result)
+        else:
+            log("skipping suite: backend lost mid-run")
 
 
 _FAILOVER_WORKER = r"""
@@ -1051,6 +1106,237 @@ def _failover_phase(result: dict, quick: bool) -> None:
             except subprocess.TimeoutExpired:
                 p.kill()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _admission_phase(result: dict, quick: bool) -> None:
+    """Overload behavior at 2x offered load, admission ON vs OFF
+    (ISSUE 5 acceptance): one storm tenant offers 10x each normal
+    tenant's load; admission ON must deliver higher within-SLO goodput,
+    a bounded check p99, a per-tenant fairness ratio >= 0.5, and every
+    rejection accounted in admission_shed_total{class=...} with a
+    Retry-After and a bounded wait (never a hang)."""
+    import threading as _th
+
+    from spicedb_kubeapi_proxy_tpu.admission import (
+        BULK_CHECK,
+        CHECK,
+        LOOKUP_PREFILTER,
+        AdmissionController,
+        AdmissionRejected,
+    )
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine
+    from spicedb_kubeapi_proxy_tpu.models import parse_schema
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics as _m
+
+    rng = np.random.default_rng(7)
+    n_ns, n_users = (400, 100) if quick else (2000, 400)
+    schema = parse_schema("""
+definition user {}
+definition namespace {
+  relation viewer: user
+  permission view = viewer
+}
+""")
+    cols = {k: [] for k in ("resource_type", "resource_id", "relation",
+                            "subject_type", "subject_id", "subject_relation")}
+    nss = np.char.add("ns", np.arange(n_ns).astype(str))
+    m = 8 * n_ns
+    cols["resource_type"].append(np.full(m, "namespace"))
+    cols["resource_id"].append(nss[rng.integers(n_ns, size=m)])
+    cols["relation"].append(np.full(m, "viewer"))
+    cols["subject_type"].append(np.full(m, "user"))
+    cols["subject_id"].append(
+        np.char.add("u", rng.integers(n_users, size=m).astype(str)))
+    cols["subject_relation"].append(np.full(m, ""))
+    e = Engine(schema=schema)
+    e.bulk_load({k: np.concatenate(v) for k, v in cols.items()})
+
+    def op_check(i):
+        e.check_bulk([CheckItem("namespace", f"ns{i % n_ns}", "view",
+                                "user", f"u{i % n_users}")])
+
+    def op_bulk(i):
+        e.check_bulk([CheckItem("namespace", f"ns{(i + j) % n_ns}", "view",
+                                "user", f"u{i % n_users}")
+                      for j in range(32)])
+
+    def op_lookup(i):
+        e.lookup_resources_mask("namespace", "view", "user",
+                                f"u{i % n_users}")
+
+    # 70% checks / 15% bulk checks / 15% list lookups
+    ops = ([(CHECK, op_check)] * 14 + [(BULK_CHECK, op_bulk)] * 3
+           + [(LOOKUP_PREFILTER, op_lookup)] * 3)
+    op_check(0), op_bulk(0), op_lookup(0)  # warm all three jit shapes
+
+    # -- capacity probe: closed loop, then offer 2x of it --------------------
+    def closed_loop(dur: float, nthreads: int = 8):
+        stop = time.perf_counter() + dur
+        lat: list = []
+        lock = _th.Lock()
+
+        def worker(w):
+            i = w
+            while time.perf_counter() < stop:
+                cls, op = ops[i % len(ops)]
+                t0 = time.perf_counter()
+                op(i)
+                with lock:
+                    lat.append((cls.name, time.perf_counter() - t0))
+                i += nthreads
+
+        ts = [_th.Thread(target=worker, args=(w,)) for w in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return len(lat) / dur, lat
+
+    # The load is CLOSED-LOOP per tenant (each thread issues its next
+    # request as soon as the previous completes): the overload factor is
+    # then structural — 28 worker threads against a knee measured at 8 —
+    # instead of riding a rate estimate that a noisy shared host skews
+    # several-fold between windows. The storm tenant runs 20 threads vs
+    # 2 per normal tenant: 10x the offered load of each of the rest.
+    closed_loop(0.4)  # settle: background index build, jit caches
+    cap_rps, base_lat = closed_loop(1.0 if quick else 1.5)
+    checks = sorted(dt for c, dt in base_lat if c == "check") or [0.005]
+    base_p50 = checks[len(checks) // 2]
+    slo = max(0.05, 4 * base_p50)
+    n_normal = 4
+    tenants = [(f"tenant{i}", 2) for i in range(n_normal)]
+    tenants.append(("storm", 20))
+    n_threads = sum(k for _, k in tenants)
+    log(f"[admission] capacity ~{cap_rps:.0f} req/s at 8 threads, SLO "
+        f"{slo * 1e3:.0f}ms; overload = {n_threads} closed-loop threads "
+        "(storm tenant at 10x the rest)")
+
+    avg_weight = sum(c.weight for c, _ in ops) / len(ops)
+    unit_cap = cap_rps * avg_weight
+    fair_share = unit_cap / len(tenants)  # cost units/s per tenant
+
+    def run(ctrl, dur: float):
+        start = time.perf_counter()
+        stop_at = start + dur
+        lock = _th.Lock()
+        stats = {name: {"good": 0, "done": 0, "shed": 0}
+                 for name, _ in tenants}
+        lat_by_class: dict = {}
+        shed_waits: list = []
+        retry_after_missing = [0]
+
+        def tenant_worker(name, seed):
+            n = seed
+            while time.perf_counter() < stop_at:
+                cls, op = ops[n % len(ops)]
+                n += 1
+                t0 = time.perf_counter()
+                try:
+                    ticket = ctrl.acquire(name, cls) if ctrl else None
+                    try:
+                        op(n)
+                    finally:
+                        if ticket is not None:
+                            ticket.release()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        stats[name]["done"] += 1
+                        if dt <= slo:
+                            stats[name]["good"] += 1
+                        lat_by_class.setdefault(cls.name, []).append(dt)
+                except AdmissionRejected as ex:
+                    wait = time.perf_counter() - t0
+                    with lock:
+                        stats[name]["shed"] += 1
+                        shed_waits.append(wait)
+                        if not ex.retry_after or ex.retry_after <= 0:
+                            retry_after_missing[0] += 1
+
+        threads = [_th.Thread(target=tenant_worker, args=(name, w * 37))
+                   for name, k in tenants for w in range(k)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        return stats, lat_by_class, shed_waits, retry_after_missing[0], wall
+
+    def summarize(label, stats, lat_by_class, wall):
+        good = sum(s["good"] for s in stats.values())
+        per_tenant = [s["good"] for s in stats.values()]
+        # fairness ratio: min/max of per-tenant COMPLETED service (the
+        # share of engine time each tenant received). Every tenant is
+        # backlogged (closed loop), so a fair scheduler serves them
+        # near-equally (ratio -> 1) while an unguarded dispatch pool
+        # serves them by thread count (ratio -> 2/20). Within-SLO
+        # attainment is the goodput/p99 story, reported separately — a
+        # storm whose requests wait longer is the scheduler WORKING
+        per_done = [s["done"] for s in stats.values()]
+        fairness = (min(per_done) / max(per_done)) \
+            if max(per_done) else 0.0
+        cl = sorted(lat_by_class.get("check", [0.0]))
+        p99 = cl[min(len(cl) - 1, int(len(cl) * 0.99))] * 1e3
+        shed = sum(s["shed"] for s in stats.values())
+        offered = sum(s["done"] + s["shed"] for s in stats.values()) / wall
+        log(f"[admission {label}] goodput={good / wall:.0f}/s of "
+            f"{offered:.0f}/s offered (SLO {slo * 1e3:.0f}ms), "
+            f"check p99={p99:.1f}ms, fairness={fairness:.2f} "
+            f"(per-tenant good {per_tenant}, done "
+            f"{[s['done'] for s in stats.values()]}), shed={shed}")
+        return good / wall, p99, fairness, shed, offered
+
+    dur = 2.5 if quick else 5.0
+    shed_before = sum(
+        _m.counter("admission_shed_total", **{"class": c}).value
+        for c in ("check", "bulk-check", "lookup-prefilter",
+                  "watch-recompute", "write-dtx"))
+    # the limit stays CLAMPED near the closed-loop knee (the capacity
+    # probe ran 8 threads, so ~8 ops of average weight saturate the
+    # engine): under 2x offered load the queue is then never empty, every
+    # grant goes through the fair scheduler, and admitted ops run near
+    # baseline latency instead of contending 24-wide
+    # decay SLOWER than the fair share and cap high: the capacity
+    # estimate is noisy on a shared CPU host, and a too-generous refill
+    # would zero every tenant's debt (collapsing the fair order to FIFO,
+    # which the storm wins by volume). Low decay only lengthens the
+    # storm's memory — ordering is work-conserving, so it never idles
+    # capacity
+    ctrl = AdmissionController(
+        initial_concurrency=16.0, min_concurrency=8.0,
+        max_concurrency=48.0,
+        tenant_rate=fair_share / 4, tenant_burst=unit_cap * 2,
+        tenant_depth=32, global_depth=128,
+        queue_timeout=max(0.05, slo * 0.5))
+    stats_on, lat_on, shed_waits, ra_missing, wall_on = run(ctrl, dur)
+    good_on, p99_on, fair_on, shed_on, offered_on = summarize(
+        "ON", stats_on, lat_on, wall_on)
+    shed_after = sum(
+        _m.counter("admission_shed_total", **{"class": c}).value
+        for c in ("check", "bulk-check", "lookup-prefilter",
+                  "watch-recompute", "write-dtx"))
+
+    stats_off, lat_off, _, _, wall_off = run(None, dur)
+    good_off, p99_off, fair_off, _, _ = summarize(
+        "OFF", stats_off, lat_off, wall_off)
+
+    max_wait = max(shed_waits) * 1e3 if shed_waits else 0.0
+    accounted = int(shed_after - shed_before) == shed_on
+    log(f"[admission] shed accounting: metric delta "
+        f"{int(shed_after - shed_before)} vs {shed_on} client rejections "
+        f"({'OK' if accounted else 'MISMATCH'}); max shed wait "
+        f"{max_wait:.0f}ms; {ra_missing} rejections lacked Retry-After")
+    result["admission_capacity_rps"] = round(cap_rps)
+    result["admission_offered_rps"] = round(offered_on)
+    result["admission_slo_ms"] = round(slo * 1e3, 1)
+    result["admission_goodput_on"] = round(good_on, 1)
+    result["admission_goodput_off"] = round(good_off, 1)
+    result["admission_check_p99_ms_on"] = round(p99_on, 2)
+    result["admission_check_p99_ms_off"] = round(p99_off, 2)
+    result["admission_fairness_on"] = round(fair_on, 3)
+    result["admission_fairness_off"] = round(fair_off, 3)
+    result["admission_shed"] = shed_on
+    result["admission_shed_accounted"] = accounted
+    result["admission_max_shed_wait_ms"] = round(max_wait, 1)
 
 
 def main() -> None:

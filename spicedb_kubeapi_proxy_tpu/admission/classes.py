@@ -1,0 +1,84 @@
+"""The cost classifier: operation -> cost class.
+
+Per-dispatch cost on the compiled graph is predictable enough to budget
+against (the TpuGraphs premise, PAPERS.md): a single check reads one
+slot, a bulk check shares one fixpoint across its items, a list
+prefilter reads a whole type's slot range, and a watch-hub recompute is
+a prefilter re-run triggered by write traffic rather than a waiting
+client. Each class carries:
+
+- ``weight`` — concurrency units one admitted op occupies against the
+  adaptive limit (a lookup occupies 4x what a check does, so 8 admitted
+  lists and 32 admitted checks exert the same device pressure);
+- ``priority`` — shed order under saturation, LOWEST first: watch
+  recomputes (an overloaded hub degrades to staler allowed-sets, not
+  dropped requests), then list prefilters, then checks; writes last
+  (dual-writes are the requests users retry by hand).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..rules.proxyrule import WRITE_VERBS  # noqa: F401 - one owner
+
+
+@dataclass(frozen=True)
+class CostClass:
+    name: str
+    weight: float  # concurrency units occupied while admitted
+    priority: int  # shed order: lower sheds first
+
+    def __str__(self) -> str:
+        return self.name
+
+
+CHECK = CostClass("check", 1.0, 2)
+BULK_CHECK = CostClass("bulk-check", 2.0, 2)
+LOOKUP_PREFILTER = CostClass("lookup-prefilter", 4.0, 1)
+WATCH_RECOMPUTE = CostClass("watch-recompute", 4.0, 0)
+WRITE_DTX = CostClass("write-dtx", 2.0, 3)
+
+CLASSES = {c.name: c for c in (CHECK, BULK_CHECK, LOOKUP_PREFILTER,
+                               WATCH_RECOMPUTE, WRITE_DTX)}
+
+# engine-host wire ops that pass through admission (engine/remote.py
+# EngineServer._dispatch); everything else — auth, failover_state,
+# revision, watch/mirror subscriptions, id-table syncs — is either
+# control-plane or too cheap to queue
+_OP_CLASSES = {
+    "check_bulk": CHECK,  # promoted to BULK_CHECK by item count
+    "lookup_resources": LOOKUP_PREFILTER,
+    "lookup_mask": LOOKUP_PREFILTER,
+    "read_relationships": CHECK,
+    "watch_since": WATCH_RECOMPUTE,
+    "write_relationships": WRITE_DTX,
+    "delete_relationships": WRITE_DTX,
+}
+
+
+def classify_op(op: str, n_items: int = 1) -> "CostClass | None":
+    """Cost class for an engine-host wire op, or None for ungated ops."""
+    cls = _OP_CLASSES.get(op)
+    if cls is CHECK and op == "check_bulk" and n_items > 1:
+        return BULK_CHECK
+    return cls
+
+
+def classify_request(verb: str, rules) -> CostClass:
+    """Cost class for one proxy request, from its verb and the matched
+    rule set — the class of the request's most expensive engine-bound
+    phase. Exception-free by construction (multi-prefilter/multi-update
+    misconfigurations surface later on their own paths)."""
+    if verb in WRITE_VERBS:
+        return WRITE_DTX
+    has_prefilter = any(r.pre_filters for r in rules)
+    if verb == "watch":
+        # a prefiltered watch drives hub recomputes for its lifetime; a
+        # plain watch only pays its admission checks
+        return WATCH_RECOMPUTE if has_prefilter else CHECK
+    if has_prefilter or (verb == "list"
+                         and any(r.post_filters for r in rules)):
+        return LOOKUP_PREFILTER
+    n_checks = sum(len(r.checks) for r in rules)
+    return BULK_CHECK if n_checks > 1 else CHECK

@@ -93,21 +93,34 @@ def single_prefilter(rules: list[RunnableRule]) -> Optional[tuple[RunnableRule, 
 
 def run_prefilter_sync(engine: Engine, pf: PreFilter,
                        input: ResolveInput,
-                       strict: bool = True) -> AllowedSet:
+                       strict: bool = True, lookup=None) -> AllowedSet:
     """``strict=False`` skips ids whose name/namespace mapping expression
     fails instead of raising — for MID-STREAM recomputes, where one
     unmappable id must not freeze the allowed set (a frozen set fails
     OPEN for revocations). The initial, pre-headers run stays strict so
-    misconfigured mappings surface as a 500."""
+    misconfigured mappings surface as a 500.
+
+    ``lookup`` overrides the engine call with ``lookup(rel) -> [ids]`` —
+    the watch hub routes group recomputes through a shared
+    :class:`~..engine.batcher.LookupBatcher` this way, so N groups
+    triggered by one write batch fuse into ~N/8 device fixpoints
+    instead of N (authz/watchhub.py). Results are unconditional by
+    construction (caveated tuples never enter the store —
+    models/bootstrap.py / engine._validate — so there are no
+    CONDITIONAL results to skip; the reference's lookups.go:83-90 skip
+    happens here at ingest instead)."""
     rel = pf.rel.generate(input)[0]
     if rel.resource_id != MATCHING_ID_FIELD_VALUE:
         raise PreFilterError(
             f"prefilter resource ID must be {MATCHING_ID_FIELD_VALUE!r}, "
             f"got {rel.resource_id!r} (reference lookups.go:49-56)")
-    ids = engine.lookup_resources(
-        rel.resource_type, rel.resource_relation,
-        rel.subject_type, rel.subject_id, rel.subject_relation or None,
-    )
+    if lookup is not None:
+        ids = lookup(rel)
+    else:
+        ids = engine.lookup_resources(
+            rel.resource_type, rel.resource_relation,
+            rel.subject_type, rel.subject_id, rel.subject_relation or None,
+        )
     allowed = AllowedSet()
     pairs = allowed.pairs
     # Vectorized fast paths for the dominant mapping forms, classified
@@ -161,9 +174,9 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
 
 async def run_prefilter(engine: Engine, pf: PreFilter,
                         input: ResolveInput,
-                        strict: bool = True) -> AllowedSet:
+                        strict: bool = True, lookup=None) -> AllowedSet:
     """Async wrapper so the device query overlaps the upstream kube request
     (the reference overlaps via goroutine+channel,
     responsefilterer.go:165-183)."""
     return await asyncio.to_thread(run_prefilter_sync, engine, pf, input,
-                                   strict)
+                                   strict, lookup)

@@ -67,6 +67,10 @@ class AuthzDeps:
     # per-dependency circuit breakers (utils/resilience.CircuitBreaker)
     # whose open state makes /readyz report unready with a reason
     breakers: tuple = ()
+    # admission controller (admission/controller.py): every engine-bound
+    # request acquires a cost-classed, per-tenant fair-queue slot before
+    # the check phase; None = unguarded (today's behavior)
+    admission: Optional[object] = None
 
 
 def _always_allowed(req: ProxyRequest) -> bool:
@@ -135,6 +139,47 @@ async def _authorize_inner(req: ProxyRequest,
             403, f"user {user.name!r} cannot {info.verb} {info.resource}",
             "Forbidden")
 
+    # -- admission control (admission/): the request is about to touch the
+    # engine — acquire a cost-classed slot under the caller's tenant
+    # identity FIRST, so one subject's LookupResources storm queues behind
+    # its own fair share instead of starving everyone's checks. A shed or
+    # timed-out wait raises AdmissionRejected (DependencyUnavailable), and
+    # authorize() above turns it into the fail-closed 503 + Retry-After —
+    # before any check dispatch, workflow enqueue, or upstream byte.
+    if deps.admission is None:
+        return await _authorized(req, deps, info, user, input, rules)
+    from ..admission import classify_request
+
+    ticket = await deps.admission.acquire_async(
+        user.name or "system:anonymous",
+        classify_request(info.verb, rules))
+    try:
+        return await _authorized(req, deps, info, user, input, rules,
+                                 ticket)
+    finally:
+        # backstop for the paths whose engine work OVERLAPS or FOLLOWS
+        # the upstream call (prefilter, postfilter, postchecks): they
+        # hold the ticket to here, so their span includes an upstream
+        # RTT — the weighted COST accounting is correct (the engine was
+        # genuinely busy for part of it) but the duration is not an
+        # engine-latency sample, so it must not feed the limiter (one
+        # 100ms kube RTT against a ~1ms check baseline would read as
+        # massive engine congestion). Engine-only spans released early
+        # inside _authorized DO observe; release is idempotent.
+        ticket.release(observe=False)
+
+
+async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
+                      input: ResolveInput, rules,
+                      ticket=None) -> ProxyResponse:
+    """The engine-bound phases (checks onward). The admission ticket,
+    when admission is enabled, is held from the check phase until the
+    last engine-bound segment of the request: it is released before
+    upstream-dominated tails (a plain proxied read/write, the dual-write
+    workflow wait) — holding it there would bill kube-apiserver latency
+    to the engine limiter and convert an upstream slowdown into engine
+    unavailability. Paths whose engine work OVERLAPS or FOLLOWS the
+    upstream call (prefilter, postfilter, postchecks) hold it across."""
     try:
         # non-blocking decision-cache probe first: a full hit answers on
         # the event loop with zero thread handoff (the repeat-heavy
@@ -144,6 +189,11 @@ async def _authorize_inner(req: ProxyRequest,
         # (concurrent requests pipeline their dispatches; the reference
         # fans checks out over goroutines, check.go:77-93)
         items, verdict = cached_verdict(deps.engine, rules, input)
+        # a fully-cached verdict means this span dispatched NOTHING: its
+        # (floor-clamped) duration must not feed the limiter's baseline,
+        # or repeat-heavy cache-hit traffic would pin the baseline at the
+        # floor and make ordinary device latency read as congestion
+        engine_sampled = verdict is None
         if verdict is None:
             verdict = await asyncio.to_thread(
                 run_checks, deps.engine, rules, input, items=items)
@@ -171,7 +221,15 @@ async def _authorize_inner(req: ProxyRequest,
             # rejections (check_open never consumes the probe slot)
             for b in deps.breakers:
                 b.check_open()
+            if ticket is not None:
+                # the engine-bound part (the admission check) is done;
+                # the ≤30s workflow wait is upstream + sqlite time (its
+                # own engine writes are gated host-side when remote)
+                ticket.release(observe=engine_sampled)
             return await _dual_write(req, deps, update_rule, input)
+        if ticket is not None:
+            # plain proxied write: no engine work left
+            ticket.release(observe=engine_sampled)
         return await deps.upstream(req)
 
     # -- watch ----------------------------------------------------------------
@@ -182,6 +240,9 @@ async def _authorize_inner(req: ProxyRequest,
 
     if info.verb == "watch":
         if pf is None:
+            if ticket is not None:
+                # plain proxied watch: checks are done
+                ticket.release(observe=engine_sampled)
             return await deps.upstream(req)
         if deps.watch_hub is None:
             from .watchhub import WatchHub
@@ -199,11 +260,23 @@ async def _authorize_inner(req: ProxyRequest,
 
     # -- read path: prefilter overlap + response filtering --------------------
     post_filters = [p for r in rules for p in r.post_filters]
+    # the ONE derivation of which engine-bound tails this request has:
+    # the dispatch branches below AND the early-release decision both
+    # read these, so a new tail cannot silently escape the admission span
+    run_postfilter = bool(post_filters and info.verb == "list")
+    run_postchecks = (info.verb == "get"
+                      and any(r.post_checks for r in rules))
     prefilter_task = None
     if pf is not None:
         prefilter_task = asyncio.ensure_future(
             run_prefilter(deps.engine, pf[1], input))
-    if post_filters and info.verb == "list":
+    if ticket is not None and prefilter_task is None \
+            and not run_postfilter and not run_postchecks:
+        # nothing engine-bound overlaps or follows the upstream call:
+        # release now so the upstream RTT isn't billed as engine latency
+        # (and a fully-cached span isn't billed as an engine sample)
+        ticket.release(observe=engine_sampled)
+    if run_postfilter:
         # the postfilter resolves rule expressions over each item's JSON
         # object — protobuf list bodies can't feed it, so strip non-JSON
         # ranges from the Accept (keeping JSON ;as=Table form: the
@@ -233,7 +306,7 @@ async def _authorize_inner(req: ProxyRequest,
         except (PreFilterError, ExprError) as e:
             return kube_status(401, f"prefilter: {e}")
         resp = apply_filter(resp, allowed, input)
-    if post_filters and info.verb == "list":
+    if run_postfilter:
         try:
             resp = await asyncio.to_thread(
                 filter_list_response, deps.engine, post_filters, input, resp)
@@ -241,8 +314,7 @@ async def _authorize_inner(req: ProxyRequest,
             return kube_status(401, f"postfilter: {e}")
 
     # -- postchecks (get only; reference shouldRunPostChecks authz.go:211-220)
-    if info.verb == "get" and resp.status < 300 \
-       and any(r.post_checks for r in rules):
+    if run_postchecks and resp.status < 300:
         try:
             post_items, post_verdict = cached_verdict(
                 deps.engine, rules, input, post=True)

@@ -211,6 +211,16 @@ class Engine:
     # -- write path ---------------------------------------------------------
 
     def _validate(self, rel: Relationship) -> None:
+        if getattr(rel, "caveat", None):
+            # caveats parse (models/tuples.py) but are NOT enforced;
+            # storing a conditional grant as unconditional would fail
+            # OPEN on every check/lookup that touches it — refuse instead
+            # (lookups then trivially skip conditional results, the
+            # reference's pkg/authz/lookups.go:83-90 direction)
+            raise SchemaViolation(
+                f"relationship carries caveat {rel.caveat!r}, which this "
+                "engine does not enforce; refusing to store a "
+                "conditional grant as unconditional")
         d = self.schema.definitions.get(rel.resource_type)
         if d is None:
             raise SchemaViolation(f"unknown resource type {rel.resource_type!r}")

@@ -50,6 +50,7 @@ from dataclasses import asdict
 from functools import partial
 from typing import Optional
 
+from ..admission import AdmissionRejected, classify_op
 from ..utils.failpoints import FailPointError, failpoints
 from ..utils.metrics import metrics
 from ..utils.net import drain_server
@@ -245,13 +246,20 @@ class EngineServer:
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
                  port: int = 0, token: Optional[str] = None,
                  ssl_context=None, max_workers: int = 64,
-                 failover_status=None):
+                 failover_status=None, admission=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.engine = engine
         self.host = host
         self.port = port
         self.token = token
+        # admission controller (admission/): device-dispatching ops
+        # acquire a cost-classed slot — tenant = the proxy replica's peer
+        # address — BEFORE entering the worker pool, so one replica's
+        # storm cannot monopolize a shared engine host and overload sheds
+        # as wire-level "admission" rejections instead of queueing
+        # unboundedly in the executor. None = ungated (today's behavior).
+        self.admission = admission
         # replication role provider (parallel/failover.py coordinator):
         # a callable returning {role, term, revision, peer_id, lag}.
         # When set, every op except failover_state is ROLE-GATED — a
@@ -332,13 +340,20 @@ class EngineServer:
     async def _serve_inner(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         authed = not self.token
+        # admission tenancy: the peer ADDRESS (one tenant per proxy
+        # replica, however many pooled connections it opens) — server-
+        # derived, never client-asserted, so a token holder cannot mint
+        # fresh tenants to reset its fair-queue debt
+        peer = writer.get_extra_info("peername")
+        peer_tenant = peer[0] if isinstance(peer, (tuple, list)) and peer \
+            else "local"
         try:
             while True:
                 limit = MAX_FRAME if authed else MAX_FRAME_PREAUTH
                 req = await _read_frame(reader, limit=limit)
                 if req is None:
                     return
-                resp = await self._dispatch(req)
+                resp = await self._dispatch(req, peer_tenant)
                 if isinstance(resp, BinaryResult):
                     authed = True
                     writer.write(_pack_binary(resp))
@@ -372,11 +387,12 @@ class EngineServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, req: dict) -> dict:
+    async def _dispatch(self, req: dict, tenant: str = "local") -> dict:
         if self.token and not hmac.compare_digest(
                 str(req.get("token") or ""), self.token):
             return {"ok": False, "kind": "auth", "error": "invalid token"}
         op = req.get("op")
+        ticket = None
         try:
             fn = getattr(self, f"_op_{op}", None)
             if fn is None:
@@ -411,10 +427,26 @@ class EngineServer:
                         # run a doomed (fenced) op to completion
                         raise _Demoted(st2.get("role"), st2.get("term"))
                     return inner_fn(view, r)
+            if self.admission is not None:
+                cls = classify_op(op, len(req.get("items") or ()) or 1)
+                if cls is not None:
+                    # admission runs AFTER the role gate (a follower's
+                    # not_leader must win — its rejection re-aims the
+                    # client) and BEFORE the worker pool: queued ops park
+                    # a future here, not an executor thread. Tenancy is
+                    # the PEER ADDRESS only — a wire-level override would
+                    # let any token holder mint fresh zero-debt tenants
+                    # per request and defeat the fair queue entirely
+                    ticket = await self.admission.acquire_async(tenant, cls)
             result = await self._in_worker(fn, req)
             if isinstance(result, BinaryResult):
                 return result
             return {"ok": True, "result": result}
+        except AdmissionRejected as e:
+            # NOT a transport failure: rides a normal response frame, so
+            # client breakers stay closed (the host is healthy, just full)
+            return {"ok": False, "kind": "admission", "error": str(e),
+                    "class": e.op_class, "retry_after": e.retry_after}
         except _Demoted as e:
             return {"ok": False, "kind": "not_leader",
                     "error": f"engine host was demoted to {e.role} "
@@ -428,6 +460,19 @@ class EngineServer:
         except Exception as e:
             log.exception("engine op %s failed", op)
             return {"ok": False, "kind": "internal", "error": str(e)}
+        finally:
+            if ticket is not None:
+                # the limiter's latency probe is the SINGLE-CHECK class
+                # only — the one op whose duration is homogeneous.
+                # Bulk-check spans scale with item count, lookups with
+                # the fixpoint, and replicated writes with the sync-
+                # replication wait: feeding that mixture to one baseline
+                # would read op VARIETY as congestion and ratchet the
+                # limit to minimum on a healthy host (device queueing
+                # still surfaces in check latency — same chip). The
+                # other classes still occupy weighted budget while held.
+                ticket.release(
+                    observe=ticket.cls.name == "check")
 
     # -- ops (run in worker threads) ----------------------------------------
 
@@ -939,6 +984,18 @@ class RemoteEngine:
                 return resp.get("result")
             kind = resp.get("kind", "internal")
             err = resp.get("error", "")
+            if kind == "admission":
+                # engine-host load shed: pre-dispatch by construction, so
+                # even writes are safe to retry after Retry-After. Its own
+                # dependency label keeps it distinguishable from proxy-
+                # side admission and from not_leader in the 503 metrics.
+                try:
+                    retry_after = float(resp.get("retry_after") or 1.0)
+                except (TypeError, ValueError):
+                    retry_after = 1.0
+                raise AdmissionRejected(
+                    str(resp.get("class") or "?"), err,
+                    retry_after=retry_after, dependency="engine-admission")
             raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
 
     def _transact(self, payload: bytes,
@@ -1304,6 +1361,12 @@ class FailoverEngine:
         c = self._primary()
         try:
             return call(c)
+        except AdmissionRejected:
+            # a healthy-but-overloaded leader shed the op: re-aiming at a
+            # follower cannot help (it would only answer not_leader), and
+            # a probe storm would add load to exactly the wrong host —
+            # surface the shed (503 + Retry-After) immediately
+            raise
         except NotLeaderError as e:
             cause, retry_ok = e, True  # rejected BEFORE dispatch
         except DependencyUnavailable as e:
@@ -1545,6 +1608,32 @@ def main(argv=None) -> int:
     ap.add_argument("--authz-cache-mask-bytes", type=int,
                     default=256 << 20,
                     help="resident lookup-mask byte budget")
+    ap.add_argument("--admission", type=parse_bool_flag, nargs="?",
+                    const=True, default=False, metavar="BOOL",
+                    help="admission control (admission/): cost-classed, "
+                         "per-tenant (= proxy replica) fair queueing with "
+                         "an adaptive concurrency limit and priority load "
+                         "shedding in front of the dispatch pool — "
+                         "protects a shared engine host from the "
+                         "aggregate of many proxy replicas (default off)")
+    ap.add_argument("--admission-initial-concurrency", type=float,
+                    default=32.0,
+                    help="adaptive limiter's starting weighted-cost limit")
+    ap.add_argument("--admission-min-concurrency", type=float, default=4.0)
+    ap.add_argument("--admission-max-concurrency", type=float,
+                    default=512.0)
+    ap.add_argument("--admission-tenant-rate", type=float, default=50.0,
+                    help="per-tenant fair-share refill (cost units/s)")
+    ap.add_argument("--admission-tenant-burst", type=float, default=100.0,
+                    help="per-tenant debt cap (cost units a storm is "
+                         "remembered for)")
+    ap.add_argument("--admission-tenant-queue-depth", type=int, default=32)
+    ap.add_argument("--admission-queue-depth", type=int, default=256,
+                    help="global queued-request bound; past it the "
+                         "lowest-priority class sheds first")
+    ap.add_argument("--admission-queue-timeout", type=float, default=1.0,
+                    help="max seconds a request may queue before it is "
+                         "shed (503 + Retry-After, never a hang)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -1559,6 +1648,23 @@ def main(argv=None) -> int:
     if args.engine_insecure and args.tls_cert_file:
         ap.error("--engine-insecure and --tls-cert-file are mutually "
                  "exclusive")
+    if args.admission:
+        # shared validator (admission.validate_config, also behind
+        # proxy/options.py): misconfiguration is a clean flag error at
+        # boot, not a raw constructor traceback or a silently-degenerate
+        # fair queue (rate 0 never forgives debt)
+        from ..admission import validate_config
+
+        try:
+            validate_config(
+                args.admission_initial_concurrency,
+                args.admission_min_concurrency,
+                args.admission_max_concurrency,
+                args.admission_tenant_rate, args.admission_tenant_burst,
+                args.admission_tenant_queue_depth,
+                args.admission_queue_depth, args.admission_queue_timeout)
+        except ValueError as e:
+            ap.error(str(e))
     peers = None
     if args.peers:
         from ..parallel.failover import FailoverError, parse_peers
@@ -1694,8 +1800,31 @@ def main(argv=None) -> int:
         # follower has subscribed (n-1 of them)
         engine = MirroredEngine(
             engine, min_subscribers=_jax.process_count() - 1)
+    admission = None
+    if args.admission:
+        from ..admission import AdmissionController
+
+        admission = AdmissionController(
+            initial_concurrency=args.admission_initial_concurrency,
+            min_concurrency=args.admission_min_concurrency,
+            max_concurrency=args.admission_max_concurrency,
+            tenant_rate=args.admission_tenant_rate,
+            tenant_burst=args.admission_tenant_burst,
+            tenant_depth=args.admission_tenant_queue_depth,
+            global_depth=args.admission_queue_depth,
+            queue_timeout=args.admission_queue_timeout,
+            dependency="engine-admission")
+        log.info("admission control on: limit %.0f (%.0f..%.0f), queue "
+                 "%d/%d, timeout %.2fs",
+                 args.admission_initial_concurrency,
+                 args.admission_min_concurrency,
+                 args.admission_max_concurrency,
+                 args.admission_tenant_queue_depth,
+                 args.admission_queue_depth,
+                 args.admission_queue_timeout)
     server = EngineServer(engine, args.bind_host, args.bind_port,
-                          token=args.token, ssl_context=server_ssl)
+                          token=args.token, ssl_context=server_ssl,
+                          admission=admission)
     coordinator = None
     if peers is not None:
         from ..parallel.failover import FailoverCoordinator

@@ -25,9 +25,12 @@ Arrows bind tighter than binary operators.
 
 from __future__ import annotations
 
+import logging
 import re
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
+
+log = logging.getLogger("sdbkp.schema")
 
 
 class SchemaError(ValueError):
@@ -114,6 +117,8 @@ class AllowedSubject:
     relation: Optional[str] = None  # userset subjects: group#member
     wildcard: bool = False  # user:*
     expiration: bool = False  # `with expiration` trait
+    caveat: Optional[str] = None  # `with <caveat>` trait (ignored; see
+    #                               skip_caveat — validated as declared)
 
     def __str__(self) -> str:
         s = self.type
@@ -152,6 +157,12 @@ class Definition:
 class Schema:
     definitions: dict[str, Definition] = field(default_factory=dict)
     use_expiration: bool = False
+    # DECLARED caveat names (parsed then ignored — see skip_caveat):
+    # kept so tuple traits can be told apart from typos — a tuple
+    # carrying a declared caveat degrades warn-and-skip, an UNDECLARED
+    # bracket trait (e.g. a misspelled expiration) fails loudly instead
+    # of silently dropping the grant
+    caveats: set = field(default_factory=set)
 
     def definition(self, name: str) -> Definition:
         try:
@@ -255,7 +266,7 @@ class _Parser:
                     raise SchemaError(f"duplicate definition {d.name!r}")
                 schema.definitions[d.name] = d
             elif self.cur.value == "caveat":
-                self.skip_caveat()
+                schema.caveats.add(self.skip_caveat())
             else:
                 raise SchemaError(
                     f"schema line {self.cur.line}: expected 'definition', got {self.cur.value!r}"
@@ -263,11 +274,21 @@ class _Parser:
         _validate(schema)
         return schema
 
-    def skip_caveat(self) -> None:
-        # `caveat name(args) { expr }` — parsed and discarded (caveats beyond
-        # `expiration` are not used by the reference proxy).
+    def skip_caveat(self) -> str:
+        # `caveat name(args) { expr }` — parsed and discarded, WITH a
+        # warning (warn-and-ignore degradation): caveats beyond
+        # `expiration` are not enforced by this engine, so relationships
+        # carrying them are excluded at load time (models/bootstrap.py)
+        # and lookups/checks never see conditional grants — fail closed,
+        # mirroring the reference skipping CONDITIONAL LookupResources
+        # results (pkg/authz/lookups.go:83-90). Returns the declared
+        # name (Schema.caveats) so tuple traits can be validated.
         self.expect("caveat")
-        self.expect_ident()
+        name = self.expect_ident()
+        log.warning(
+            "schema: caveat %r parsed but IGNORED (caveats are not "
+            "enforced; relationships conditioned on it will be excluded "
+            "— conditional grants fail closed)", name)
         depth = 0
         while True:
             t = self.advance()
@@ -278,7 +299,7 @@ class _Parser:
             elif t.value in ")}":
                 depth -= 1
                 if depth == 0 and t.value == "}":
-                    return
+                    return name
 
     def parse_definition(self) -> Definition:
         self.expect("definition")
@@ -326,13 +347,33 @@ class _Parser:
         if self.cur.value == "#":
             self.advance()
             relation = self.expect_ident()
+        caveat = None
         while self.cur.value == "with":
             self.advance()
-            trait = self.expect_ident()
-            if trait == "expiration":
-                expiration = True
-            # other traits (caveats) are tolerated and ignored
-        return AllowedSubject(typ, relation, wildcard, expiration)
+            while True:
+                trait = self.expect_ident()
+                if trait == "expiration":
+                    expiration = True
+                else:
+                    # a caveated subject type (`user with ip_allowlist`):
+                    # tolerated (warn-and-ignore) rather than a parse
+                    # failure — the relation stays usable, and tuples
+                    # actually CARRYING the caveat are excluded at load
+                    # time (conditional grants fail closed). _validate
+                    # still requires the name to be DECLARED, so a
+                    # misspelled `expiration` cannot slip through as a
+                    # phantom caveat.
+                    caveat = trait
+                    log.warning(
+                        "schema: subject %r allows caveat %r, which is "
+                        "not enforced (caveated tuples are excluded)",
+                        typ, trait)
+                # SpiceDB chains traits with `and`:
+                # `user with some_caveat and expiration`
+                if self.cur.value != "and":
+                    break
+                self.advance()
+        return AllowedSubject(typ, relation, wildcard, expiration, caveat)
 
     def parse_permission(self) -> Permission:
         self.expect("permission")
@@ -404,6 +445,14 @@ def _validate(schema: Schema) -> None:
                 if a.type not in schema.definitions:
                     raise SchemaError(
                         f"{d.name}#{r.name}: unknown subject type {a.type!r}"
+                    )
+                if a.caveat is not None and a.caveat not in schema.caveats:
+                    # tolerate only DECLARED caveats: `with expirations`
+                    # (a typo) must fail the parse loudly, not become a
+                    # phantom caveat that silently drops grants
+                    raise SchemaError(
+                        f"{d.name}#{r.name}: unknown trait {a.caveat!r} "
+                        "(not 'expiration' and no such caveat declared)"
                     )
                 if a.relation is not None:
                     sub = schema.definitions[a.type]

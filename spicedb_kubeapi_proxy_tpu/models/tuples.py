@@ -10,10 +10,13 @@ format used in bootstrap ``relationships`` blocks
 
 from __future__ import annotations
 
+import logging
 import re
 from dataclasses import dataclass, replace
 from datetime import datetime, timezone
 from typing import Optional
+
+log = logging.getLogger("sdbkp.tuples")
 
 
 class TupleError(ValueError):
@@ -37,11 +40,32 @@ _IDENT = r"[A-Za-z_][A-Za-z0-9_/]*"
 # — unambiguous because the structural '@' separator is always preceded by
 # '#relation', and relations cannot contain '@'.
 _ID = r"[A-Za-z0-9_.=+/@-]+|\*"
-_REL_RE = re.compile(
-    rf"^(?P<resource_type>{_IDENT}):(?P<resource_id>{_ID})#(?P<relation>{_IDENT})"
+_REL_CORE = (
+    rf"(?P<resource_type>{_IDENT}):(?P<resource_id>{_ID})#(?P<relation>{_IDENT})"
     rf"@(?P<subject_type>{_IDENT}):(?P<subject_id>{_ID})"
     rf"(?:#(?P<subject_relation>{_IDENT}|\.\.\.))?"
+)
+_REL_RE = re.compile(
+    "^" + _REL_CORE +
+    # optional caveat trait (SpiceDB `[caveat_name]` /
+    # `[caveat_name:{...context...}]`) BEFORE the expiration trait; the
+    # lookahead keeps `[expiration:...]` out of the caveat group. Parsed
+    # tolerantly — enforcement is warn-and-skip at load time
+    rf"(?:\[(?!expiration[:\]])(?P<caveat>[A-Za-z_][A-Za-z0-9_/]*)"
+    rf"(?::(?P<caveat_ctx>[^\]]*))?\])?"
     rf"(?:\[expiration:(?P<expiration>[^\]]+)\])?$"
+)
+# a caveat CONTEXT may carry JSON with nested ']' (e.g.
+# `[ip_allowlist:{"ips":["10.0.0.0/8"]}]`), which the strict bracket
+# grammar above cannot span: this fallback's non-greedy DOTALL context
+# backtracks to the real closing bracket, so such tuples still hit the
+# documented warn-and-skip degradation instead of crashing the bootstrap
+_REL_CAVEAT_LENIENT_RE = re.compile(
+    "^" + _REL_CORE +
+    rf"\[(?!expiration[:\]])(?P<caveat>[A-Za-z_][A-Za-z0-9_/]*)"
+    rf":(?P<caveat_ctx>.*?)\]"
+    rf"(?:\[expiration:(?P<expiration>[^\]]+)\])?$",
+    re.DOTALL,
 )
 
 ELLIPSIS = "..."
@@ -56,6 +80,11 @@ class Relationship:
     subject_id: str
     subject_relation: Optional[str] = None  # userset subject, e.g. group#member
     expiration: Optional[float] = None  # unix seconds; None = never expires
+    # caveat NAME when the tuple is conditional (`[caveat_name]`); parsed
+    # tolerantly but never enforced: the engine REFUSES to store caveated
+    # tuples (a conditional grant served unconditionally would fail open)
+    # and the bootstrap loader skips them with a warning
+    caveat: Optional[str] = None
 
     def key(self) -> tuple:
         """Identity key — expiration is an attribute, not identity (TOUCH
@@ -79,6 +108,8 @@ class Relationship:
         )
         if self.subject_relation:
             s += f"#{self.subject_relation}"
+        if self.caveat:
+            s += f"[{self.caveat}]"
         if self.expiration is not None:
             ts = datetime.fromtimestamp(self.expiration, tz=timezone.utc)
             s += f"[expiration:{ts.strftime('%Y-%m-%dT%H:%M:%SZ')}]"
@@ -101,7 +132,8 @@ def parse_expiration(text: str) -> float:
 
 def parse_relationship(text: str) -> Relationship:
     """Parse a concrete relationship string (no templates)."""
-    m = _REL_RE.match(text.strip())
+    t = text.strip()
+    m = _REL_RE.match(t) or _REL_CAVEAT_LENIENT_RE.match(t)
     if not m:
         raise TupleError(f"invalid relationship: {text!r}")
     g = m.groupdict()
@@ -109,6 +141,12 @@ def parse_relationship(text: str) -> Relationship:
     if sub_rel == ELLIPSIS:
         sub_rel = None
     exp = parse_expiration(g["expiration"]) if g["expiration"] else None
+    caveat = g.get("caveat") or None
+    if caveat:
+        log.warning(
+            "relationship %r carries caveat %r, which is not enforced "
+            "(conditional grants are excluded at load — fail closed)",
+            text.strip(), caveat)
     return Relationship(
         g["resource_type"],
         g["resource_id"],
@@ -117,6 +155,7 @@ def parse_relationship(text: str) -> Relationship:
         g["subject_id"],
         sub_rel,
         exp,
+        caveat,
     )
 
 

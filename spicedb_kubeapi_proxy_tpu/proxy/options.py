@@ -226,6 +226,20 @@ class Options:
     # circuit waits before admitting a half-open probe
     breaker_failure_threshold: int = 5
     breaker_reset_seconds: float = 10.0
+    # -- admission control (admission/) --------------------------------------
+    # cost-classed, per-tenant (= authenticated user) fair queueing with
+    # an adaptive concurrency limit and priority load shedding in front
+    # of every engine-bound request; shed requests get the fail-closed
+    # kube 503 + Retry-After. Off by default (today's behavior).
+    admission: bool = False
+    admission_initial_concurrency: float = 32.0
+    admission_min_concurrency: float = 4.0
+    admission_max_concurrency: float = 512.0
+    admission_tenant_rate: float = 50.0  # fair-share refill, cost units/s
+    admission_tenant_burst: float = 100.0  # per-tenant debt cap
+    admission_tenant_queue_depth: int = 32
+    admission_queue_depth: int = 256  # global bound; lowest priority sheds
+    admission_queue_timeout: float = 1.0  # max queue wait before shedding
 
     def _parse_remote(self) -> Optional[list[tuple[str, int]]]:
         """[(host, port), ...] for tcp:// endpoints, None otherwise;
@@ -338,6 +352,23 @@ class Options:
             raise OptionsError("breaker-failure-threshold must be >= 1")
         if self.breaker_reset_seconds < 0:
             raise OptionsError("breaker-reset-seconds must be >= 0")
+        if self.admission:
+            from ..admission import validate_config
+
+            try:
+                # ONE owner for the bounds, shared with the engine-host
+                # CLI so the two flag surfaces can never drift
+                validate_config(
+                    self.admission_initial_concurrency,
+                    self.admission_min_concurrency,
+                    self.admission_max_concurrency,
+                    self.admission_tenant_rate,
+                    self.admission_tenant_burst,
+                    self.admission_tenant_queue_depth,
+                    self.admission_queue_depth,
+                    self.admission_queue_timeout)
+            except ValueError as e:
+                raise OptionsError(str(e)) from None
         if self.authz_cache_size < 1:
             raise OptionsError("authz-cache-size must be >= 1")
         if self.authz_cache_mask_bytes < 0:
@@ -542,11 +573,26 @@ class Options:
         dep_breakers = tuple(
             b for b in (getattr(upstream, "breaker", None),
                         getattr(engine, "breaker", None)) if b is not None)
+        admission = None
+        if self.admission:
+            from ..admission import AdmissionController
+
+            admission = AdmissionController(
+                initial_concurrency=self.admission_initial_concurrency,
+                min_concurrency=self.admission_min_concurrency,
+                max_concurrency=self.admission_max_concurrency,
+                tenant_rate=self.admission_tenant_rate,
+                tenant_burst=self.admission_tenant_burst,
+                tenant_depth=self.admission_tenant_queue_depth,
+                global_depth=self.admission_queue_depth,
+                queue_timeout=self.admission_queue_timeout,
+                dependency="admission")
         deps = AuthzDeps(
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
             discovery_cache=discovery_cache,
             breakers=dep_breakers,
+            admission=admission,
         )
         ssl_context = None
         if self.tls_cert_file:
@@ -616,6 +662,11 @@ class Options:
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
         "breaker_reset_seconds",
+        "admission", "admission_initial_concurrency",
+        "admission_min_concurrency", "admission_max_concurrency",
+        "admission_tenant_rate", "admission_tenant_burst",
+        "admission_tenant_queue_depth", "admission_queue_depth",
+        "admission_queue_timeout",
     )
 
     def debug_dump(self) -> dict:
@@ -833,6 +884,49 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--breaker-reset-seconds", type=float, default=10.0,
                         help="how long an open circuit waits before "
                              "admitting a half-open probe")
+    parser.add_argument("--admission", type=parse_bool_flag, nargs="?",
+                        const=True, default=False, metavar="BOOL",
+                        help="admission control: cost-classed, per-tenant "
+                             "(= authenticated user) fair queueing with "
+                             "an adaptive concurrency limit and priority "
+                             "load shedding in front of every "
+                             "engine-bound request; overload sheds as "
+                             "fail-closed 503 + Retry-After instead of "
+                             "queueing unboundedly (default off; see "
+                             "docs/operations.md 'Admission control & "
+                             "overload')")
+    parser.add_argument("--admission-initial-concurrency", type=float,
+                        default=32.0,
+                        help="adaptive limiter's starting weighted-cost "
+                             "limit (check=1, bulk-check/write=2, "
+                             "lookup/watch-recompute=4 units)")
+    parser.add_argument("--admission-min-concurrency", type=float,
+                        default=4.0,
+                        help="floor the limiter never drops below")
+    parser.add_argument("--admission-max-concurrency", type=float,
+                        default=512.0,
+                        help="ceiling the limiter never probes past")
+    parser.add_argument("--admission-tenant-rate", type=float,
+                        default=50.0,
+                        help="per-tenant fair-share refill (cost "
+                             "units/s): how fast a tenant's consumed "
+                             "device time is forgiven")
+    parser.add_argument("--admission-tenant-burst", type=float,
+                        default=100.0,
+                        help="per-tenant debt cap (cost units a storm "
+                             "is remembered for)")
+    parser.add_argument("--admission-tenant-queue-depth", type=int,
+                        default=32,
+                        help="max queued requests per tenant")
+    parser.add_argument("--admission-queue-depth", type=int, default=256,
+                        help="global queued-request bound; past it the "
+                             "lowest-priority class sheds first (watch "
+                             "ticks, then lists, then checks; writes "
+                             "last)")
+    parser.add_argument("--admission-queue-timeout", type=float,
+                        default=1.0,
+                        help="max seconds a request may queue before it "
+                             "is shed (503 + Retry-After, never a hang)")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -897,4 +991,13 @@ def options_from_args(args: argparse.Namespace) -> Options:
         engine_retries=args.engine_retries,
         breaker_failure_threshold=args.breaker_failure_threshold,
         breaker_reset_seconds=args.breaker_reset_seconds,
+        admission=args.admission,
+        admission_initial_concurrency=args.admission_initial_concurrency,
+        admission_min_concurrency=args.admission_min_concurrency,
+        admission_max_concurrency=args.admission_max_concurrency,
+        admission_tenant_rate=args.admission_tenant_rate,
+        admission_tenant_burst=args.admission_tenant_burst,
+        admission_tenant_queue_depth=args.admission_tenant_queue_depth,
+        admission_queue_depth=args.admission_queue_depth,
+        admission_queue_timeout=args.admission_queue_timeout,
     )

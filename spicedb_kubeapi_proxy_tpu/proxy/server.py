@@ -126,14 +126,27 @@ class Server:
                     repl_line = f"replication: {detail}"
                 else:
                     reasons.append(("replication", detail))
+            info_lines = [] if repl_line is None else [repl_line]
+            # admission shed/queue state is INFORMATIONAL: shedding is
+            # the overload design working, not unreadiness — pulling a
+            # shedding replica from rotation would dump its share of the
+            # load onto the rest and cascade
+            adm = getattr(self.deps, "admission", None)
+            if adm is not None:
+                st = adm.status()
+                info_lines.append(
+                    f"admission: limit={st['limit']} "
+                    f"inflight={st['inflight']} queued={st['queued']} "
+                    f"shed={st['shed_total']}")
             if reasons:
                 body = "".join(f"[-]{dep}: {reason}\n"
                                for dep, reason in reasons)
                 return ProxyResponse(
                     status=503, headers={"Content-Type": "text/plain"},
                     body=body.encode())
-            body = b"ok" if repl_line is None \
-                else f"[+]{repl_line}\nok".encode()
+            body = b"ok" if not info_lines else (
+                "".join(f"[+]{line}\n" for line in info_lines) + "ok"
+            ).encode()
             return ProxyResponse(status=200, body=body)
         if req.path == "/metrics":
             return ProxyResponse(

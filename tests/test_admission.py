@@ -1,0 +1,884 @@
+"""Admission control subsystem (ISSUE 5): cost classifier, per-tenant
+weighted fair queue, adaptive concurrency limiter, priority load
+shedding, middleware + engine-host wiring, Retry-After behavior, the
+failover interplay, the watch-hub recompute fusing satellite, and
+caveat graceful degradation."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.admission import (
+    BULK_CHECK,
+    CHECK,
+    LOOKUP_PREFILTER,
+    WATCH_RECOMPUTE,
+    WRITE_DTX,
+    AdaptiveLimiter,
+    AdmissionController,
+    AdmissionRejected,
+    classify_op,
+    classify_request,
+)
+from spicedb_kubeapi_proxy_tpu.authz import AuthzDeps, authorize
+from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine, WriteOp
+from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import parse_request_info
+from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest
+from spicedb_kubeapi_proxy_tpu.rules import MapMatcher
+from spicedb_kubeapi_proxy_tpu.rules.input import UserInfo
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+ALL_CLASSES = ("check", "bulk-check", "lookup-prefilter",
+               "watch-recompute", "write-dtx")
+
+
+def shed_counts():
+    return {c: metrics.counter("admission_shed_total",
+                               **{"class": c}).value
+            for c in ALL_CLASSES}
+
+
+def ctrl(limit=1.0, **kw):
+    """A controller with a PINNED limit (min=initial=max) and no debt
+    decay, so scheduling decisions are deterministic."""
+    kw.setdefault("tenant_rate", 0.0)
+    kw.setdefault("tenant_burst", 1e9)
+    kw.setdefault("tenant_depth", 64)
+    kw.setdefault("global_depth", 256)
+    kw.setdefault("queue_timeout", 30.0)
+    return AdmissionController(
+        initial_concurrency=limit, min_concurrency=limit,
+        max_concurrency=limit, **kw)
+
+
+# -- classifier ---------------------------------------------------------------
+
+
+def test_classify_op_and_shed_order():
+    assert classify_op("check_bulk") is CHECK
+    assert classify_op("check_bulk", 8) is BULK_CHECK
+    assert classify_op("lookup_mask") is LOOKUP_PREFILTER
+    assert classify_op("lookup_resources") is LOOKUP_PREFILTER
+    assert classify_op("write_relationships") is WRITE_DTX
+    assert classify_op("delete_relationships") is WRITE_DTX
+    assert classify_op("watch_since") is WATCH_RECOMPUTE
+    # control-plane ops are never gated
+    for op in ("revision", "failover_state", "watch_subscribe",
+               "mirror_subscribe", "object_ids", "exists"):
+        assert classify_op(op) is None
+    # shed order: watch ticks first, then lists, then checks; writes last
+    assert WATCH_RECOMPUTE.priority < LOOKUP_PREFILTER.priority
+    assert LOOKUP_PREFILTER.priority < CHECK.priority
+    assert CHECK.priority == BULK_CHECK.priority
+    assert CHECK.priority < WRITE_DTX.priority
+    # weights scale with device cost
+    assert LOOKUP_PREFILTER.weight > BULK_CHECK.weight > 0
+
+
+def test_classify_request():
+    matcher = MapMatcher.from_yaml(open("deploy/rules.yaml").read())
+
+    def rules_for(verb, path, query=None):
+        from spicedb_kubeapi_proxy_tpu.rules.matcher import RequestMeta
+
+        info = parse_request_info(verb_to_method(verb), path, query or {})
+        return matcher.match(RequestMeta.from_request(info))
+
+    def verb_to_method(verb):
+        return {"create": "POST", "delete": "DELETE"}.get(verb, "GET")
+
+    assert classify_request(
+        "create", rules_for("create", "/api/v1/namespaces")) is WRITE_DTX
+    assert classify_request(
+        "list", rules_for("list", "/api/v1/namespaces")) \
+        is LOOKUP_PREFILTER
+    assert classify_request(
+        "watch", rules_for(
+            "watch", "/api/v1/namespaces", {"watch": ["true"]})) \
+        is WATCH_RECOMPUTE
+    got = classify_request(
+        "get", rules_for("get", "/api/v1/namespaces/x"))
+    assert got in (CHECK, BULK_CHECK)
+
+
+# -- fair queue ---------------------------------------------------------------
+
+
+def test_immediate_admission_tracks_weighted_cost():
+    c = ctrl(limit=8.0)
+    t1 = c.acquire("a", CHECK)
+    t2 = c.acquire("a", LOOKUP_PREFILTER)
+    st = c.status()
+    assert st["inflight"] == 2
+    assert st["inflight_cost"] == 5.0  # 1 + 4
+    t1.release()
+    t2.release()
+    t2.release()  # idempotent: no double credit
+    st = c.status()
+    assert st["inflight"] == 0 and st["inflight_cost"] == 0.0
+
+
+def test_fair_queue_storm_tenant_cannot_starve():
+    async def go():
+        c = ctrl(limit=1.0)
+        hold = await c.acquire_async("warm", CHECK)
+        order = []
+
+        async def waiter(tenant):
+            t = await c.acquire_async(tenant, CHECK)
+            order.append(tenant)
+            t.release()
+
+        # the storm tenant queues 8 requests BEFORE alice/bob queue 3
+        # each: plain FIFO would serve all 8 first
+        tasks = [asyncio.ensure_future(waiter("storm")) for _ in range(8)]
+        await asyncio.sleep(0)
+        tasks += [asyncio.ensure_future(waiter("alice")) for _ in range(3)]
+        tasks += [asyncio.ensure_future(waiter("bob")) for _ in range(3)]
+        await asyncio.sleep(0)
+        assert c.status()["queued"] == 14
+        hold.release()  # begin the drain chain
+        await asyncio.wait_for(asyncio.gather(*tasks), 10)
+        # weighted fair share: alice and bob are served round-robin with
+        # the storm, not behind its whole backlog
+        assert "alice" in order[:6] and "bob" in order[:6]
+        assert order.count("storm") == 8  # nothing lost either
+    asyncio.run(go())
+
+
+def test_priority_shedding_evicts_lowest_class_first():
+    async def go():
+        before = shed_counts()
+        c = ctrl(limit=1.0, global_depth=3, tenant_depth=3)
+        hold = await c.acquire_async("hog", CHECK)
+        results = {}
+
+        async def waiter(name, tenant, cls):
+            try:
+                t = await c.acquire_async(tenant, cls)
+                results[name] = "granted"
+                t.release()
+            except AdmissionRejected as e:
+                results[name] = ("shed", e.retry_after)
+
+        tasks = [asyncio.ensure_future(
+            waiter(f"w{i}", f"wt{i}", WATCH_RECOMPUTE)) for i in range(3)]
+        await asyncio.sleep(0)
+        # queue full of watch recomputes; an arriving WRITE evicts the
+        # NEWEST lowest-priority waiter instead of being rejected
+        tasks.append(asyncio.ensure_future(
+            waiter("write", "writer", WRITE_DTX)))
+        await asyncio.sleep(0.01)
+        assert results.get("w2", ("", 0))[0] == "shed"
+        # an arriving watch tick outranks nothing: IT sheds
+        tasks.append(asyncio.ensure_future(
+            waiter("late-watch", "wtx", WATCH_RECOMPUTE)))
+        await asyncio.sleep(0.01)
+        assert results["late-watch"][0] == "shed"
+        assert results["late-watch"][1] > 0  # Retry-After hint present
+        hold.release()
+        await asyncio.wait_for(asyncio.gather(*tasks), 10)
+        assert results["write"] == "granted"
+        assert results["w0"] == results["w1"] == "granted"
+        after = shed_counts()
+        # every rejection accounted, under its own class
+        assert after["watch-recompute"] - before["watch-recompute"] == 2
+        assert after["write-dtx"] == before["write-dtx"]
+    asyncio.run(go())
+
+
+def test_queue_timeout_sheds_never_hangs():
+    c = ctrl(limit=1.0, queue_timeout=0.05)
+    hold = c.acquire("hog", CHECK)
+    before = shed_counts()
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejected) as ei:
+        c.acquire("victim", CHECK)
+    elapsed = time.monotonic() - t0
+    assert 0.04 <= elapsed < 2.0  # bounded: sheds at the timeout
+    assert ei.value.retry_after > 0
+    assert ei.value.dependency == "admission"
+    after = shed_counts()
+    assert after["check"] - before["check"] == 1
+    hold.release()
+    # capacity freed: the next acquire is immediate
+    c.acquire("victim", CHECK).release()
+
+
+def test_queue_depth_bounds():
+    async def go():
+        c = ctrl(limit=1.0, tenant_depth=2, global_depth=100,
+                 queue_timeout=30.0)
+        hold = await c.acquire_async("t", CHECK)
+        tasks = [asyncio.ensure_future(c.acquire_async("t", CHECK))
+                 for _ in range(2)]
+        await asyncio.sleep(0)
+        # third same-tenant, same-priority arrival overflows ITS queue
+        with pytest.raises(AdmissionRejected):
+            await c.acquire_async("t", CHECK)
+        # ...but another tenant still queues fine
+        other = asyncio.ensure_future(c.acquire_async("u", CHECK))
+        await asyncio.sleep(0)
+        assert c.status()["queued"] == 3
+        hold.release()
+
+        async def finish(fut):
+            (await fut).release()
+
+        # each waiter releases as soon as it is granted — grant order is
+        # the fair queue's business, not the test's
+        await asyncio.wait_for(
+            asyncio.gather(*[finish(f) for f in tasks + [other]]), 10)
+    asyncio.run(go())
+
+
+def test_cancelled_waiter_leaks_nothing():
+    """A handler task cancelled while its acquire is queued (client
+    disconnect) must hand back its queue slot — or, if a grant raced
+    in, the admitted capacity — never wedging the controller."""
+    async def go():
+        c = ctrl(limit=1.0)
+        hold = await c.acquire_async("a", CHECK)
+        # cancelled while QUEUED
+        task = asyncio.ensure_future(c.acquire_async("b", CHECK))
+        await asyncio.sleep(0)
+        assert c.status()["queued"] == 1
+        before = shed_counts()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert c.status()["queued"] == 0
+        # an abandoned wait is not an overload rejection
+        assert shed_counts() == before
+        # cancelled AFTER the grant raced in: the charged capacity must
+        # be handed back by the cancellation path
+        task2 = asyncio.ensure_future(c.acquire_async("b", CHECK))
+        await asyncio.sleep(0)
+        hold.release()  # grants task2's waiter synchronously
+        task2.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task2
+        st = c.status()
+        assert st["inflight"] == 0 and st["inflight_cost"] == 0.0
+        # not wedged: a fresh acquire admits immediately
+        (await c.acquire_async("b", CHECK)).release()
+    asyncio.run(go())
+
+
+def test_cancel_of_blocking_head_drains_fitting_waiters():
+    """Removing a too-heavy queue head (timeout or cancellation) must
+    drain immediately: a lighter request that fits under the limit may
+    not sit until an unrelated release — or shed spuriously at its own
+    timeout — while capacity is free."""
+    async def go():
+        c = ctrl(limit=4.0, queue_timeout=5.0)
+        a = await c.acquire_async("a", BULK_CHECK)  # 2 units
+        b = await c.acquire_async("b", CHECK)  # 3 units total
+        big = asyncio.ensure_future(
+            c.acquire_async("c", LOOKUP_PREFILTER))  # 4: does not fit
+        await asyncio.sleep(0)
+        small = asyncio.ensure_future(
+            c.acquire_async("d", CHECK))  # fits (3+1<=4), behind head
+        await asyncio.sleep(0)
+        assert c.status()["queued"] == 2
+        big.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await big
+        # granted promptly off the cancellation drain — NO release ran
+        t = await asyncio.wait_for(small, 1.0)
+        t.release()
+        a.release()
+        b.release()
+        assert c.status()["inflight"] == 0
+    asyncio.run(go())
+
+
+# -- adaptive limiter ---------------------------------------------------------
+
+
+def test_limiter_grows_when_healthy_and_saturated():
+    lim = AdaptiveLimiter(initial=32, min_limit=4, max_limit=64,
+                          warmup=5, cooldown=2)
+    for _ in range(40):
+        lim.observe(0.010, inflight_cost=lim.limit)  # healthy + full
+    assert lim.limit > 32
+    grown = lim.limit
+    # unsaturated healthy traffic learns nothing
+    for _ in range(40):
+        lim.observe(0.010, inflight_cost=0.0)
+    assert lim.limit == grown
+
+
+def test_limiter_grows_under_heavy_weight_saturation():
+    """Utilization is sampled BEFORE the released weight is handed back:
+    a system saturated purely by weight-4 lookups must still be able to
+    probe headroom (post-decrement sampling could never reach the
+    threshold for heavy classes, ratcheting the limit down only)."""
+    lim = AdaptiveLimiter(initial=8, min_limit=4, max_limit=32,
+                          warmup=5, cooldown=2)
+    c = AdmissionController(tenant_rate=0.0, tenant_burst=1e9,
+                            queue_timeout=5.0, limiter=lim)
+    for _ in range(30):
+        t1 = c.acquire("a", LOOKUP_PREFILTER)
+        t2 = c.acquire("b", LOOKUP_PREFILTER)  # 8 units: saturated
+        t1.release()
+        t2.release()
+    assert lim.limit > 8
+
+
+def test_limiter_backs_off_when_latency_detaches():
+    lim = AdaptiveLimiter(initial=32, min_limit=4, max_limit=64,
+                          warmup=5, cooldown=2)
+    for _ in range(10):
+        lim.observe(0.010, inflight_cost=lim.limit)
+    top = lim.limit
+    for _ in range(60):
+        lim.observe(0.200, inflight_cost=lim.limit)  # 20x the baseline
+    assert lim.limit <= top * 0.5
+    assert lim.limit >= 4  # never below the floor
+
+
+# -- middleware wiring --------------------------------------------------------
+
+DEPLOY_RULES = open("deploy/rules.yaml").read()
+
+
+class WorkflowSpy:
+    """Records dual-write enqueues; a SHED write must never reach it."""
+
+    def __init__(self):
+        self.created = 0
+
+    async def create_instance(self, mode, input):
+        self.created += 1
+        return "iid"
+
+    async def get_result(self, iid, timeout):  # pragma: no cover
+        raise AssertionError("unexpected workflow wait")
+
+
+async def _upstream_200(req):
+    from spicedb_kubeapi_proxy_tpu.proxy.types import json_response
+
+    return json_response(200, {"kind": "NamespaceList", "items": []})
+
+
+def _request(method, path, user="alice", body=None, query=None):
+    import json as _json
+
+    query = query or {}
+    return ProxyRequest(
+        method=method, path=path, query=query,
+        headers={"Content-Type": "application/json"},
+        body=_json.dumps(body).encode() if body is not None else b"",
+        user=UserInfo(name=user),
+        request_info=parse_request_info(method, path, query))
+
+
+def test_shed_write_returns_503_retry_after_and_never_enqueues():
+    async def go():
+        c = ctrl(limit=1.0, queue_timeout=0.05)
+        hold = c.acquire("hog", CHECK)
+        spy = WorkflowSpy()
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                         engine=Engine(), upstream=_upstream_200,
+                         workflow=spy, admission=c)
+        before = shed_counts()
+        m0 = metrics.counter("proxy_dependency_unavailable_total",
+                             dependency="admission").value
+        resp = await authorize(_request(
+            "POST", "/api/v1/namespaces",
+            body={"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "x"}}), deps)
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        # the write was shed BEFORE any durable side effect
+        assert spy.created == 0
+        after = shed_counts()
+        assert after["write-dtx"] - before["write-dtx"] == 1
+        assert metrics.counter("proxy_dependency_unavailable_total",
+                               dependency="admission").value == m0 + 1
+        hold.release()
+    asyncio.run(go())
+
+
+def test_admitted_request_flows_and_releases():
+    async def go():
+        c = ctrl(limit=8.0)
+        e = Engine()
+        e.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:dev#creator@user:alice"))])
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                         engine=e, upstream=_upstream_200, admission=c)
+        resp = await authorize(
+            _request("GET", "/api/v1/namespaces/dev"), deps)
+        assert resp.status == 200
+        assert c.status()["inflight"] == 0  # ticket released
+        # denial also releases
+        resp = await authorize(
+            _request("GET", "/api/v1/namespaces/dev", user="bob"), deps)
+        assert resp.status == 403
+        assert c.status()["inflight"] == 0
+    asyncio.run(go())
+
+
+def test_admission_vs_not_leader_distinguishable_in_metrics():
+    from spicedb_kubeapi_proxy_tpu.engine.remote import NotLeaderError
+
+    class NotLeaderEngine:
+        def check_bulk(self, items, now=None):
+            raise NotLeaderError()
+
+    async def go():
+        # leg 1: an engine mid-failover fails closed as engine-leader
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                         engine=NotLeaderEngine(),
+                         upstream=_upstream_200)
+        leader0 = metrics.counter("proxy_dependency_unavailable_total",
+                                  dependency="engine-leader").value
+        adm0 = metrics.counter("proxy_dependency_unavailable_total",
+                               dependency="admission").value
+        resp = await authorize(
+            _request("GET", "/api/v1/namespaces/dev"), deps)
+        assert resp.status == 503 and "Retry-After" in resp.headers
+        # leg 2: admission sheds the same request shape
+        c = ctrl(limit=1.0, queue_timeout=0.05)
+        hold = c.acquire("hog", CHECK)
+        deps2 = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                          engine=Engine(), upstream=_upstream_200,
+                          admission=c)
+        resp2 = await authorize(
+            _request("GET", "/api/v1/namespaces/dev"), deps2)
+        assert resp2.status == 503 and "Retry-After" in resp2.headers
+        hold.release()
+        # the two Retry-After sources tick SEPARATE dependency labels
+        assert metrics.counter("proxy_dependency_unavailable_total",
+                               dependency="engine-leader").value \
+            == leader0 + 1
+        assert metrics.counter("proxy_dependency_unavailable_total",
+                               dependency="admission").value == adm0 + 1
+    asyncio.run(go())
+
+
+# -- engine-host wiring -------------------------------------------------------
+
+
+def test_engine_server_sheds_and_breaker_stays_closed():
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        EngineServer,
+        RemoteEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.utils.resilience import STATE_CLOSED
+
+    e = Engine()
+    c = AdmissionController(
+        initial_concurrency=1, min_concurrency=1, max_concurrency=1,
+        tenant_rate=0.0, tenant_burst=1e9, queue_timeout=0.05,
+        dependency="engine-admission")
+    hold = c.acquire("hog", CHECK)
+
+    async def go():
+        server = EngineServer(e, admission=c)
+        port = await server.start()
+        remote = RemoteEngine("127.0.0.1", port)
+        try:
+            before = shed_counts()
+            with pytest.raises(AdmissionRejected) as ei:
+                await asyncio.to_thread(remote.check_bulk, [CheckItem(
+                    "namespace", "dev", "view", "user", "alice")])
+            assert ei.value.retry_after > 0
+            assert ei.value.dependency == "engine-admission"
+            # a shed is a healthy host saying "not now", NOT a transport
+            # failure: the client breaker must stay closed
+            assert remote.breaker.state == STATE_CLOSED
+            after = shed_counts()
+            assert after["check"] - before["check"] >= 1
+            # control-plane ops are never gated, even while saturated
+            assert await asyncio.to_thread(
+                remote.failover_state) is not None
+            # capacity freed -> the same op admits
+            hold.release()
+            got = await asyncio.to_thread(remote.check_bulk, [CheckItem(
+                "namespace", "dev", "view", "user", "alice")])
+            assert got == [False]
+        finally:
+            remote.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+def test_role_gate_wins_over_admission_so_shed_writes_never_apply():
+    """Failover interplay: on a non-leader the not_leader rejection must
+    win (it re-aims the client), and on a saturated leader a shed write
+    must leave the store untouched — never acked, never applied."""
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        EngineServer,
+        NotLeaderError,
+        RemoteEngine,
+    )
+
+    e = Engine()
+    c = AdmissionController(
+        initial_concurrency=1, min_concurrency=1, max_concurrency=1,
+        tenant_rate=0.0, tenant_burst=1e9, queue_timeout=0.05,
+        dependency="engine-admission")
+    hold = c.acquire("hog", CHECK)
+    role = {"role": "follower", "term": 3, "revision": 0,
+            "peer_id": 1, "lag": 0}
+
+    async def go():
+        server = EngineServer(e, admission=c,
+                              failover_status=lambda: dict(role))
+        port = await server.start()
+        remote = RemoteEngine("127.0.0.1", port)
+        rel = parse_relationship("namespace:dev#creator@user:alice")
+        try:
+            rev0 = e.revision
+            # follower: not_leader, NOT admission (even while saturated)
+            with pytest.raises(NotLeaderError):
+                await asyncio.to_thread(
+                    remote.write_relationships, [WriteOp("touch", rel)])
+            # leader but saturated: the write sheds pre-dispatch
+            role["role"] = "leader"
+            with pytest.raises(AdmissionRejected):
+                await asyncio.to_thread(
+                    remote.write_relationships, [WriteOp("touch", rel)])
+            assert e.revision == rev0  # nothing applied, nothing acked
+            hold.release()
+            rev = await asyncio.to_thread(
+                remote.write_relationships, [WriteOp("touch", rel)])
+            assert rev > rev0
+        finally:
+            remote.close()
+            await server.stop()
+    asyncio.run(go())
+
+
+# -- readyz surfacing ---------------------------------------------------------
+
+
+def test_readyz_reports_admission_state():
+    from spicedb_kubeapi_proxy_tpu.proxy.server import Server
+
+    async def go():
+        c = ctrl(limit=4.0)
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                         engine=Engine(), upstream=_upstream_200,
+                         admission=c)
+        srv = Server(deps)
+        resp = await srv.handle(_request("GET", "/readyz"))
+        assert resp.status == 200
+        body = resp.body.decode()
+        assert "admission:" in body and "limit=4.0" in body
+        assert "queued=0" in body
+    asyncio.run(go())
+
+
+# -- watch hub: recompute fusing (satellite) ---------------------------------
+
+
+def test_watchhub_groups_fuse_into_batched_dispatches():
+    from spicedb_kubeapi_proxy_tpu.authz.watchhub import WatchHub
+    from spicedb_kubeapi_proxy_tpu.rules.input import ResolveInput
+    from spicedb_kubeapi_proxy_tpu.rules.matcher import RequestMeta
+
+    e = Engine()
+    e.write_relationships([WriteOp("touch", parse_relationship(
+        "namespace:dev#viewer@user:u0"))])
+    matcher = MapMatcher.from_yaml(DEPLOY_RULES)
+    info = parse_request_info("GET", "/api/v1/namespaces",
+                              {"watch": ["true"]})
+    rules = matcher.match(RequestMeta.from_request(info))
+    pf = next(p for r in rules for p in r.pre_filters)
+
+    async def go():
+        hub = WatchHub(e, poll_interval=0.01)
+        handles = []
+        for i in range(6):
+            input = ResolveInput.create(info, UserInfo(name=f"u{i}"))
+            handles.append(await hub.register(pf, input))
+        b0 = metrics.counter("engine_lookup_batches_total").value
+        n0 = metrics.counter("engine_lookups_total").value
+        # ONE write batch triggers all 6 (rule, subject) groups
+        await asyncio.to_thread(e.write_relationships, [WriteOp(
+            "touch",
+            parse_relationship("namespace:dev#viewer@user:u1"))])
+
+        async def drain(h):
+            while True:
+                item = await asyncio.wait_for(h.queue.get(), 10)
+                if item[0] == "allowed":
+                    return
+                assert item[0] != "error", item
+
+        await asyncio.gather(*[drain(h) for h in handles])
+        batches = metrics.counter(
+            "engine_lookup_batches_total").value - b0
+        lookups = metrics.counter("engine_lookups_total").value - n0
+        # 6 group recomputes fused into shared dispatches (VERDICT Weak
+        # #3: pre-fusing this was 6 independent fixpoints). Scheduling
+        # jitter may split the window once or twice, but fusing must cut
+        # the dispatch count at least in half
+        assert lookups == 6
+        assert 1 <= batches <= 3
+        for h in handles:
+            await hub.unregister(h)
+    asyncio.run(go())
+
+
+# -- caveat graceful degradation (satellite) ---------------------------------
+
+
+def test_caveats_parse_tolerantly_and_fail_closed():
+    from spicedb_kubeapi_proxy_tpu.engine.engine import SchemaViolation
+    from spicedb_kubeapi_proxy_tpu.models.bootstrap import parse_bootstrap
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+
+    b = parse_bootstrap("""
+schema: |-
+  caveat on_tuesday(day: string) { day == "tuesday" }
+  definition user {}
+  definition doc {
+    relation viewer: user with on_tuesday and expiration | user
+    permission view = viewer
+  }
+relationships: |-
+  doc:readme#viewer@user:alice
+  doc:readme#viewer@user:bob[on_tuesday]
+  doc:readme#viewer@user:eve[on_tuesday:{"tz": "utc"}]
+""")
+    # caveat declaration + caveated subject types parse (warn-and-ignore)
+    assert "doc" in b.schema.definitions
+    # caveated tuples are EXCLUDED at load: a conditional grant is never
+    # served unconditionally (the reference skips CONDITIONAL lookup
+    # results, pkg/authz/lookups.go:83-90 — here they never enter)
+    assert [str(r) for r in b.relationships] == [
+        "doc:readme#viewer@user:alice"]
+    e = Engine(schema=b.schema)
+    for r in b.relationships:
+        e.write_relationships([WriteOp("touch", r)])
+    assert e.check(CheckItem("doc", "readme", "view", "user", "alice"))
+    assert not e.check(CheckItem("doc", "readme", "view", "user", "bob"))
+    assert e.lookup_resources("doc", "view", "user", "bob") == []
+    # the write path refuses conditional grants outright
+    with pytest.raises(SchemaViolation):
+        e.write_relationships([WriteOp("touch", Relationship(
+            "doc", "x", "viewer", "user", "eve", None, None,
+            "on_tuesday"))])
+
+
+def test_caveat_context_with_nested_brackets_degrades_not_crashes():
+    from spicedb_kubeapi_proxy_tpu.models.bootstrap import parse_bootstrap
+
+    # JSON-array context carries ']' inside the bracket: must still hit
+    # the warn-and-skip path, never a TupleError that aborts the boot
+    r = parse_relationship(
+        'doc:1#viewer@user:a[ip_allowlist:{"ips":["10.0.0.0/8"]}]')
+    assert r.caveat == "ip_allowlist"
+    r2 = parse_relationship(
+        'doc:1#viewer@user:a[c:{"x":[1]}]'
+        '[expiration:2030-01-01T00:00:00Z]')
+    assert r2.caveat == "c" and r2.expiration is not None
+    b = parse_bootstrap("""
+schema: |-
+  caveat ip_allowlist(ips: string) { true }
+  definition user {}
+  definition doc {
+    relation viewer: user
+    permission view = viewer
+  }
+relationships: |-
+  doc:1#viewer@user:ok
+  doc:1#viewer@user:cond[ip_allowlist:{"ips":["10.0.0.0/8"]}]
+""")
+    assert [str(r) for r in b.relationships] == ["doc:1#viewer@user:ok"]
+    # an UNDECLARED bracket trait is far more likely a typo (e.g.
+    # [expiry:...] for [expiration:...]): refuse loudly rather than
+    # silently dropping the grant as a phantom caveat
+    with pytest.raises(ValueError, match="unknown trait"):
+        parse_bootstrap("""
+schema: |-
+  definition user {}
+  definition doc {
+    relation viewer: user
+    permission view = viewer
+  }
+relationships: |-
+  doc:1#viewer@user:oops[expiry:2030-01-01T00:00:00Z]
+""")
+    # same guard at the schema level: a misspelled trait on a relation
+    # is an error, not a phantom caveat
+    from spicedb_kubeapi_proxy_tpu.models.schema import (
+        SchemaError,
+        parse_schema,
+    )
+
+    with pytest.raises(SchemaError, match="unknown trait"):
+        parse_schema("""
+definition user {}
+definition doc { relation viewer: user with expirations }
+""")
+
+
+def test_upstream_wait_not_billed_to_engine_limiter():
+    """The ticket is released before upstream-dominated tails: a slow
+    kube-apiserver must not occupy device budget or feed the limiter."""
+    from spicedb_kubeapi_proxy_tpu.proxy.types import json_response
+
+    async def go():
+        c = ctrl(limit=8.0)
+        e = Engine()
+        e.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:dev#creator@user:alice"))])
+        seen_inflight = []
+
+        async def upstream(req):
+            seen_inflight.append(c.status()["inflight"])
+            return json_response(200, {"kind": "Namespace",
+                                       "metadata": {"name": "dev"}})
+
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                         engine=e, upstream=upstream, admission=c)
+        # GET with checks only (no postchecks in deploy rules): the
+        # ticket must already be released when the upstream runs
+        resp = await authorize(
+            _request("GET", "/api/v1/namespaces/dev"), deps)
+        assert resp.status == 200
+        assert seen_inflight == [0]
+        # LIST rides a prefilter that OVERLAPS the upstream: held there
+        resp = await authorize(
+            _request("GET", "/api/v1/namespaces"), deps)
+        assert resp.status == 200
+        assert seen_inflight[1] == 1
+        assert c.status()["inflight"] == 0  # and released at the end
+    asyncio.run(go())
+
+
+def test_cached_hits_do_not_feed_the_limiter():
+    """A fully-cached verdict dispatched nothing: its (floor-clamped)
+    span must not feed the limiter's baseline, or repeat-heavy cache-hit
+    traffic would pin the baseline at the floor and make ordinary device
+    latency read as congestion."""
+    async def go():
+        c = ctrl(limit=8.0)
+        e = Engine()
+        e.enable_decision_cache()
+        e.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:dev#creator@user:alice"))])
+        deps = AuthzDeps(matcher=MapMatcher.from_yaml(DEPLOY_RULES),
+                         engine=e, upstream=_upstream_200, admission=c)
+        req = lambda: _request("GET", "/api/v1/namespaces/dev")  # noqa: E731
+        assert (await authorize(req(), deps)).status == 200  # miss
+        s1 = c.limiter.snapshot()["samples"]
+        assert s1 >= 1
+        for _ in range(5):
+            assert (await authorize(req(), deps)).status == 200  # hits
+        assert c.limiter.snapshot()["samples"] == s1
+    asyncio.run(go())
+
+
+def test_caveat_tuple_string_round_trip():
+    r = parse_relationship(
+        "doc:readme#viewer@user:bob[c1][expiration:2030-01-01T00:00:00Z]")
+    assert r.caveat == "c1" and r.expiration is not None
+    assert str(r) == \
+        "doc:readme#viewer@user:bob[c1][expiration:2030-01-01T00:00:00Z]"
+    # plain expiration tuples are untouched by the caveat grammar
+    r2 = parse_relationship(
+        "doc:readme#viewer@user:bob[expiration:2030-01-01T00:00:00Z]")
+    assert r2.caveat is None and r2.expiration is not None
+
+
+# -- options ------------------------------------------------------------------
+
+
+def test_options_validate_admission_flags():
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options,
+        OptionsError,
+    )
+
+    def opts(**kw):
+        return Options(rule_content=DEPLOY_RULES, upstream=object(),
+                       admission=True, **kw)
+
+    opts().validate()
+    with pytest.raises(OptionsError):
+        opts(admission_min_concurrency=64.0,
+             admission_initial_concurrency=8.0).validate()
+    with pytest.raises(OptionsError):
+        opts(admission_queue_timeout=0.0).validate()
+    with pytest.raises(OptionsError):
+        opts(admission_queue_depth=0).validate()
+    with pytest.raises(OptionsError):
+        opts(admission_tenant_rate=-1.0).validate()
+
+
+def test_options_complete_wires_admission_into_deps():
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    cfg = Options(rule_content=DEPLOY_RULES, upstream=_upstream_200,
+                  admission=True,
+                  workflow_database_path=":memory:").complete()
+    assert cfg.deps.admission is not None
+    assert cfg.deps.admission.status()["limit"] == 32.0
+    # default off: byte-identical to the pre-admission proxy
+    cfg2 = Options(rule_content=DEPLOY_RULES, upstream=_upstream_200,
+                   workflow_database_path=":memory:").complete()
+    assert cfg2.deps.admission is None
+
+
+# -- concurrency stress: fairness under real threads -------------------------
+
+
+def test_fairness_under_thread_concurrency():
+    """A storm tenant hammering from many threads cannot starve two
+    polite tenants: with capacity 1 and a fair queue, grants interleave
+    by debt, so the polite tenants complete their (small) workloads in
+    bounded time even while the storm keeps the queue full."""
+    c = ctrl(limit=1.0, queue_timeout=5.0)
+    done = {"storm": 0, "alice": 0, "bob": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def storm():
+        while not stop.is_set():
+            try:
+                t = c.acquire("storm", CHECK)
+            except AdmissionRejected:
+                continue
+            time.sleep(0.001)
+            t.release()
+            with lock:
+                done["storm"] += 1
+
+    def polite(name, n=10):
+        for _ in range(n):
+            t = c.acquire(name, CHECK)
+            time.sleep(0.001)
+            t.release()
+            with lock:
+                done[name] += 1
+
+    storms = [threading.Thread(target=storm) for _ in range(6)]
+    for t in storms:
+        t.start()
+    time.sleep(0.05)  # let the storm own the queue first
+    p1 = threading.Thread(target=polite, args=("alice",))
+    p2 = threading.Thread(target=polite, args=("bob",))
+    t0 = time.monotonic()
+    p1.start()
+    p2.start()
+    p1.join(timeout=10)
+    p2.join(timeout=10)
+    elapsed = time.monotonic() - t0
+    stop.set()
+    for t in storms:
+        t.join(timeout=10)
+    assert done["alice"] == 10 and done["bob"] == 10
+    # fair share: ~every third grant went to a polite tenant, so the 10
+    # ops complete in roughly 30 service times, not behind the storm
+    assert elapsed < 5.0

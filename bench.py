@@ -114,6 +114,63 @@ def build_engine(n_pods: int, n_users: int, n_ns: int, n_groups: int,
     return e, total
 
 
+# Per-stage attribution (ISSUE 6): each stage maps to the histogram(s)
+# its code path observes. Phases snapshot before/after and report the
+# delta's p50/p99, so BENCH_*.json rows carry stage breakdowns instead of
+# only end-to-end percentiles.
+_STAGE_HISTOGRAMS = {
+    "admission_wait": ("admission_queue_seconds",),
+    "device": ("engine_check_seconds", "engine_lookup_seconds"),
+    "upstream": ("proxy_upstream_seconds",),
+}
+
+
+def _stage_snapshot() -> dict:
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    out = {}
+    for stage, names in _STAGE_HISTOGRAMS.items():
+        out[stage] = {n: metrics.hist_snapshot(n) for n in names}
+    return out
+
+
+def _record_stage_breakdown(result: dict, key: str, before: dict) -> None:
+    """p50/p99 (ms) + sample count per stage for the window since
+    ``before`` (a ``_stage_snapshot()``), merged across each stage's
+    histograms. Stages with no samples report ``n: 0`` and null
+    percentiles — never Infinity, never a crash (the JSON contract)."""
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import (
+        snapshot_delta_quantile,
+    )
+
+    after = _stage_snapshot()
+    stages = {}
+    for stage, names in _STAGE_HISTOGRAMS.items():
+        n = 0
+        p50 = p99 = None
+        for name in names:
+            b, a = before[stage][name], after[stage][name]
+            if a is None:
+                continue
+            dn = a["n"] - (b["n"] if b else 0)
+            if dn <= 0:
+                continue
+            n += dn
+            q50 = snapshot_delta_quantile(b, a, 0.5)
+            q99 = snapshot_delta_quantile(b, a, 0.99)
+            # multiple histograms per stage: keep the slower series'
+            # percentile (an upper bound; exact merging would need raw
+            # samples the registry deliberately doesn't retain)
+            p50 = q50 * 1e3 if p50 is None else max(p50, q50 * 1e3)
+            p99 = q99 * 1e3 if p99 is None else max(p99, q99 * 1e3)
+        stages[stage] = {
+            "n": n,
+            "p50_ms": None if p50 is None else round(p50, 3),
+            "p99_ms": None if p99 is None else round(p99, 3),
+        }
+    result[key] = stages
+
+
 def _dispatch_floor_ms(trials: int = 12) -> float:
     """Wall p50 of a no-op jitted dispatch+readback — the transport floor
     below which no synchronous device query can go (one tunnel RTT on
@@ -540,6 +597,10 @@ def _measure(args, result: dict) -> None:
     mask, _ = e.lookup_resources_mask("pod", "view", "user", subjects[0])
     log(f"warmup (jit compile + run): {time.perf_counter() - t0:.1f}s; "
         f"visible={int(mask.sum())}/{n_pods}")
+    # per-stage attribution window: everything from here through the
+    # repeat-traffic section lands in result["stages"] (p50/p99 per
+    # stage from the span-backed histograms, warmup excluded)
+    stage0 = _stage_snapshot()
     profiling = False
     if args.profile_dir:
         # device timeline for the measured queries (the fixpoint dispatch
@@ -808,6 +869,8 @@ def _measure(args, result: dict) -> None:
         log(f"repeat-traffic section failed (non-fatal): {ex}")
     finally:
         e.disable_decision_cache()
+
+    _record_stage_breakdown(result, "stages", stage0)
 
     # -- restart recovery: WAL replay throughput + time-to-ready --
     # Simulated crash (the --data-dir durability story, persistence/):
@@ -1307,7 +1370,9 @@ definition namespace {
         tenant_rate=fair_share / 4, tenant_burst=unit_cap * 2,
         tenant_depth=32, global_depth=128,
         queue_timeout=max(0.05, slo * 0.5))
+    stage0 = _stage_snapshot()
     stats_on, lat_on, shed_waits, ra_missing, wall_on = run(ctrl, dur)
+    _record_stage_breakdown(result, "admission_stages", stage0)
     good_on, p99_on, fair_on, shed_on, offered_on = summarize(
         "ON", stats_on, lat_on, wall_on)
     shed_after = sum(

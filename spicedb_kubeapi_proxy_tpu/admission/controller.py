@@ -311,6 +311,13 @@ class AdmissionController:
                 self._decay(t, now)
                 if self._queued == 0 and self._fits(cls):
                     self._admit_locked(t, cls)
+                    # zero-wait admits observe too: the queue-wait
+                    # distribution must cover EVERY admitted request or
+                    # its p50 reads as "everyone queued" the moment one
+                    # request does (bench per-stage admission-wait)
+                    metrics.histogram(
+                        "admission_queue_seconds",
+                        dependency=self.dependency).observe(0.0)
                     return None
                 if len(t.queue) >= self.tenant_depth \
                         or self._queued >= self.global_depth:

@@ -23,12 +23,14 @@ Mirrors /root/reference/pkg/authz/authz.go:23-194 (WithAuthorization):
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..dtx.runner import ActivityError, WorkflowEngine, WorkflowTimeout
 from ..dtx.workflow import KubeResp, LOCK_MODE_PESSIMISTIC
 from ..engine import Engine
+from ..obs.trace import tracer
 from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
 from ..utils.metrics import metrics
 from ..utils.resilience import DependencyUnavailable
@@ -71,6 +73,61 @@ class AuthzDeps:
     # request acquires a cost-classed, per-tenant fair-queue slot before
     # the check phase; None = unguarded (today's behavior)
     admission: Optional[object] = None
+    # decision audit log (obs/audit.py AuditLog): one JSON line per
+    # authorization verdict — denies always, allows rate-capped;
+    # None = no audit (today's behavior)
+    audit: Optional[object] = None
+
+
+def _audit(deps: AuthzDeps, info, user, *, allow: bool,
+           rules=None, reason: str = "",
+           cache_hit: Optional[bool] = None) -> None:
+    """Write one decision line when auditing is on; never raises into
+    the authorization chain (a broken audit sink must not deny or allow
+    anything)."""
+    a = deps.audit
+    if a is None:
+        return
+    rev = getattr(getattr(deps.engine, "store", None), "revision", None)
+    try:
+        a.decision(
+            allow=allow,
+            verb=info.verb,
+            resource=info.resource,
+            subresource=info.subresource,
+            namespace=info.namespace,
+            name=info.name,
+            subject=(user.name if user else ""),
+            groups=(user.groups if user else None),
+            rule=(",".join(r.name for r in rules) if rules else None),
+            reason=reason,
+            cache_hit=cache_hit,
+            revision=rev if isinstance(rev, int) else None,
+            trace_id=tracer.current_trace_id(),
+            stages_us=tracer.stage_micros(),
+        )
+    except Exception:  # noqa: BLE001 - audit must never break serving
+        metrics.counter("audit_write_errors_total").inc()
+
+
+async def _traced_upstream(deps: AuthzDeps, req: ProxyRequest
+                           ) -> ProxyResponse:
+    """The ONE upstream call site wrapper: times the kube-apiserver RTT
+    as a named child span + histogram and forwards the trace context as
+    a W3C ``traceparent`` header so the upstream's own telemetry can
+    stitch to ours."""
+    t0 = time.perf_counter()
+    with tracer.span("upstream") as sp:
+        tp = sp.traceparent()
+        if tp is not None:
+            req.headers = {k: v for k, v in req.headers.items()
+                           if k.lower() != "traceparent"}
+            req.headers["traceparent"] = tp
+        resp = await deps.upstream(req)
+        sp.set("status", resp.status)
+    metrics.histogram("proxy_upstream_seconds").observe(
+        time.perf_counter() - t0)
+    return resp
 
 
 def _always_allowed(req: ProxyRequest) -> bool:
@@ -99,6 +156,16 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
     try:
         return await _authorize_inner(req, deps)
     except DependencyUnavailable as e:
+        from ..admission import AdmissionRejected
+
+        # tail sampling always keeps these: a shed (the admission design
+        # working) is flagged "shed" — and ONLY shed, so error-trace
+        # filters see real failures — while every other fail-closed 503
+        # (breaker open, deadline, leaderless engine) flags "error"
+        if isinstance(e, AdmissionRejected):
+            tracer.flag("shed")
+        else:
+            tracer.flag("error", str(e))
         metrics.counter("proxy_dependency_unavailable_total",
                         dependency=e.dependency).inc()
         resp = kube_status(
@@ -120,24 +187,33 @@ async def _authorize_inner(req: ProxyRequest,
     if _always_allowed(req):
         if deps.discovery_cache is not None:
             return await deps.discovery_cache.serve(req, deps.upstream)
-        return await deps.upstream(req)
+        return await _traced_upstream(deps, req)
 
     input = ResolveInput.create(info, user, body=req.body or None,
                                 headers=req.headers)
 
-    rules = deps.matcher.match(RequestMeta.from_request(info))
-    if not rules:
-        return kube_status(
-            403, f"user {user.name!r} cannot {info.verb} {info.resource}",
-            "Forbidden")
-    try:
-        rules = [r for r in rules if r.conditions_pass(input)]
-    except ExprError as e:
-        return kube_status(500, f"evaluating rule conditions: {e}")
-    if not rules:
-        return kube_status(
-            403, f"user {user.name!r} cannot {info.verb} {info.resource}",
-            "Forbidden")
+    with tracer.span("rule_match") as sp:
+        rules = deps.matcher.match(RequestMeta.from_request(info))
+        if not rules:
+            sp.set("matched", 0)
+            _audit(deps, info, user, allow=False,
+                   reason="no rule matches the request")
+            return kube_status(
+                403,
+                f"user {user.name!r} cannot {info.verb} {info.resource}",
+                "Forbidden")
+        try:
+            rules = [r for r in rules if r.conditions_pass(input)]
+        except ExprError as e:
+            return kube_status(500, f"evaluating rule conditions: {e}")
+        sp.set("matched", len(rules))
+        if not rules:
+            _audit(deps, info, user, allow=False,
+                   reason="every matching rule's conditions filtered out")
+            return kube_status(
+                403,
+                f"user {user.name!r} cannot {info.verb} {info.resource}",
+                "Forbidden")
 
     # -- admission control (admission/): the request is about to touch the
     # engine — acquire a cost-classed slot under the caller's tenant
@@ -150,9 +226,11 @@ async def _authorize_inner(req: ProxyRequest,
         return await _authorized(req, deps, info, user, input, rules)
     from ..admission import classify_request
 
-    ticket = await deps.admission.acquire_async(
-        user.name or "system:anonymous",
-        classify_request(info.verb, rules))
+    with tracer.span("admission_wait") as sp:
+        cls = classify_request(info.verb, rules)
+        sp.set("class", cls.name)
+        ticket = await deps.admission.acquire_async(
+            user.name or "system:anonymous", cls)
     try:
         return await _authorized(req, deps, info, user, input, rules,
                                  ticket)
@@ -188,21 +266,31 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         # loop free while the device query's readback is in flight
         # (concurrent requests pipeline their dispatches; the reference
         # fans checks out over goroutines, check.go:77-93)
-        items, verdict = cached_verdict(deps.engine, rules, input)
+        with tracer.span("cache_probe") as sp:
+            items, verdict = cached_verdict(deps.engine, rules, input)
+            sp.set("hit", verdict is not None)
         # a fully-cached verdict means this span dispatched NOTHING: its
         # (floor-clamped) duration must not feed the limiter's baseline,
         # or repeat-heavy cache-hit traffic would pin the baseline at the
         # floor and make ordinary device latency read as congestion
         engine_sampled = verdict is None
         if verdict is None:
-            verdict = await asyncio.to_thread(
-                run_checks, deps.engine, rules, input, items=items)
+            with tracer.span("engine_dispatch", items=len(items)):
+                verdict = await asyncio.to_thread(
+                    run_checks, deps.engine, rules, input, items=items)
         if not verdict:
+            _audit(deps, info, user, allow=False, rules=rules,
+                   reason="check denied", cache_hit=not engine_sampled)
             return kube_status(
                 403,
                 f"user {user.name!r} is not permitted to {info.verb} "
                 f"{info.resource} {input.namespaced_name}",
                 "Forbidden")
+        if not (info.verb == "get" and any(r.post_checks for r in rules)):
+            # gets with postchecks aren't decided yet — their audit line
+            # is written after the post-upstream checks below
+            _audit(deps, info, user, allow=True, rules=rules,
+                   reason="checks passed", cache_hit=not engine_sampled)
     except ExprError as e:
         return kube_status(500, f"resolving checks: {e}")
 
@@ -230,7 +318,7 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         if ticket is not None:
             # plain proxied write: no engine work left
             ticket.release(observe=engine_sampled)
-        return await deps.upstream(req)
+        return await _traced_upstream(deps, req)
 
     # -- watch ----------------------------------------------------------------
     try:
@@ -243,18 +331,22 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
             if ticket is not None:
                 # plain proxied watch: checks are done
                 ticket.release(observe=engine_sampled)
-            return await deps.upstream(req)
+            return await _traced_upstream(deps, req)
         if deps.watch_hub is None:
             from .watchhub import WatchHub
 
             deps.watch_hub = WatchHub(
                 deps.engine, poll_interval=deps.watch_poll_interval)
         try:
-            upstream_resp = await deps.upstream(req)
-            return await filtered_watch(
-                deps.engine, upstream_resp, pf[1], input,
-                poll_interval=deps.watch_poll_interval,
-                hub=deps.watch_hub)
+            upstream_resp = await _traced_upstream(deps, req)
+            with tracer.span("watch_join"):
+                # establishment only: the trace covers joining the hub
+                # and computing the initial allowed set, never the
+                # long-lived stream itself
+                return await filtered_watch(
+                    deps.engine, upstream_resp, pf[1], input,
+                    poll_interval=deps.watch_poll_interval,
+                    hub=deps.watch_hub)
         except (PreFilterError, ExprError) as e:
             return kube_status(500, f"watch prefilter: {e}")
 
@@ -268,8 +360,14 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
                       and any(r.post_checks for r in rules))
     prefilter_task = None
     if pf is not None:
-        prefilter_task = asyncio.ensure_future(
-            run_prefilter(deps.engine, pf[1], input))
+        async def _traced_prefilter():
+            # ensure_future copies the contextvar context, so the span
+            # lands on this request's trace even though the prefilter
+            # runs concurrently with the upstream round trip
+            with tracer.span("prefilter"):
+                return await run_prefilter(deps.engine, pf[1], input)
+
+        prefilter_task = asyncio.ensure_future(_traced_prefilter())
     if ticket is not None and prefilter_task is None \
             and not run_postfilter and not run_postchecks:
         # nothing engine-bound overlaps or follows the upstream call:
@@ -291,7 +389,7 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         req.headers["Accept"] = rewrite_accept(accept, watching=False,
                                                json_only=True)
     try:
-        resp = await deps.upstream(req)
+        resp = await _traced_upstream(deps, req)
     except Exception:
         if prefilter_task:
             prefilter_task.cancel()
@@ -308,20 +406,36 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         resp = apply_filter(resp, allowed, input)
     if run_postfilter:
         try:
-            resp = await asyncio.to_thread(
-                filter_list_response, deps.engine, post_filters, input, resp)
+            with tracer.span("postfilter"):
+                resp = await asyncio.to_thread(
+                    filter_list_response, deps.engine, post_filters,
+                    input, resp)
         except ExprError as e:
             return kube_status(401, f"postfilter: {e}")
 
     # -- postchecks (get only; reference shouldRunPostChecks authz.go:211-220)
+    if run_postchecks and resp.status >= 300:
+        # the deferred audit line (checks passed, allow withheld above)
+        # must still be written: the subject WAS allowed through to the
+        # upstream, whose error skips the postchecks entirely
+        _audit(deps, info, user, allow=True, rules=rules,
+               reason=f"checks passed (upstream {resp.status}, "
+                      "postchecks skipped)")
     if run_postchecks and resp.status < 300:
         try:
-            post_items, post_verdict = cached_verdict(
-                deps.engine, rules, input, post=True)
-            if post_verdict is None:
-                post_verdict = await asyncio.to_thread(
-                    run_checks, deps.engine, rules, input, post=True,
-                    items=post_items)
+            with tracer.span("postcheck"):
+                post_items, post_verdict = cached_verdict(
+                    deps.engine, rules, input, post=True)
+                post_cached = post_verdict is not None
+                if post_verdict is None:
+                    post_verdict = await asyncio.to_thread(
+                        run_checks, deps.engine, rules, input, post=True,
+                        items=post_items)
+            _audit(deps, info, user, allow=bool(post_verdict),
+                   rules=rules,
+                   reason=("postchecks passed" if post_verdict
+                           else "postcheck denied"),
+                   cache_hit=post_cached)
             if not post_verdict:
                 return kube_status(
                     403,
@@ -344,14 +458,15 @@ async def _dual_write(req: ProxyRequest, deps: AuthzDeps, rule,
     except (UpdateError, ExprError) as e:
         return kube_status(500, f"resolving update: {e}")
     mode = rule.locking or deps.default_lock_mode
-    iid = await deps.workflow.create_instance(mode, wf_input.to_dict())
-    try:
-        out = await deps.workflow.get_result(
-            iid, timeout=WORKFLOW_RESULT_TIMEOUT)
-    except WorkflowTimeout:
-        return kube_status(504, "dual-write timed out")
-    except ActivityError as e:
-        return kube_status(502, f"dual-write failed: {e}")
+    with tracer.span("dual_write", mode=mode):
+        iid = await deps.workflow.create_instance(mode, wf_input.to_dict())
+        try:
+            out = await deps.workflow.get_result(
+                iid, timeout=WORKFLOW_RESULT_TIMEOUT)
+        except WorkflowTimeout:
+            return kube_status(504, "dual-write timed out")
+        except ActivityError as e:
+            return kube_status(502, f"dual-write failed: {e}")
     resp = KubeResp.from_activity(out)
     headers = dict(resp.headers)
     headers["Content-Length"] = str(len(resp.body))

@@ -437,9 +437,14 @@ class WatchHub:
         return lookup
 
     async def _recompute(self, group: _Group) -> None:
+        import time as _time
+
+        from ..utils.metrics import metrics
+
         try:
             while True:
                 start_seq = group.seq
+                t0 = _time.perf_counter()
                 try:
                     fresh = await run_prefilter(
                         self.engine, group.pf, group.input, strict=False,
@@ -450,6 +455,12 @@ class WatchHub:
                     for w in list(group.watchers):
                         w.queue.put_nowait(("error", e))
                     return
+                # per-group recompute latency: the watch path's engine
+                # stage (there is no request trace to span — recomputes
+                # are write-triggered background work fanned out to
+                # every watcher of the group)
+                metrics.histogram("watchhub_recompute_seconds").observe(
+                    _time.perf_counter() - t0)
                 group.last_recompute = asyncio.get_running_loop().time()
                 for w in list(group.watchers):
                     w.queue.put_nowait(("allowed", fresh, start_seq))

@@ -21,6 +21,8 @@ import numpy as np
 from ..models.bootstrap import Bootstrap, DEFAULT_BOOTSTRAP, parse_bootstrap
 from ..models.schema import Schema
 from ..models.tuples import Relationship
+from ..obs.profile import install_jax_compile_hook
+from ..obs.trace import tracer
 from ..ops.reachability import (
     CompiledGraph,
     MAX_DELTA_RECORDS,
@@ -136,6 +138,10 @@ class Engine:
         # through a ShardedGraph pinned across it instead of one device
         self.mesh = mesh
         self._sharded = None
+        # XLA compilation is the engine's biggest latency cliff and the
+        # one event it cannot time itself; the jax monitoring listener
+        # mirrors compile events into the metrics registry (obs/profile)
+        install_jax_compile_hook()
         if seed:
             self.write_relationships([WriteOp("touch", r) for r in seed])
 
@@ -314,6 +320,8 @@ class Engine:
                 inc = self._try_incremental(cur)
                 if inc is not None:
                     self._compiled = inc
+                    metrics.gauge("engine_csr_nnz").set(inc.n_edges)
+                    metrics.gauge("engine_graph_slots").set(inc.M)
                     return inc
             if self._compiled is None or \
                self._compiled.revision != self.store.revision:
@@ -322,6 +330,14 @@ class Engine:
                 metrics.counter("engine_graph_compiles_total").inc()
                 metrics.histogram("engine_graph_compile_seconds").observe(
                     time.perf_counter() - t0)
+                # TrieJax-style kernel accounting: the compiled graph's
+                # shape gauges let a scrape correlate latency with graph
+                # scale (CSR nnz = adjacency edges, M = slot space).
+                # Set only when the graph CHANGED — compiled() itself is
+                # per-dispatch hot path (the incremental branch above
+                # sets them on its own updates)
+                metrics.gauge("engine_csr_nnz").set(self._compiled.n_edges)
+                metrics.gauge("engine_graph_slots").set(self._compiled.M)
             return self._compiled
 
     def _try_incremental(self, cur: CompiledGraph) -> Optional[CompiledGraph]:
@@ -564,6 +580,13 @@ class Engine:
                 cg, objs, items[s:s + chunk])
             futs.append(backend.query_async(seeds, q_slots, q_batch, now=now))
         metrics.counter("engine_checks_total").inc(n)
+        metrics.histogram(
+            "engine_dispatch_batch_rows",
+            buckets=(1, 2, 4, 8, 16, 64, 256, 1024, 4096, 16384, 65536),
+        ).observe(n)
+        # leaf span (finished by fin, possibly on another thread): the
+        # device-side share of a check when a trace is active
+        dev_span = tracer.begin("device", kind="check", rows=n)
 
         def iters():
             return max(f.iterations() for f in futs)
@@ -575,7 +598,11 @@ class Engine:
             # dispatch+device+readback as before the chunked pipeline
             metrics.histogram("engine_check_seconds").observe(
                 time.perf_counter() - t0)
-            metrics.histogram("engine_fixpoint_iterations").observe(iters())
+            it = iters()
+            metrics.histogram("engine_fixpoint_iterations").observe(it)
+            if dev_span is not None:
+                dev_span.set("fixpoint_iters", it)
+                dev_span.finish()
             return out
 
         return EngineFuture(None, fin, iters=iters)
@@ -738,18 +765,36 @@ class Engine:
             seeds, q_slots, q_batch, now=now,
             q_cache_key=("lookup", off, n), q_contiguous=True)
         metrics.counter("engine_lookups_total").inc()
+        metrics.histogram(
+            "engine_dispatch_batch_rows",
+            buckets=(1, 2, 4, 8, 16, 64, 256, 1024, 4096, 16384, 65536),
+        ).observe(n)
+        dev_span = tracer.begin("device", kind="lookup", rows=n)
 
         def fin(out):
             metrics.histogram("engine_lookup_seconds").observe(
                 time.perf_counter() - t0)
-            metrics.histogram("engine_fixpoint_iterations").observe(
-                fut.iterations())
+            it = fut.iterations()
+            metrics.histogram("engine_fixpoint_iterations").observe(it)
             # QueryFuture.result() already materialized a fresh host
             # array; only copy again if it came back read-only
             m = np.asarray(out)
             if not m.flags.writeable:
                 m = m.copy()
-            return mask_pseudo_objects(m), interner
+            m = mask_pseudo_objects(m)
+            # final-frontier occupancy: how much of the queried slot
+            # window the reachable set filled (TrieJax-style frontier
+            # accounting, host-side off the readback — no device cost)
+            occ = int(m.sum())
+            metrics.histogram(
+                "engine_frontier_occupancy",
+                buckets=(0, 1, 8, 64, 512, 4096, 32768, 262144, 2**21),
+            ).observe(occ)
+            if dev_span is not None:
+                dev_span.set("fixpoint_iters", it)
+                dev_span.set("frontier_occupancy", occ)
+                dev_span.finish()
+            return m, interner
 
         return EngineFuture(fut, fin)
 

@@ -51,6 +51,7 @@ from functools import partial
 from typing import Optional
 
 from ..admission import AdmissionRejected, classify_op
+from ..obs.trace import tracer
 from ..utils.failpoints import FailPointError, failpoints
 from ..utils.metrics import metrics
 from ..utils.net import drain_server
@@ -133,7 +134,7 @@ _ERROR_KINDS = {
 _IDEMPOTENT_OPS = frozenset({
     "check_bulk", "lookup_resources", "lookup_mask", "object_ids",
     "revision", "exists", "watch_since", "watch_gate",
-    "read_relationships",
+    "read_relationships", "traces",
 })
 
 # "the transport failed" (vs the engine answering with an error): socket
@@ -392,13 +393,28 @@ class EngineServer:
                 str(req.get("token") or ""), self.token):
             return {"ok": False, "kind": "auth", "error": "invalid token"}
         op = req.get("op")
+        # trace stitching: the proxy forwards its span context as the
+        # "tr" frame field (a W3C traceparent); engine-host spans (queue
+        # wait, device dispatch, replication ack wait) attach under it —
+        # into the SAME live trace when proxy and host share a process,
+        # as a same-trace_id satellite fragment across processes
+        with tracer.adopt(req.get("tr"), f"engine_host.{op}",
+                          endpoint=f"{self.host}:{self.port}",
+                          tenant=tenant):
+            return await self._dispatch_traced(req, op, tenant)
+
+    async def _dispatch_traced(self, req: dict, op, tenant: str) -> dict:
         ticket = None
         try:
             fn = getattr(self, f"_op_{op}", None)
             if fn is None:
                 return {"ok": False, "kind": "proto",
                         "error": f"unknown op {op!r}"}
-            if self.failover_status is not None and op != "failover_state":
+            if self.failover_status is not None \
+                    and op not in ("failover_state", "traces"):
+                # traces is diagnostics like failover_state: an operator
+                # following a trace through a follower (or a deposed
+                # leader) must be able to read its fragments
                 st = self.failover_status()
                 if st.get("role") != "leader":
                     # fail CLOSED, never stale: a follower's store trails
@@ -437,7 +453,21 @@ class EngineServer:
                     # the PEER ADDRESS only — a wire-level override would
                     # let any token holder mint fresh zero-debt tenants
                     # per request and defeat the fair queue entirely
-                    ticket = await self.admission.acquire_async(tenant, cls)
+                    with tracer.span("engine_queue_wait",
+                                     **{"class": cls.name}):
+                        ticket = await self.admission.acquire_async(
+                            tenant, cls)
+            captured = tracer.capture()
+            if captured is not None:
+                # run_in_executor does NOT copy contextvars: re-enter the
+                # trace inside the worker so the device span (and any
+                # replication-ack-wait span under it) stitches correctly
+                inner = fn
+
+                def fn(r, _inner=inner, _cap=captured):  # noqa: F811
+                    with tracer.activate(_cap), \
+                            tracer.span("engine_device", op=op):
+                        return _inner(r)
             result = await self._in_worker(fn, req)
             if isinstance(result, BinaryResult):
                 return result
@@ -719,6 +749,13 @@ class EngineServer:
     def _op_exists(self, req: dict):
         return self.engine.store.exists(_filter_from_dict(req["filter"]))
 
+    def _op_traces(self, req: dict):
+        """This host's recent kept-trace ring (diagnostics, never
+        role-gated): cross-process deployments fetch their engine-side
+        fragments through here — the proxy's /debug/traces merges them
+        into its own traces by trace_id."""
+        return tracer.recent(int(req.get("limit", 64)))
+
 
 # -- client ------------------------------------------------------------------
 
@@ -943,6 +980,14 @@ class RemoteEngine:
         msg = {"op": op, **args}
         if self.token:
             msg["token"] = self.token
+        # span context rides the frame as a "tr" field (W3C traceparent)
+        # so the engine host's spans stitch into this request's trace;
+        # the rpc span brackets every attempt of this logical call —
+        # under failover each endpoint tried appears as its own span
+        rpc_span = tracer.begin("engine_rpc", op=op,
+                                endpoint=self.dependency)
+        if rpc_span is not None:
+            msg["tr"] = rpc_span.traceparent()
         payload = _pack(msg)
         attempts = (self.retries + 1) if op in _IDEMPOTENT_OPS else 1
         delays = self.retry_policy.delays()
@@ -952,51 +997,62 @@ class RemoteEngine:
         # self.timeout total is the bound either way (per-attempt socket
         # budgets are derived from what remains)
         deadline = Deadline.after(self.timeout)
-        while True:
-            attempts -= 1
-            self.breaker.allow()
-            start = time.monotonic()
-            try:
-                resp = self._transact(payload, deadline)
-            except TRANSPORT_ERRORS:
-                self.breaker.record_failure()
-                deadline.check(self.dependency)
-                if attempts <= 0:
-                    raise
-                metrics.counter("proxy_dependency_retries_total",
-                                dependency=self.dependency).inc()
-                time.sleep(min(next(delays), deadline.remaining()))
-                continue
-            except BaseException:
-                # non-transport outcome (protocol/frame error, pre-auth
-                # rejection raised as an error kind): no verdict on the
-                # transport, but the admitted half-open probe slot must
-                # not leak or the breaker wedges open forever
-                self.breaker.release()
-                raise
-            self.breaker.record_success()
-            metrics.histogram("proxy_dependency_seconds",
-                              dependency=self.dependency).observe(
-                time.monotonic() - start)
-            if isinstance(resp, tuple):
-                return resp  # (meta, payload) binary response
-            if resp.get("ok"):
-                return resp.get("result")
-            kind = resp.get("kind", "internal")
-            err = resp.get("error", "")
-            if kind == "admission":
-                # engine-host load shed: pre-dispatch by construction, so
-                # even writes are safe to retry after Retry-After. Its own
-                # dependency label keeps it distinguishable from proxy-
-                # side admission and from not_leader in the 503 metrics.
+        try:
+            while True:
+                attempts -= 1
+                self.breaker.allow()
+                start = time.monotonic()
                 try:
-                    retry_after = float(resp.get("retry_after") or 1.0)
-                except (TypeError, ValueError):
-                    retry_after = 1.0
-                raise AdmissionRejected(
-                    str(resp.get("class") or "?"), err,
-                    retry_after=retry_after, dependency="engine-admission")
-            raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
+                    resp = self._transact(payload, deadline)
+                except TRANSPORT_ERRORS:
+                    self.breaker.record_failure()
+                    deadline.check(self.dependency)
+                    if attempts <= 0:
+                        raise
+                    metrics.counter("proxy_dependency_retries_total",
+                                    dependency=self.dependency).inc()
+                    time.sleep(min(next(delays), deadline.remaining()))
+                    continue
+                except BaseException:
+                    # non-transport outcome (protocol/frame error,
+                    # pre-auth rejection raised as an error kind): no
+                    # verdict on the transport, but the admitted
+                    # half-open probe slot must not leak or the breaker
+                    # wedges open forever
+                    self.breaker.release()
+                    raise
+                self.breaker.record_success()
+                metrics.histogram("proxy_dependency_seconds",
+                                  dependency=self.dependency).observe(
+                    time.monotonic() - start)
+                if isinstance(resp, tuple):
+                    return resp  # (meta, payload) binary response
+                if resp.get("ok"):
+                    return resp.get("result")
+                kind = resp.get("kind", "internal")
+                err = resp.get("error", "")
+                if kind == "admission":
+                    # engine-host load shed: pre-dispatch by
+                    # construction, so even writes are safe to retry
+                    # after Retry-After. Its own dependency label keeps
+                    # it distinguishable from proxy-side admission and
+                    # from not_leader in the 503 metrics.
+                    try:
+                        retry_after = float(resp.get("retry_after") or 1.0)
+                    except (TypeError, ValueError):
+                        retry_after = 1.0
+                    raise AdmissionRejected(
+                        str(resp.get("class") or "?"), err,
+                        retry_after=retry_after,
+                        dependency="engine-admission")
+                raise _ERROR_KINDS.get(kind, RemoteEngineError)(err)
+        except BaseException as e:
+            if rpc_span is not None:
+                rpc_span.set("error", repr(e))
+            raise
+        finally:
+            if rpc_span is not None:
+                rpc_span.finish()
 
     def _transact(self, payload: bytes,
                   deadline: Optional[Deadline] = None):
@@ -1186,6 +1242,15 @@ class RemoteEngine:
         retry set: resolution probes must answer fast about dead hosts,
         not burn a retry budget against them)."""
         return self._call("failover_state")
+
+    def fetch_traces(self, limit: int = 64) -> list:
+        """The engine host's recent kept-trace ring (trace fragments
+        sharing the proxy's trace_ids); [] against hosts predating the
+        op — trace retrieval is diagnostics, never an error."""
+        try:
+            return self._call("traces", limit=limit) or []
+        except RemoteEngineError:
+            return []
 
 
 # -- client-side engine failover ----------------------------------------------
@@ -1447,6 +1512,18 @@ class FailoverEngine:
     def watch_gate(self, resource_type: str, name: str):
         return self._invoke(lambda c: c.watch_gate(resource_type, name))
 
+    def fetch_traces(self, limit: int = 64) -> list:
+        """Trace fragments from EVERY reachable endpoint (a re-aimed
+        request leaves spans on more than one host); per-endpoint
+        failures contribute nothing rather than failing diagnostics."""
+        out: list = []
+        for c in self._clients:
+            try:
+                out.extend(c.fetch_traces(limit))
+            except Exception:  # noqa: BLE001 - diagnostics best-effort
+                continue
+        return out
+
     @property
     def revision(self) -> int:
         return self._invoke(lambda c: c.revision)
@@ -1634,8 +1711,21 @@ def main(argv=None) -> int:
     ap.add_argument("--admission-queue-timeout", type=float, default=1.0,
                     help="max seconds a request may queue before it is "
                          "shed (503 + Retry-After, never a hang)")
+    ap.add_argument("--trace-sample", type=float, default=0.1,
+                    help="tail-sampling keep probability for engine-host "
+                         "trace fragments (error/slow ops always kept; "
+                         "0 disables span recording entirely). Proxies "
+                         "forward their trace context on the wire; "
+                         "fragments share the proxy's trace_id")
+    ap.add_argument("--trace-slow-ms", type=float, default=250.0,
+                    help="ops at or above this duration are always kept "
+                         "by tail sampling")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if not 0.0 <= args.trace_sample <= 1.0:
+        ap.error("--trace-sample must be in [0, 1]")
+    tracer.configure(sample=args.trace_sample,
+                     slow_ms=args.trace_slow_ms)
 
     from ..utils.tlsconf import (
         TLSConfigError,

@@ -26,6 +26,7 @@ import numpy as np
 
 from .. import native
 from ..models.tuples import Relationship
+from ..utils.metrics import metrics
 from .interning import Interner
 
 # Operation codes (watch log + write ops)
@@ -410,6 +411,14 @@ class Store:
 
     # -- public API --------------------------------------------------------
 
+    def _observe_revision(self) -> None:
+        """Observability gauges, refreshed by EVERY revision-advancing
+        mutation (write, delete, bulk load, state install/restore):
+        revision for cache-key/trace correlation, watch-log depth for
+        follower catch-up headroom."""
+        metrics.gauge("store_revision").set(self.revision)
+        metrics.gauge("store_watch_log_records").set(len(self._watch_log))
+
     def write(self, ops: list[WriteOp],
               preconditions: list[Precondition] = ()) -> int:
         """Apply a write transaction; returns the new revision.
@@ -496,6 +505,7 @@ class Store:
                     self._has_finite_exp = True
             self._trim_watch_log()
             self.revision = rev
+            self._observe_revision()
             if self.journal is not None:
                 self.journal({"kind": "write", "rev": rev,
                               "effects": effects}, None)
@@ -551,6 +561,7 @@ class Store:
             self.revision = (_revision if _revision is not None
                              else self.revision + 1)
             self.unlogged_revision = self.revision
+            self._observe_revision()
             if self.journal is not None:
                 from ..persistence.codec import encode_bulk_cols
 
@@ -621,6 +632,7 @@ class Store:
             if count:
                 self._trim_watch_log()
                 self.revision = rev
+                self._observe_revision()
                 if self.journal is not None:
                     self.journal({"kind": "delete", "rev": rev,
                                   "effects": effects}, None)
@@ -682,6 +694,7 @@ class Store:
             self._expiry_bounds = None
             self.revision = revision
             self.unlogged_revision = revision
+            self._observe_revision()
             # watchers from before the jump must re-list (their revisions
             # describe history this store never logged) — same contract
             # as a snapshot restore
@@ -924,6 +937,7 @@ class Store:
             self.revision = int(meta["revision"])
             self.unlogged_revision = self.revision
             self._watch_log = []
+            self._observe_revision()
             # watchers from before the restore must re-list + re-watch
             # (their revisions describe a different store lineage) — make
             # watch_since raise instead of silently returning no events

@@ -1,0 +1,29 @@
+"""Observability: request tracing, decision audit log, engine profiling.
+
+The proxy's whole value is *explainable* authorization; this package makes
+one request followable end-to-end — admission → cache → device fixpoint →
+replication → upstream — and every decision auditable:
+
+- :mod:`.trace` — cheap in-process spans under a W3C ``traceparent``
+  context, recorded into a lock-sharded ring buffer with tail sampling
+  (error/shed/slow traces always kept). Served at ``/debug/traces``.
+- :mod:`.audit` — one JSON line per authorization decision
+  (``--audit-log``), denies always, allows rate-capped.
+- :mod:`.profile` — JAX compile-event hooks feeding the metrics registry.
+"""
+
+from .audit import AuditLog
+from .trace import (
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+    tracer,
+)
+
+__all__ = [
+    "AuditLog",
+    "Tracer",
+    "format_traceparent",
+    "parse_traceparent",
+    "tracer",
+]

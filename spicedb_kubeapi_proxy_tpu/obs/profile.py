@@ -1,0 +1,57 @@
+"""Engine profiling hooks: JAX compile events into the metrics registry.
+
+TrieJax-style kernel accounting (PAPERS.md) for the parts the engine
+cannot time itself: XLA compilation happens inside jax, invisibly to the
+dispatch path, yet a recompile is the single largest latency cliff the
+engine has (tens of seconds at the 10M-relationship scale). jax's
+monitoring module broadcasts event durations; the listener below mirrors
+every compile-shaped event into ``jax_compile_seconds`` /
+``jax_compile_events_total`` so a scrape (or bench.py's per-phase stage
+breakdown) can attribute a p99 spike to compilation instead of guessing.
+
+The other profiling hooks live where the numbers are produced:
+CSR nnz / slot-space gauges at graph compile (engine/engine.py
+``compiled()``), dispatch batch-size and frontier-occupancy histograms on
+the query paths, queue-wait on the admission controller, replication ack
+wait on the mirrored engine.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.metrics import metrics
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    # jax event names are path-ish ("/jax/core/compile/..."); anything
+    # compile-shaped counts — backend_compile, pjit compile, tracing not
+    if "compile" not in event:
+        return
+    metrics.counter("jax_compile_events_total").inc()
+    metrics.histogram(
+        "jax_compile_seconds",
+        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                 60.0, 120.0)).observe(float(duration))
+
+
+def install_jax_compile_hook() -> bool:
+    """Register the compile-event listener once per process; True when a
+    listener is (now or already) installed. Safe without jax or against a
+    jax whose monitoring surface moved — profiling is best-effort, the
+    engine must not fail to boot over it."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+        except Exception:  # noqa: BLE001 - any jax/API-drift failure
+            return False
+        _installed = True
+        return True

@@ -367,6 +367,22 @@ class MirroredEngine:
         never acknowledged as durable when it is not."""
         import time as _time
 
+        from ..obs.trace import tracer
+        from ..utils.metrics import metrics
+
+        t_wait0 = _time.perf_counter()
+        ack_span = tracer.begin("replication_ack_wait", seq=seq)
+        try:
+            self._wait_replicated_inner(seq)
+        finally:
+            metrics.histogram("engine_replication_ack_seconds").observe(
+                _time.perf_counter() - t_wait0)
+            if ack_span is not None:
+                ack_span.finish()
+
+    def _wait_replicated_inner(self, seq: int) -> None:
+        import time as _time
+
         deadline = _time.monotonic() + self._replication_timeout
         # ids observed acking >= seq at ANY point — an ack is a durable
         # journal entry on that replica, so it still counts toward the

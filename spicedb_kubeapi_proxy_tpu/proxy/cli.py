@@ -40,6 +40,13 @@ def main(argv=None) -> int:
         await stop.wait()
         await cfg.server.stop()
         await cfg.workflow.shutdown()
+        if cfg.deps.audit is not None:
+            # drain + close the audit writer queue: the decisions
+            # nearest a shutdown (deny storms before a crash-loop) are
+            # exactly the ones an auditor needs — never drop them on
+            # SIGTERM, never leave a torn half-written tail line
+            await asyncio.get_running_loop().run_in_executor(
+                None, cfg.deps.audit.close)
         if opts.snapshot_path and hasattr(cfg.engine, "save_snapshot"):
             cfg.engine.save_snapshot(opts.snapshot_path)
             logging.info("saved snapshot to %s", opts.snapshot_path)

@@ -240,6 +240,24 @@ class Options:
     admission_tenant_queue_depth: int = 32
     admission_queue_depth: int = 256  # global bound; lowest priority sheds
     admission_queue_timeout: float = 1.0  # max queue wait before shedding
+    # -- observability (obs/) ------------------------------------------------
+    # request tracing (obs/trace.py): tail-sampling keep probability for
+    # ordinary traces — error/shed/slow traces are ALWAYS kept. 0
+    # disables tracing entirely (no spans recorded, /debug/traces 404s).
+    trace_sample: float = 0.1
+    # traces at or above this request duration are always kept, and a
+    # slow-request log line is emitted
+    trace_slow_ms: float = 250.0
+    # recent-trace ring capacity served by /debug/traces
+    trace_ring: int = 256
+    # /debug/traces stays 404 unless explicitly enabled (same posture as
+    # /debug/config: traces name other subjects' requests and timings)
+    enable_debug_traces: bool = False
+    # decision audit log (obs/audit.py): file path or "stderr"; None =
+    # no audit. One JSON line per authorization decision — denies
+    # always, allows rate-capped at audit_allow_rps lines/second.
+    audit_log: Optional[str] = None
+    audit_allow_rps: float = 10.0
 
     def _parse_remote(self) -> Optional[list[tuple[str, int]]]:
         """[(host, port), ...] for tcp:// endpoints, None otherwise;
@@ -369,6 +387,14 @@ class Options:
                     self.admission_queue_timeout)
             except ValueError as e:
                 raise OptionsError(str(e)) from None
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise OptionsError("trace-sample must be in [0, 1]")
+        if self.trace_slow_ms < 0:
+            raise OptionsError("trace-slow-ms must be >= 0")
+        if self.trace_ring < 1:
+            raise OptionsError("trace-ring must be >= 1")
+        if self.audit_allow_rps <= 0:
+            raise OptionsError("audit-allow-rps must be > 0")
         if self.authz_cache_size < 1:
             raise OptionsError("authz-cache-size must be >= 1")
         if self.authz_cache_mask_bytes < 0:
@@ -587,12 +613,26 @@ class Options:
                 global_depth=self.admission_queue_depth,
                 queue_timeout=self.admission_queue_timeout,
                 dependency="admission")
+        # observability: the tracer is process-global (the engine and
+        # remote client record spans through it); configure from flags
+        # here, the ONE place serving configuration lands
+        from ..obs import AuditLog
+        from ..obs.trace import tracer
+
+        tracer.configure(sample=self.trace_sample,
+                         slow_ms=self.trace_slow_ms,
+                         ring=self.trace_ring)
+        audit = None
+        if self.audit_log:
+            audit = AuditLog(self.audit_log,
+                             allow_rps=self.audit_allow_rps)
         deps = AuthzDeps(
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
             discovery_cache=discovery_cache,
             breakers=dep_breakers,
             admission=admission,
+            audit=audit,
         )
         ssl_context = None
         if self.tls_cert_file:
@@ -644,7 +684,8 @@ class Options:
                         client_ca_configured=bool(self.tls_client_ca_file),
                         requestheader_allowed_names=tuple(
                             self.tls_requestheader_allowed_names),
-                        token_authenticator=token_authenticator)
+                        token_authenticator=token_authenticator,
+                        enable_debug_traces=self.enable_debug_traces)
         return CompletedConfig(self, engine, workflow, deps, server)
 
     # fields safe to expose on /debug/config — an ALLOWLIST so a future
@@ -667,6 +708,8 @@ class Options:
         "admission_tenant_rate", "admission_tenant_burst",
         "admission_tenant_queue_depth", "admission_queue_depth",
         "admission_queue_timeout",
+        "trace_sample", "trace_slow_ms", "trace_ring",
+        "enable_debug_traces", "audit_log", "audit_allow_rps",
     )
 
     def debug_dump(self) -> dict:
@@ -927,6 +970,33 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         default=1.0,
                         help="max seconds a request may queue before it "
                              "is shed (503 + Retry-After, never a hang)")
+    parser.add_argument("--trace-sample", type=float, default=0.1,
+                        help="request-trace tail-sampling keep "
+                             "probability (error/shed/slow traces are "
+                             "always kept; 0 disables tracing and "
+                             "/debug/traces entirely)")
+    parser.add_argument("--trace-slow-ms", type=float, default=250.0,
+                        help="requests at or above this duration are "
+                             "always kept by tail sampling and logged "
+                             "as slow, with their trace id")
+    parser.add_argument("--trace-ring", type=int, default=256,
+                        help="recent-trace ring capacity served by "
+                             "/debug/traces")
+    parser.add_argument("--enable-debug-traces", action="store_true",
+                        help="serve the recent-trace ring on "
+                             "/debug/traces (authenticated; off by "
+                             "default — traces name other subjects' "
+                             "request paths and timings)")
+    parser.add_argument("--audit-log", default=None,
+                        metavar="PATH|stderr",
+                        help="decision audit log destination: one JSON "
+                             "line per authorization decision (denies "
+                             "always, allows rate-capped; see "
+                             "docs/operations.md for the line schema). "
+                             "Unset = no audit log")
+    parser.add_argument("--audit-allow-rps", type=float, default=10.0,
+                        help="rate cap for ALLOW audit lines per second "
+                             "(denies are never capped)")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -1000,4 +1070,10 @@ def options_from_args(args: argparse.Namespace) -> Options:
         admission_tenant_queue_depth=args.admission_tenant_queue_depth,
         admission_queue_depth=args.admission_queue_depth,
         admission_queue_timeout=args.admission_queue_timeout,
+        trace_sample=args.trace_sample,
+        trace_slow_ms=args.trace_slow_ms,
+        trace_ring=args.trace_ring,
+        enable_debug_traces=args.enable_debug_traces,
+        audit_log=args.audit_log,
+        audit_allow_rps=args.audit_allow_rps,
     )

@@ -22,6 +22,7 @@ from typing import Optional
 from urllib.parse import parse_qs, unquote, urlsplit
 
 from ..authz import AuthzDeps, authorize
+from ..obs.trace import tracer
 from ..proxy.authn import (
     AuthenticationError,
     ClientCertAuthenticator,
@@ -36,6 +37,11 @@ log = logging.getLogger("sdbkp.proxy")
 
 MAX_BODY = 64 * 1024 * 1024
 
+# fixed infra endpoints that never open a trace: probe/scrape cadence
+# would otherwise cycle real request traces out of the bounded ring
+_UNTRACED_PATHS = frozenset({
+    "/livez", "/readyz", "/metrics", "/debug/traces", "/debug/config"})
+
 
 class Server:
     """Serves the handler chain over TCP; also exposes `handle` for
@@ -48,7 +54,8 @@ class Server:
                  ssl_context=None,
                  client_ca_configured: bool = False,
                  requestheader_allowed_names: tuple = (),
-                 token_authenticator=None):
+                 token_authenticator=None,
+                 enable_debug_traces: bool = False):
         self.deps = deps
         self.authenticator = authenticator or HeaderAuthenticator()
         self.cert_authenticator = ClientCertAuthenticator()
@@ -70,30 +77,68 @@ class Server:
         self.ssl_context = ssl_context
         self.client_ca_configured = client_ca_configured
         self.requestheader_allowed_names = set(requestheader_allowed_names)
+        # /debug/traces posture mirrors /debug/config: traces name other
+        # subjects' request paths and timings, so the endpoint is opt-in
+        # (--enable-debug-traces) on top of authentication
+        self.enable_debug_traces = enable_debug_traces
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()  # live connection-handler tasks
 
     # -- handler chain -------------------------------------------------------
 
     async def handle(self, req: ProxyRequest) -> ProxyResponse:
-        """Panic recovery → logging → request info → authn → authz."""
+        """Panic recovery → logging → tracing → request info → authn →
+        authz. The root span adopts an incoming W3C ``traceparent`` (or
+        mints a fresh trace); every response carries ``X-Trace-Id`` while
+        tracing is on, so a shed/failed request is followable from the
+        client's error body straight into ``/debug/traces``. Fixed infra
+        endpoints (health probes, scrapes, the introspection endpoints
+        themselves) never trace: at kubelet/Prometheus cadence their
+        sampled zero-span traces would cycle real request traces out of
+        the fixed ring on a low-traffic replica."""
         start = time.monotonic()
-        try:
-            resp = await self._handle_inner(req)
-        except Exception as e:  # panic recovery (server.go:149)
-            log.error("panic serving %s %s: %s\n%s", req.method, req.path, e,
-                      traceback.format_exc())
-            metrics.counter("proxy_panics").inc()
-            resp = kube_status(500, "internal error")
+        trace_id = None
+        if req.path in _UNTRACED_PATHS:
+            resp = await self._recovered_inner(req)
+        else:
+            tp = next((v for k, v in req.headers.items()
+                       if k.lower() == "traceparent"), None)
+            with tracer.start("request", traceparent=tp,
+                              method=req.method, path=req.path) as root:
+                resp = await self._recovered_inner(req)
+                root.set("status", resp.status)
+                if resp.status >= 500 and not tracer.flagged("shed"):
+                    # breaker-open / dependency-down responses are traces
+                    # worth keeping: flag so tail sampling never drops
+                    # them. Shed 503s stay "shed"-only — a load shed is
+                    # the admission design WORKING, and it must not
+                    # pollute an operator's error-trace filter
+                    tracer.flag("error")
+                trace_id = root.trace_id
+                if trace_id is not None:
+                    resp.headers.setdefault("X-Trace-Id", trace_id)
         dur = time.monotonic() - start
         metrics.counter("proxy_requests_total",
                         verb=(req.request_info.verb if req.request_info
                               else req.method),
                         code=resp.status).inc()
         metrics.histogram("proxy_request_seconds").observe(dur)
+        if trace_id is not None and dur >= tracer.slow_s:
+            log.warning("slow request: %s %s -> %d (%.1fms, trace %s)",
+                        req.method, req.path, resp.status, dur * 1e3,
+                        trace_id)
         log.info("%s %s -> %d (%.1fms)", req.method, req.path, resp.status,
                  dur * 1e3)
         return resp
+
+    async def _recovered_inner(self, req: ProxyRequest) -> ProxyResponse:
+        try:
+            return await self._handle_inner(req)
+        except Exception as e:  # panic recovery (server.go:149)
+            log.error("panic serving %s %s: %s\n%s", req.method, req.path,
+                      e, traceback.format_exc())
+            metrics.counter("proxy_panics").inc()
+            return kube_status(500, "internal error")
 
     async def _handle_inner(self, req: ProxyRequest) -> ProxyResponse:
         if req.path == "/livez":
@@ -162,9 +207,10 @@ class Server:
                 # to_thread: OIDC verification can do a blocking JWKS
                 # fetch (plus modular-exponentiation work) — neither
                 # belongs on the event loop
-                user = await asyncio.to_thread(
-                    self.token_authenticator.authenticate_token,
-                    auth[7:].strip())
+                with tracer.span("authn"):
+                    user = await asyncio.to_thread(
+                        self.token_authenticator.authenticate_token,
+                        auth[7:].strip())
                 if user is None:
                     # credentials were presented and are wrong: reject
                     # rather than falling through to weaker identities
@@ -176,6 +222,53 @@ class Server:
                 req.user = self.authenticator.authenticate(req.headers)
             except AuthenticationError as e:
                 return kube_status(401, str(e), "Unauthorized")
+        if req.path == "/debug/traces":
+            # flag-gated AND authenticated (traces name other subjects'
+            # request paths and timings); the ring is the recent
+            # TAIL-KEPT set — error/shed/slow always, the rest sampled
+            if not self.enable_debug_traces or not tracer.enabled:
+                return kube_status(
+                    404, "trace endpoint disabled "
+                         "(--enable-debug-traces, --trace-sample>0)",
+                    "NotFound")
+            import json as _json
+
+            try:
+                limit = int(req.query_get("limit", "64"))
+            except ValueError:
+                limit = 64
+            traces = tracer.recent(limit)
+            # cross-process engine hosts keep their span fragments in
+            # their OWN ring: fetch and stitch them in by trace_id so an
+            # operator reads one complete trace here. In-process engines
+            # (and tcp:// hosts sharing this interpreter) stitched live,
+            # so only EXTERNAL fragments merge — never duplicates.
+            fetch = getattr(self.deps.engine, "fetch_traces", None)
+            if fetch is not None:
+                try:
+                    frags = await asyncio.to_thread(fetch, limit)
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    frags = []
+                # shallow-copy before stitching: recent() hands back the
+                # ring's own dicts, and mutating them would re-append
+                # fragments on every later fetch
+                traces = [dict(t) for t in traces]
+                by_id = {t["trace_id"]: t for t in traces}
+                for f in frags:
+                    if not f.get("external"):
+                        continue
+                    local = by_id.get(f["trace_id"])
+                    if local is not None:
+                        local["spans"] = local["spans"] + f["spans"]
+                    else:
+                        # a later fragment of the same trace (a re-aimed
+                        # request leaves spans on several hosts) must
+                        # merge into THIS entry, not append another
+                        traces.append(f)
+                        by_id[f["trace_id"]] = f
+            return ProxyResponse(
+                status=200, headers={"Content-Type": "application/json"},
+                body=_json.dumps({"traces": traces}).encode())
         if req.path == "/debug/config":
             # flag-gated (Options.enable_debug_config) AND authenticated:
             # the dump is allowlisted, but config topology still doesn't

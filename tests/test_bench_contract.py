@@ -48,6 +48,22 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     assert "hung plugin" in out["backend_error"]
     assert out["value"] is not None and out["value"] > 0
     assert "[DEGRADED: cpu]" in out["metric"]
+    # per-stage breakdown (ISSUE 6): every stage key serializes, counts
+    # are ints, percentiles are finite numbers or null — never Infinity
+    # (json.loads above already rejects bare Infinity-producing bugs at
+    # the parse level only for NaN-strict parsers, so check explicitly)
+    stages = out["stages"]
+    assert set(stages) == {"admission_wait", "device", "upstream"}
+    for st in stages.values():
+        assert isinstance(st["n"], int)
+        for k in ("p50_ms", "p99_ms"):
+            v = st[k]
+            assert v is None or (isinstance(v, (int, float))
+                                 and v == v and abs(v) != float("inf"))
+    # the tiny run exercises the engine: the device stage must have
+    # samples and real percentiles
+    assert stages["device"]["n"] > 0
+    assert stages["device"]["p50_ms"] is not None
 
 
 def test_sigterm_flushes_partial_json():

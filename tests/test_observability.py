@@ -1,0 +1,634 @@
+"""Observability subsystem (ISSUE 6): end-to-end request tracing, the
+decision audit log, engine profiling hooks, and the Prometheus exposition
+contract.
+
+The e2e pins: a live proxy + tcp engine-host request produces ONE trace
+holding both proxy-side and engine-host-side spans (stitched via the wire
+frame field); denies always land in the audit log with the matched rule
+and trace_id; the failure paths (admission shed, breaker-open
+fail-closed, failover re-aim) keep their traces and carry the trace id to
+the client.
+"""
+
+import asyncio
+import json
+import os
+import re
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.obs.audit import AuditLog
+from spicedb_kubeapi_proxy_tpu.obs.trace import (
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+    tracer,
+)
+from spicedb_kubeapi_proxy_tpu.utils.metrics import (
+    Histogram,
+    Registry,
+    metrics,
+    snapshot_delta_quantile,
+)
+
+RULES = open(os.path.join(os.path.dirname(__file__), "..", "deploy",
+                          "rules.yaml")).read()
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    """Every test starts from a clean, keep-everything tracer and leaves
+    the module-global in its default state."""
+    tracer.configure(sample=1.0, slow_ms=250.0, ring=256)
+    tracer.reset()
+    yield
+    tracer.configure(sample=0.1, slow_ms=250.0, ring=256)
+    tracer.reset()
+
+
+# -- traceparent --------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    tp = format_traceparent("0af7651916cd43dd8448eb211c80319c",
+                            "b7ad6b7169203331")
+    assert tp == ("00-0af7651916cd43dd8448eb211c80319c-"
+                  "b7ad6b7169203331-01")
+    trace_id, span_id, flags = parse_traceparent(tp)
+    assert trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert span_id == "b7ad6b7169203331"
+    assert flags == 1
+
+
+def test_traceparent_malformed_is_none():
+    for bad in (None, "", "garbage", "00-short-short-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span
+                "00-" + "z" * 32 + "-" + "1" * 16 + "-01",  # non-hex
+                "00-" + "1" * 32 + "-" + "1" * 16,  # missing flags
+                42):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_concurrent_same_traceparent_requests_stay_separate():
+    """A client retry reusing its traceparent while the original is
+    still in flight must NOT share a live trace (engine-host spans and
+    stage timings would cross-stitch between unrelated requests): the
+    second request gets a fresh trace_id, keeping the requested one as
+    an attribute."""
+    tp = format_traceparent("e" * 32, "f" * 16)
+    with tracer.start("request", traceparent=tp) as first:
+        with tracer.start("request", traceparent=tp) as second:
+            assert second.trace_id != first.trace_id
+            assert second.attrs["requested_trace_id"] == "e" * 32
+            # adopt() while both live stitches to the ORIGINAL holder
+            with tracer.adopt(tp, "engine_host.op") as sp:
+                assert sp.trace_id == first.trace_id
+        # the inner root's finish must not evict the original live entry
+        with tracer.adopt(tp, "engine_host.op2") as sp:
+            assert sp.trace_id == first.trace_id
+    kept = {t["trace_id"] for t in tracer.recent()}
+    assert {first.trace_id, second.trace_id} <= kept
+
+
+def test_ingress_adopts_incoming_traceparent():
+    with tracer.start("request", traceparent=format_traceparent(
+            "c" * 32, "d" * 16)) as root:
+        assert root.trace_id == "c" * 32
+    kept = tracer.recent(1)
+    assert kept and kept[0]["trace_id"] == "c" * 32
+    # the root's parent is the incoming span id
+    root_span = [s for s in kept[0]["spans"] if s["name"] == "request"][0]
+    assert root_span["parent_id"] == "d" * 16
+
+
+# -- tail sampling ------------------------------------------------------------
+
+
+def test_tail_sampling_keeps_errors_sheds_and_slow_only():
+    t = Tracer(sample=0.5, slow_ms=10_000.0, ring=64)
+    t.configure(_rand=lambda: 0.99)  # above sample: ordinary drops
+    with t.start("request"):
+        pass
+    assert t.recent() == []
+    with t.start("request"):
+        t.flag("error", "boom")
+    with t.start("request"):
+        t.flag("shed")
+    assert len(t.recent()) == 2
+    t.configure(slow_ms=0.0)  # everything is "slow" now
+    with t.start("request"):
+        pass
+    assert len(t.recent()) == 3
+    # sample=0 disables recording entirely
+    t.configure(sample=0.0, slow_ms=0.0)
+    with t.start("request") as root:
+        assert root.trace_id is None
+    assert len(t.recent()) == 3
+
+
+def test_span_exception_flags_trace_error():
+    t = Tracer(sample=0.0001, slow_ms=10_000.0, ring=64)
+    t.configure(_rand=lambda: 0.99)
+    with pytest.raises(RuntimeError):
+        with t.start("request"):
+            with t.span("engine_dispatch"):
+                raise RuntimeError("device fell over")
+    kept = t.recent()
+    assert len(kept) == 1 and kept[0]["flags"].get("error")
+    sp = [s for s in kept[0]["spans"] if s["name"] == "engine_dispatch"][0]
+    assert "device fell over" in sp["attrs"]["error"]
+
+
+def test_spans_cross_executor_hops_via_capture_activate():
+    import concurrent.futures
+
+    with tracer.start("request") as root:
+        cap = tracer.capture()
+
+        def worker():
+            with tracer.activate(cap), tracer.span("engine_device"):
+                return tracer.current_trace_id()
+
+        with concurrent.futures.ThreadPoolExecutor(1) as pool:
+            tid = pool.submit(worker).result()
+        assert tid == root.trace_id
+    kept = tracer.recent(1)[0]
+    assert {"engine_device", "request"} <= {s["name"]
+                                            for s in kept["spans"]}
+
+
+# -- histogram quantile + exposition ------------------------------------------
+
+
+def test_histogram_quantile_overflow_clamps_to_max():
+    h = Histogram(buckets=(0.001, 0.01))
+    h.observe(42.5)
+    h.observe(97.25)
+    # both observations overflow the last bucket: p50/p99 must be the
+    # largest observed value, never float("inf") (BENCH_*.json fields)
+    assert h.quantile(0.5) == 97.25
+    assert h.quantile(0.99) == 97.25
+    assert h.quantile(0.99) != float("inf")
+    h.observe(0.0005)
+    assert h.quantile(0.01) == 0.001  # in-range targets keep bucket UB
+
+
+def test_snapshot_delta_quantile_windows():
+    h = Histogram(buckets=(0.001, 0.01, 0.1))
+    h.observe(0.05)
+    before = h.snapshot()
+    assert snapshot_delta_quantile(before, h.snapshot(), 0.5) is None
+    for _ in range(9):
+        h.observe(0.005)
+    h.observe(7.0)
+    after = h.snapshot()
+    assert snapshot_delta_quantile(before, after, 0.5) == 0.01
+    assert snapshot_delta_quantile(before, after, 0.999) == 7.0
+
+
+def test_histogram_renders_cumulative_buckets_and_types():
+    r = Registry()
+    r.counter("demo_total").inc()
+    r.gauge("demo_gauge").set(3)
+    h = r.histogram("demo_seconds", dependency="x")
+    for v in (0.0001, 0.004, 50.0):
+        h.observe(v)
+    text = r.render()
+    assert "# TYPE demo_total counter" in text
+    assert "# TYPE demo_gauge gauge" in text
+    assert "# TYPE demo_seconds histogram" in text
+    # cumulative bucket series, closed by +Inf == _count
+    assert 'demo_seconds_bucket{dependency="x",le="0.005"} 2' in text
+    assert 'demo_seconds_bucket{dependency="x",le="+Inf"} 3' in text
+    # the historical lines are unchanged (backward compatibility)
+    assert 'demo_seconds_count{dependency="x"} 3' in text
+    assert 'demo_seconds_sum{dependency="x"}' in text
+    # buckets are monotonically non-decreasing
+    counts = [int(m.group(1)) for m in re.finditer(
+        r'demo_seconds_bucket\{[^}]*\} (\d+)', text)]
+    assert counts == sorted(counts)
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (.+)$")
+
+
+def test_metrics_exposition_lints():
+    """The scrape-format contract (CI-pinned): every registered metric
+    name matches Prometheus naming rules, no duplicate name+label-set
+    sample, and every histogram renders a bucket series closed by +Inf.
+    Exercises a representative slice of the real instrumentation first so
+    the lint sees the names production registers."""
+    async def exercise():
+        from fake_kube import FakeKube
+        from spicedb_kubeapi_proxy_tpu.proxy.inmemory import InMemoryClient
+        from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+        import tempfile
+
+        cfg = Options(
+            rule_content=RULES, upstream=FakeKube(), bind_port=0,
+            workflow_database_path=os.path.join(
+                tempfile.mkdtemp(prefix="obslint-"), "dtx.sqlite"),
+            admission=True,
+        ).complete()
+        alice = InMemoryClient(cfg.server.handle, user="alice")
+        assert (await alice.post(
+            "/api/v1/namespaces",
+            {"metadata": {"name": "lint"}})).status == 201
+        assert (await alice.get("/api/v1/namespaces")).status == 200
+        assert (await alice.get("/api/v1/namespaces/lint")).status == 200
+        bob = InMemoryClient(cfg.server.handle, user="bob")
+        assert (await bob.get("/api/v1/namespaces/lint")).status == 403
+        await cfg.workflow.shutdown()
+
+    asyncio.run(exercise())
+    text = metrics.render()
+    assert text.strip(), "registry rendered empty after real traffic"
+    seen: set = set()
+    hist_names: set = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert _NAME_RE.match(name), f"bad metric name {name!r}"
+            if kind == "histogram":
+                hist_names.add(name)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line {line!r}"
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        assert _NAME_RE.match(name), f"bad metric name {name!r}"
+        for lk in re.findall(r'([a-zA-Z0-9_]+)="', labels):
+            assert _NAME_RE.match(lk), f"bad label name {lk!r} in {line!r}"
+        float(value)  # every sample value parses as a number
+        assert (name, labels) not in seen, f"duplicate sample {line!r}"
+        seen.add((name, labels))
+    assert hist_names, "no histograms registered by real traffic"
+    for name in hist_names:
+        assert f'{name}_bucket' in text, f"{name} renders no buckets"
+        assert re.search(rf'{name}_bucket{{[^}}]*le="\+Inf"}}', text), \
+            f"{name} bucket series not closed by +Inf"
+
+
+# -- audit log ----------------------------------------------------------------
+
+
+def test_audit_denies_always_allows_rate_capped(tmp_path):
+    path = str(tmp_path / "audit.jsonl")
+    clock = [0.0]
+    a = AuditLog(path, allow_rps=2.0, clock=lambda: clock[0])
+    for _ in range(10):
+        a.decision(allow=True, verb="list", subject="alice",
+                   rule="namespace-list-watch")
+    for _ in range(5):
+        a.decision(allow=False, verb="get", subject="bob",
+                   rule="namespace-get", reason="check denied")
+    a.close()
+    lines = [json.loads(ln) for ln in open(path)]
+    allows = [r for r in lines if r["decision"] == "allow"]
+    denies = [r for r in lines if r["decision"] == "deny"]
+    assert len(allows) == 2  # burst = allow_rps, clock frozen
+    assert len(denies) == 5  # never capped
+    assert denies[0]["rule"] == "namespace-get"
+    # budget refills with time
+    clock[0] += 1.0
+    a2 = AuditLog(path, allow_rps=2.0, clock=lambda: clock[0])
+    a2.decision(allow=True, verb="list", subject="alice")
+    a2.close()
+    assert sum(1 for ln in open(path)
+               if json.loads(ln)["decision"] == "allow") == 3
+
+
+# -- e2e: live proxy + tcp engine host ----------------------------------------
+
+
+def _free_client(handle, user):
+    from spicedb_kubeapi_proxy_tpu.proxy.inmemory import InMemoryClient
+
+    return InMemoryClient(handle, user=user)
+
+
+def test_trace_end_to_end_proxy_tcp_engine(tmp_path):
+    """THE acceptance pin: one request through a live proxy + tcp engine
+    host yields ONE trace containing proxy-side spans (admission wait,
+    engine rpc, upstream) AND engine-host spans (queue wait, device
+    dispatch) stitched via the wire frame field; denies always appear in
+    the audit log with the matched rule and trace_id."""
+    from fake_kube import FakeKube
+    from spicedb_kubeapi_proxy_tpu.admission import AdmissionController
+    from spicedb_kubeapi_proxy_tpu.engine import Engine
+    from spicedb_kubeapi_proxy_tpu.engine.remote import EngineServer
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    audit_path = str(tmp_path / "audit.jsonl")
+
+    async def go():
+        e = Engine()
+        srv = EngineServer(
+            e, admission=AdmissionController(
+                dependency="engine-admission"))
+        port = await srv.start()
+        cfg = Options(
+            engine_endpoint=f"tcp://127.0.0.1:{port}",
+            engine_insecure=True,
+            rule_content=RULES,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            admission=True,
+            trace_sample=1.0,
+            enable_debug_traces=True,
+            audit_log=audit_path,
+        ).complete()
+        await cfg.workflow.resume_pending()
+        alice = _free_client(cfg.server.handle, "alice")
+        bob = _free_client(cfg.server.handle, "bob")
+
+        resp = await alice.post("/api/v1/namespaces",
+                                {"metadata": {"name": "team-a"}})
+        assert resp.status == 201, resp.body
+        resp = await alice.get("/api/v1/namespaces/team-a")
+        assert resp.status == 200
+        allow_trace = resp.headers["X-Trace-Id"]
+        resp = await bob.get("/api/v1/namespaces/team-a")
+        assert resp.status == 403
+        deny_trace = resp.headers["X-Trace-Id"]
+
+        # /debug/traces serves the ring; find the allowed get's trace
+        resp = await alice.get("/debug/traces")
+        assert resp.status == 200
+        traces = {t["trace_id"]: t
+                  for t in json.loads(resp.body)["traces"]}
+        t = traces[allow_trace]
+        names = {s["name"] for s in t["spans"]}
+        # proxy-side stages
+        assert {"request", "rule_match", "admission_wait", "cache_probe",
+                "engine_dispatch", "engine_rpc", "upstream"} <= names, \
+            names
+        # engine-host-side stages, stitched into the SAME trace via the
+        # wire frame field
+        assert {"engine_host.check_bulk", "engine_queue_wait",
+                "engine_device"} <= names, names
+        # admission-wait, device-dispatch, and upstream individually
+        # timed (finished spans with a recorded duration)
+        by_name = {s["name"]: s for s in t["spans"]}
+        for stage in ("admission_wait", "engine_device", "upstream"):
+            assert by_name[stage]["duration_us"] >= 0
+        # the engine-host span names the endpoint it served on
+        assert by_name["engine_host.check_bulk"]["attrs"][
+            "endpoint"].endswith(str(port))
+        # deny trace was kept too (tail sampling at 1.0 keeps all)
+        assert deny_trace in traces
+
+        await cfg.workflow.shutdown()
+        cfg.engine.close()
+        await srv.stop()
+
+        # audit: the deny line carries the matched rule and trace_id
+        # (writes drain through the audit writer thread: flush first)
+        cfg.deps.audit.flush()
+        lines = [json.loads(ln) for ln in open(audit_path)]
+        denies = [r for r in lines if r["decision"] == "deny"]
+        assert denies, lines
+        d = denies[-1]
+        assert d["subject"] == "bob"
+        assert d["rule"] == "namespace-get"
+        assert d["trace_id"] == deny_trace
+        assert d["verb"] == "get" and d["name"] == "team-a"
+        # per-stage micros recorded up to the decision point
+        assert "engine_dispatch" in d["stages_us"] \
+            or "cache_probe" in d["stages_us"]
+        allows = [r for r in lines if r["decision"] == "allow"]
+        assert any(r["trace_id"] == allow_trace for r in allows)
+
+    asyncio.run(go())
+
+
+def test_admission_shed_503_carries_trace_id_and_shed_flag(tmp_path):
+    """Failure path 1: an admission shed's 503 carries the trace id and
+    the trace is flagged shed (always kept by tail sampling)."""
+    from fake_kube import FakeKube
+    from spicedb_kubeapi_proxy_tpu.admission import AdmissionRejected
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    class AlwaysShed:
+        async def acquire_async(self, tenant, cls):
+            raise AdmissionRejected(cls.name, "queue full",
+                                    retry_after=2.0)
+
+        def status(self):
+            return {"limit": 0, "inflight": 0, "queued": 0,
+                    "shed_total": 1}
+
+    async def go():
+        cfg = Options(
+            rule_content=RULES, upstream=FakeKube(), bind_port=0,
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            trace_sample=1.0,
+        ).complete()
+        cfg.deps.admission = AlwaysShed()
+        tracer.configure(_rand=lambda: 0.99)  # only flags keep traces
+        tracer.configure(sample=0.0001)
+        alice = _free_client(cfg.server.handle, "alice")
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "2"
+        trace_id = resp.headers["X-Trace-Id"]
+        kept = {t["trace_id"]: t for t in tracer.recent()}
+        assert trace_id in kept, "shed trace must survive tail sampling"
+        assert kept[trace_id]["flags"].get("shed") is True
+        # a shed is the admission design WORKING: it must not pollute an
+        # operator's error-trace filter
+        assert not kept[trace_id]["flags"].get("error")
+        await cfg.workflow.shutdown()
+
+    asyncio.run(go())
+
+
+def test_breaker_open_fail_closed_trace_kept_with_error(tmp_path):
+    """Failure path 2: breaker-open fail-closed 503s keep their trace
+    (error-flagged) and carry the trace id to the client."""
+    import socket
+
+    from fake_kube import FakeKube
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead = s.getsockname()[1]  # bound-then-closed: nothing listens
+
+    async def go():
+        cfg = Options(
+            engine_endpoint=f"tcp://127.0.0.1:{dead}",
+            engine_insecure=True,
+            rule_content=RULES, upstream=FakeKube(), bind_port=0,
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            engine_retries=0, engine_connect_timeout=0.5,
+            breaker_failure_threshold=1, breaker_reset_seconds=60.0,
+            trace_sample=1.0,
+        ).complete()
+        tracer.configure(_rand=lambda: 0.99)
+        tracer.configure(sample=0.0001)
+        alice = _free_client(cfg.server.handle, "alice")
+        resp = await alice.get("/api/v1/namespaces")  # trips the breaker
+        assert resp.status >= 500
+        resp = await alice.get("/api/v1/namespaces")  # breaker-open 503
+        assert resp.status == 503
+        trace_id = resp.headers["X-Trace-Id"]
+        kept = {t["trace_id"]: t for t in tracer.recent()}
+        assert trace_id in kept
+        assert kept[trace_id]["flags"].get("error")
+        await cfg.workflow.shutdown()
+
+    asyncio.run(go())
+
+
+def test_cross_process_fragments_recorded_and_fetchable_via_wire():
+    """An engine host in ANOTHER process records satellite fragments
+    under the proxy's trace_id; the wire `traces` op serves its ring so
+    the proxy's /debug/traces can stitch them back in."""
+    from spicedb_kubeapi_proxy_tpu.engine import Engine
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        EngineServer,
+        RemoteEngine,
+    )
+
+    # adopt a traceparent whose trace is NOT live in this process — the
+    # cross-process shape — and record a span under it
+    tp = format_traceparent("a1" * 16, "b2" * 8)
+    with tracer.adopt(tp, "engine_host.check_bulk", endpoint="x") as sp:
+        assert sp.trace_id == "a1" * 16
+    frags = [t for t in tracer.recent() if t["external"]]
+    assert frags and frags[0]["trace_id"] == "a1" * 16
+    # the fragment's root hangs off the proxy's wire-carried span id
+    root = frags[0]["spans"][0]
+    assert root["parent_id"] == "b2" * 8
+
+    async def go():
+        e = Engine()
+        srv = EngineServer(e)
+        port = await srv.start()
+        r = RemoteEngine("127.0.0.1", port)
+        got = await asyncio.to_thread(r.fetch_traces, 64)
+        assert any(t["trace_id"] == "a1" * 16 and t["external"]
+                   for t in got)
+        r.close()
+        await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_failover_reaim_spans_two_endpoints_one_trace():
+    """Failure path 3: a failover re-aim is ONE logical request whose
+    spans cover BOTH engine endpoints under a single trace_id."""
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        EngineServer,
+        FailoverEngine,
+    )
+
+    async def go():
+        e = Engine()
+        follower = EngineServer(
+            e, failover_status=lambda: {"role": "follower", "term": 2,
+                                        "revision": 0, "peer_id": 0,
+                                        "lag": 0})
+        leader = EngineServer(
+            e, failover_status=lambda: {"role": "leader", "term": 2,
+                                        "revision": 0, "peer_id": 1,
+                                        "lag": 0})
+        p1, p2 = await follower.start(), await leader.start()
+        fe = FailoverEngine([("127.0.0.1", p1), ("127.0.0.1", p2)],
+                            retries=0)
+        with tracer.start("request") as root:
+            out = await asyncio.to_thread(
+                fe.check_bulk,
+                [CheckItem("namespace", "dev", "view", "user", "alice")])
+            assert out == [False]
+            trace_id = root.trace_id
+        kept = {t["trace_id"]: t for t in tracer.recent()}
+        t = kept[trace_id]
+        endpoints = {s["attrs"].get("endpoint") for s in t["spans"]
+                     if s["name"] == "engine_rpc"}
+        # the not_leader rejection on p1 and the re-aimed call on p2 are
+        # spans of the SAME trace
+        assert f"engine:127.0.0.1:{p1}" in endpoints, (endpoints, p1)
+        assert f"engine:127.0.0.1:{p2}" in endpoints, (endpoints, p2)
+        fe.close()
+        await follower.stop()
+        await leader.stop()
+
+    asyncio.run(go())
+
+
+# -- tracing-off invariants ---------------------------------------------------
+
+
+def test_tracing_disabled_serves_with_no_spans_and_404_debug(tmp_path):
+    from fake_kube import FakeKube
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    async def go():
+        cfg = Options(
+            rule_content=RULES, upstream=FakeKube(), bind_port=0,
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            trace_sample=0.0, enable_debug_traces=True,
+        ).complete()
+        alice = _free_client(cfg.server.handle, "alice")
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status == 200
+        assert "X-Trace-Id" not in resp.headers
+        assert tracer.recent() == []
+        resp = await alice.get("/debug/traces")
+        assert resp.status == 404  # sampling off -> no ring to serve
+        await cfg.workflow.shutdown()
+
+    asyncio.run(go())
+
+
+def test_debug_traces_flag_gated_and_infra_paths_untraced(tmp_path):
+    """/debug/traces is 404 without --enable-debug-traces (the
+    /debug/config posture), and health/scrape endpoints never record
+    traces — probe cadence must not cycle real requests out of the
+    ring."""
+    from fake_kube import FakeKube
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    async def go():
+        cfg = Options(
+            rule_content=RULES, upstream=FakeKube(), bind_port=0,
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+            trace_sample=1.0,  # keep everything that IS traced
+        ).complete()
+        alice = _free_client(cfg.server.handle, "alice")
+        assert (await alice.get("/debug/traces")).status == 404
+        for _ in range(5):
+            assert (await alice.get("/readyz")).status == 200
+            assert (await alice.get("/livez")).status == 200
+            assert (await alice.get("/metrics")).status == 200
+        assert tracer.recent() == [], "infra endpoints must not trace"
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status == 200 and "X-Trace-Id" in resp.headers
+        assert len(tracer.recent()) == 1
+        await cfg.workflow.shutdown()
+
+    asyncio.run(go())
+
+
+def test_trace_overhead_disabled_is_negligible():
+    """The no-regression guard in unit form: with sample=0 the span hooks
+    must cost nanoseconds, not microseconds (the bench-level pin is the
+    check-throughput phase staying within noise)."""
+    tracer.configure(sample=0.0)
+    t0 = time.perf_counter()
+    n = 20_000
+    for _ in range(n):
+        with tracer.span("x"):
+            pass
+        tracer.begin("y")
+    per_call = (time.perf_counter() - t0) / (2 * n)
+    # generous bound: even a slow CI box does a no-op contextvar check in
+    # well under 20us
+    assert per_call < 20e-6, f"{per_call * 1e6:.2f}us per disabled hook"

@@ -5,7 +5,7 @@ PY ?= python
 # `verify` uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: test test-quick chaos bench bench-quick bench-smoke serve-dev demo native lint verify image clean
+.PHONY: test test-quick chaos bench bench-quick bench-smoke bench-macro serve-dev demo native lint verify image clean
 
 # full suite on the virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -31,6 +31,12 @@ bench-quick:
 # CI-sized bench exercising the full hot path including the decision
 # cache's repeat-traffic phase (cold vs warm p50 + hit rate on stderr)
 bench-smoke: bench-quick
+
+# open-loop macrobench smoke: ONLY the trace-shaped offered-load sweep
+# at --tiny scale (seconds, not minutes) — proves the goodput curve,
+# knee estimate, burst p99.9, and SLO attainment all emit
+bench-macro:
+	$(PY) bench.py --tiny --macro-only
 
 # fully self-contained demo: proxy + in-memory upstream + sample rules
 # on http://127.0.0.1:8080 (the reference's `mage dev:up`+`dev:run` flow

@@ -135,10 +135,12 @@ def _stage_snapshot() -> dict:
 
 
 def _record_stage_breakdown(result: dict, key: str, before: dict) -> None:
-    """p50/p99 (ms) + sample count per stage for the window since
+    """p50/p99/p99.9 (ms) + sample count per stage for the window since
     ``before`` (a ``_stage_snapshot()``), merged across each stage's
-    histograms. Stages with no samples report ``n: 0`` and null
-    percentiles — never Infinity, never a crash (the JSON contract)."""
+    histograms. Stages whose window saw NO samples are omitted entirely
+    (a zero-count row with null percentiles reads like a measurement);
+    recorded percentiles are always finite — never Infinity, never a
+    crash (the JSON contract)."""
     from spicedb_kubeapi_proxy_tpu.utils.metrics import (
         snapshot_delta_quantile,
     )
@@ -147,7 +149,7 @@ def _record_stage_breakdown(result: dict, key: str, before: dict) -> None:
     stages = {}
     for stage, names in _STAGE_HISTOGRAMS.items():
         n = 0
-        p50 = p99 = None
+        p50 = p99 = p999 = None
         for name in names:
             b, a = before[stage][name], after[stage][name]
             if a is None:
@@ -158,15 +160,20 @@ def _record_stage_breakdown(result: dict, key: str, before: dict) -> None:
             n += dn
             q50 = snapshot_delta_quantile(b, a, 0.5)
             q99 = snapshot_delta_quantile(b, a, 0.99)
+            q999 = snapshot_delta_quantile(b, a, 0.999)
             # multiple histograms per stage: keep the slower series'
             # percentile (an upper bound; exact merging would need raw
             # samples the registry deliberately doesn't retain)
             p50 = q50 * 1e3 if p50 is None else max(p50, q50 * 1e3)
             p99 = q99 * 1e3 if p99 is None else max(p99, q99 * 1e3)
+            p999 = q999 * 1e3 if p999 is None else max(p999, q999 * 1e3)
+        if n == 0:
+            continue
         stages[stage] = {
             "n": n,
             "p50_ms": None if p50 is None else round(p50, 3),
             "p99_ms": None if p99 is None else round(p99, 3),
+            "p999_ms": None if p999 is None else round(p999, 3),
         }
     result[key] = stages
 
@@ -571,6 +578,18 @@ def _measure(args, result: dict) -> None:
     quick = args.quick or args.tiny or (degraded and not args.force_full)
     if quick and not args.quick:
         log("degraded backend: shrinking to --quick config")
+    if args.macro_only:
+        # the CI smoke path (make bench-macro): only the open-loop
+        # macrobench, headline metric = the sweep's knee estimate
+        _macro_phase(result, quick, args.tiny)
+        macro = result["macro"]
+        result["metric"] = (
+            "open-loop macrobench goodput knee (offered op/s)"
+            + (" [DEGRADED: cpu]" if degraded else ""))
+        result["value"] = macro.get("knee_rps")
+        result["unit"] = "op/s"
+        result["vs_baseline"] = None
+        return
     if args.tiny:
         n_pods, n_users, n_ns, n_groups, n_rels = 200, 100, 10, 10, 3_000
         args.trials = min(args.trials, 5)
@@ -938,6 +957,18 @@ def _measure(args, result: dict) -> None:
             _admission_phase(result, quick)
         except Exception as ex:  # noqa: BLE001 - aux measurement only
             log(f"admission section failed (non-fatal): {ex}")
+
+    # -- open-loop trace-shaped macrobench (ROADMAP item 5) --
+    # Runs at EVERY scale including --tiny: the macro result schema is
+    # contract-test-pinned, and the sweep is the harness later
+    # engine-scaling PRs are judged against.
+    try:
+        _macro_phase(result, quick, args.tiny)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"macro section failed (non-fatal): {ex}")
 
     if args.remote_compare and not reprobe_backend(
             result, "remote-compare",
@@ -1404,6 +1435,501 @@ definition namespace {
     result["admission_max_shed_wait_ms"] = round(max_wait, 1)
 
 
+_MACRO_SCHEMA = """
+definition user {}
+definition group {
+  relation member: user
+}
+definition namespace {
+  relation viewer: user | user:* | group#member
+  permission view = viewer
+}
+"""
+
+_MACRO_RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata:
+  name: macro-ns-list-watch
+match:
+  - apiVersion: v1
+    resource: namespaces
+    verbs: [list, watch]
+prefilter:
+  - fromObjectIDNameExpr: "{{resourceId}}"
+    lookupMatchingResources:
+      tpl: "namespace:$#view@user:{{user.name}}"
+"""
+
+
+class _WatchStreamHarness:
+    """Concurrent watch streams through the fused watch hub, drivable
+    from loadgen worker THREADS: the hub and its watchers live on a
+    dedicated asyncio loop thread (the serving shape — the proxy's hub
+    runs on its event loop while engine work happens on executors).
+    ``open()`` registers one more stream; beyond ``max_streams`` the
+    oldest is recycled so a storm holds a bounded high-water population
+    instead of leaking forever."""
+
+    def __init__(self, engine, max_streams: int):
+        import asyncio
+
+        from spicedb_kubeapi_proxy_tpu.authz.watchhub import WatchHub
+        from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import (
+            parse_request_info,
+        )
+        from spicedb_kubeapi_proxy_tpu.rules.input import (
+            ResolveInput,
+            UserInfo,
+        )
+        from spicedb_kubeapi_proxy_tpu.rules.matcher import (
+            MapMatcher,
+            RequestMeta,
+        )
+
+        self.max_streams = max_streams
+        self.opened = 0
+        self._handles: list = []
+        self._info = parse_request_info("GET", "/api/v1/namespaces",
+                                        {"watch": ["true"]})
+        matcher = MapMatcher.from_yaml(_MACRO_RULES)
+        rules = matcher.match(RequestMeta.from_request(self._info))
+        self._pf = next(p for r in rules for p in r.pre_filters)
+        self._ResolveInput, self._UserInfo = ResolveInput, UserInfo
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="macro-watch-loop",
+            daemon=True)
+        self._thread.start()
+        self.hub = WatchHub(engine, poll_interval=0.02)
+
+    def open(self, user: str, timeout: float = 10.0) -> None:
+        import asyncio
+
+        fut = asyncio.run_coroutine_threadsafe(self._open(user),
+                                               self._loop)
+        fut.result(timeout=timeout)
+        self.opened += 1
+
+    async def _open(self, user: str) -> None:
+        input = self._ResolveInput.create(
+            self._info, self._UserInfo(name=user))
+        handle = await self.hub.register(self._pf, input)
+        self._handles.append(handle)
+        if len(self._handles) > self.max_streams:
+            await self.hub.unregister(self._handles.pop(0))
+
+    @property
+    def live_streams(self) -> int:
+        return len(self._handles)
+
+    def close(self) -> None:
+        import asyncio
+
+        async def teardown():
+            for h in self._handles:
+                try:
+                    await self.hub.unregister(h)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            self._handles.clear()
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                teardown(), self._loop).result(timeout=15)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+        async def cancel_stragglers():
+            # the hub's pump/source tasks wind down via unregister, but
+            # an in-flight wait may still be parked: cancel whatever is
+            # left so stopping the loop doesn't warn about pending tasks
+            me = asyncio.current_task()
+            rest = [t for t in asyncio.all_tasks() if t is not me]
+            for t in rest:
+                t.cancel()
+            await asyncio.gather(*rest, return_exceptions=True)
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                cancel_stragglers(), self._loop).result(timeout=5)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        if not self._thread.is_alive():
+            self._loop.close()  # release the selector/self-pipe fds
+
+
+def _macro_phase(result: dict, quick: bool, tiny: bool) -> None:
+    """The open-loop, trace-shaped macrobench (ROADMAP item 5): a mixed-
+    op workload (checks, bulk checks, list prefilters, Table filtering,
+    LookupSubjects, wildcard grants, write churn, watch streams through
+    the fused hub) fired on a Poisson-plus-bursts arrival schedule with
+    Zipf tenant skew, swept across offered-load multipliers of a probed
+    closed-loop capacity. Emits the goodput-vs-offered-load curve, a
+    knee estimate, per-class burst p99/p99.9, per-stage tail attribution
+    from the trace ring, and per-class SLO attainment into the result
+    JSON — the harness every engine-scaling PR after this one is judged
+    against."""
+    import hashlib
+
+    from spicedb_kubeapi_proxy_tpu.admission import (
+        BULK_CHECK,
+        CHECK,
+        LOOKUP_PREFILTER,
+        WATCH_RECOMPUTE,
+        WRITE_DTX,
+        AdmissionController,
+    )
+    from spicedb_kubeapi_proxy_tpu.authz.filterer import filter_body
+    from spicedb_kubeapi_proxy_tpu.authz.lookups import AllowedSet
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine
+    from spicedb_kubeapi_proxy_tpu.engine.store import WriteOp
+    from spicedb_kubeapi_proxy_tpu.loadgen import run_sweep
+    from spicedb_kubeapi_proxy_tpu.loadgen.schedule import (
+        OP_BULK_CHECK,
+        OP_CHECK,
+        OP_LIST_PREFILTER,
+        OP_LOOKUP_SUBJECTS,
+        OP_TABLE,
+        OP_WATCH_OPEN,
+        OP_WILDCARD,
+        OP_WRITE,
+        trace_shaped_config,
+    )
+    from spicedb_kubeapi_proxy_tpu.models import parse_schema
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+    from spicedb_kubeapi_proxy_tpu.obs.slo import (
+        SLOMonitor,
+        default_objectives,
+    )
+    from spicedb_kubeapi_proxy_tpu.obs.trace import tracer
+    from spicedb_kubeapi_proxy_tpu.rules.input import ResolveInput, UserInfo
+    from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import (
+        parse_request_info,
+    )
+
+    if tiny:
+        n_ns, n_users, n_groups = 120, 80, 8
+        table_rows, max_streams, dur, workers = 300, 64, 2.0, 16
+    elif quick:
+        n_ns, n_users, n_groups = 600, 300, 24
+        table_rows, max_streams, dur, workers = 1_200, 256, 3.0, 32
+    else:
+        n_ns, n_users, n_groups = 2_000, 800, 64
+        table_rows, max_streams, dur, workers = 5_000, 2_048, 5.0, 64
+    # workers sized to the host: on a 2-core CI box, 16+ jax-busy
+    # threads starve the dispatcher thread and every point reads late
+    # (generator noise, not server signal)
+    workers = max(4, min(workers, 4 * (os.cpu_count() or 4)))
+
+    rng = np.random.default_rng(11)
+    schema = parse_schema(_MACRO_SCHEMA)
+    cols = {k: [] for k in ("resource_type", "resource_id", "relation",
+                            "subject_type", "subject_id",
+                            "subject_relation")}
+
+    def add(rt, rid, rl, st, sid, srl=""):
+        m = len(rid)
+        cols["resource_type"].append(np.full(m, rt))
+        cols["resource_id"].append(np.asarray(rid))
+        cols["relation"].append(np.full(m, rl))
+        cols["subject_type"].append(np.full(m, st))
+        cols["subject_id"].append(np.asarray(sid))
+        cols["subject_relation"].append(np.full(m, srl))
+
+    nss = np.char.add("ns", np.arange(n_ns).astype(str))
+    users = np.char.add("u", np.arange(n_users).astype(str))
+    groups = np.char.add("g", np.arange(n_groups).astype(str))
+    m = 8 * n_ns
+    add("namespace", nss[rng.integers(n_ns, size=m)], "viewer",
+        "user", users[rng.integers(n_users, size=m)])
+    gm = 10 * n_groups
+    add("group", groups[rng.integers(n_groups, size=gm)], "member",
+        "user", users[rng.integers(n_users, size=gm)])
+    add("namespace", nss[rng.integers(n_ns, size=n_groups)], "viewer",
+        "group", groups, "member")
+    # the wildcard slice: ~2% of namespaces are public (user:*) — the
+    # still-unexercised grant form the mixed workload drives
+    n_wild = max(2, n_ns // 50)
+    wild_ns = nss[:n_wild]
+    add("namespace", wild_ns, "viewer", "user", np.full(n_wild, "*"))
+    e = Engine(schema=schema)
+    e.bulk_load({k: np.concatenate(v) for k, v in cols.items()})
+
+    # a Table response body at scale (rows named like the namespaces, so
+    # the allowed-set filter drops real rows), built once per run
+    table_body = json.dumps({
+        "kind": "Table", "apiVersion": "meta.k8s.io/v1",
+        "columnDefinitions": [{"name": "Name", "type": "string"}],
+        "rows": [{"cells": [f"ns{i}"],
+                  "object": {"metadata": {"name": f"ns{i}"}}}
+                 for i in range(table_rows)],
+    }).encode()
+    table_info = parse_request_info("GET", "/api/v1/namespaces", {})
+    table_input = ResolveInput.create(table_info, UserInfo(name="macro"))
+
+    # -- op table (the mixed workload) ---------------------------------------
+    def op_check(a):
+        e.check_bulk([CheckItem("namespace", f"ns{a.key % n_ns}", "view",
+                                "user", f"u{a.key % n_users}")])
+
+    def op_bulk(a):
+        e.check_bulk([CheckItem("namespace", f"ns{(a.key + j) % n_ns}",
+                                "view", "user", f"u{a.key % n_users}")
+                      for j in range(32)])
+
+    def op_list(a):
+        e.lookup_resources_mask("namespace", "view", "user",
+                                f"u{a.key % n_users}")
+
+    def op_table(a):
+        ids = e.lookup_resources("namespace", "view", "user",
+                                 f"u{a.key % n_users}")
+        allowed = AllowedSet()
+        for i in ids:
+            allowed.add("", i)
+        status, _body = filter_body(table_body, allowed, table_input)
+        assert status == 200
+
+    def op_lookup_subjects(a):
+        e.lookup_subjects("namespace", f"ns{a.key % n_ns}", "view",
+                          "user")
+
+    def op_wildcard(a):
+        # a public (user:*) namespace must admit ANY subject, including
+        # ones holding no direct tuples at all
+        ok = e.check_bulk([CheckItem(
+            "namespace", str(wild_ns[a.key % n_wild]), "view",
+            "user", f"ghost{a.key}")])[0]
+        assert ok, "wildcard grant failed"
+
+    def op_write(a):
+        e.write_relationships([WriteOp("touch", Relationship(
+            "namespace", f"ns{a.key % n_ns}", "viewer",
+            "user", f"u{(a.key * 7) % n_users}"))])
+
+    # the watch harness is ROTATED per sweep point (make_config below):
+    # streams opened at 0.5x must not ride along as recompute background
+    # load for the 3.5x point — each point's stream population is the
+    # one its own offered load built
+    harness_box = [_WatchStreamHarness(e, max_streams=max_streams)]
+    watch_opened = [0]
+
+    def op_watch(a):
+        harness_box[0].open(f"u{a.key % n_users}")
+        watch_opened[0] += 1
+
+    for op in (op_check, op_bulk, op_list, op_table, op_lookup_subjects,
+               op_wildcard, op_write):
+        op(type("A", (), {"key": 0})())  # warm every jit shape
+    ops_raw = {
+        OP_CHECK: op_check, OP_BULK_CHECK: op_bulk,
+        OP_LIST_PREFILTER: op_list, OP_TABLE: op_table,
+        OP_LOOKUP_SUBJECTS: op_lookup_subjects, OP_WILDCARD: op_wildcard,
+        OP_WRITE: op_write, OP_WATCH_OPEN: op_watch,
+    }
+
+    # -- capacity probe (closed loop) anchors the offered-load axis ----------
+    # The probe runs the REAL op mix (minus watch-open, which mutates
+    # the stream population): anchoring to a checks-only rate would put
+    # even the 0.5x sweep point past the knee of the heavier mixed
+    # workload, and the curve would have no healthy region at all.
+    import threading as _th
+
+    from spicedb_kubeapi_proxy_tpu.loadgen.schedule import DEFAULT_MIX
+
+    probe_ops = []
+    for name, w in DEFAULT_MIX.items():
+        fn = ops_raw[OP_CHECK if name == OP_WATCH_OPEN else name]
+        probe_ops.extend([fn] * max(1, round(w * 100)))
+
+    def closed_probe(dur_s: float, nthreads: int = 8) -> float:
+        stop = time.perf_counter() + dur_s
+        done = [0] * nthreads
+
+        def w(i):
+            k = i
+
+            class A:  # minimal arrival stand-in for the op table
+                key = 0
+
+            while time.perf_counter() < stop:
+                A.key = k
+                probe_ops[(k * 131) % len(probe_ops)](A)
+                done[i] += 1
+                k += nthreads
+
+        ts = [_th.Thread(target=w, args=(i,)) for i in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return sum(done) / dur_s
+
+    closed_probe(0.3)  # settle jit + index
+    cap_rps = closed_probe(0.8 if tiny else 1.5)
+    # base = 0.25x the probed mix capacity. The trace shape roughly
+    # 1.5x-es the average rate over the baseline (bursts), so the
+    # (0.5, 1, 2, 3.5) sweep spans ~0.2x..1.4x capacity on average with
+    # bursts transiently far past it — healthy points below the knee,
+    # genuine overload above, exactly the curve shape the knee estimator
+    # needs
+    base_rate = max(5.0, cap_rps * 0.25)
+    log(f"[macro] closed-loop mixed capacity ~{cap_rps:.0f} op/s at 8 "
+        f"threads; base offered rate {base_rate:.0f}/s")
+
+    # SLOs: anchored to the probed baseline, floored so CI jitter does
+    # not reclassify a healthy run (values recorded in the result)
+    slo_s = {
+        OP_CHECK: 0.05, OP_WILDCARD: 0.05, OP_BULK_CHECK: 0.15,
+        OP_LIST_PREFILTER: 0.15, OP_TABLE: 0.25,
+        OP_LOOKUP_SUBJECTS: 0.5, OP_WRITE: 0.25, OP_WATCH_OPEN: 0.5,
+    }
+
+    # one admission controller PER SWEEP POINT (rotated by make_config
+    # below): the AIMD limit a 1.4x-overload run ratchets down to must
+    # not leak into the next point's healthy-load measurement
+    ctrl_box = [None]
+
+    def fresh_ctrl():
+        ctrl_box[0] = AdmissionController(
+            initial_concurrency=16.0, min_concurrency=8.0,
+            max_concurrency=64.0, tenant_rate=cap_rps / 4,
+            tenant_burst=cap_rps * 2, tenant_depth=32, global_depth=256,
+            queue_timeout=0.25)
+
+    fresh_ctrl()
+    op_cls = {
+        OP_CHECK: CHECK, OP_WILDCARD: CHECK,
+        OP_BULK_CHECK: BULK_CHECK, OP_LOOKUP_SUBJECTS: BULK_CHECK,
+        OP_LIST_PREFILTER: LOOKUP_PREFILTER, OP_TABLE: LOOKUP_PREFILTER,
+        OP_WRITE: WRITE_DTX, OP_WATCH_OPEN: WATCH_RECOMPUTE,
+    }
+
+    from spicedb_kubeapi_proxy_tpu.obs.trace import tracer as _tracer
+
+    def admitted(name, fn):
+        # only the homogeneous single-check class feeds the AIMD
+        # limiter's latency probe (the engine-host round-6 rule): a
+        # mixed feed of 32-item bulks, full-mask lookups, and watch
+        # registrations reads op VARIETY as congestion and ratchets the
+        # limit to the floor under healthy load
+        observe = op_cls[name] is CHECK
+
+        def run(a):
+            with _tracer.span("admission_wait"):
+                ticket = ctrl_box[0].acquire(a.tenant, op_cls[name])
+            try:
+                fn(a)
+            finally:
+                ticket.release(observe=observe)
+        return run
+
+    ops = {name: admitted(name, fn) for name, fn in ops_raw.items()}
+
+    seed = 42
+    multipliers = (0.5, 1.0, 2.0, 3.5)
+    tenants = 6
+    peak_streams = [0]
+
+    def make_config(m):
+        fresh_ctrl()  # each point starts with an unratcheted limiter
+        peak_streams[0] = max(peak_streams[0],
+                              harness_box[0].live_streams)
+        harness_box[0].close()  # each point's own watch-stream population
+        harness_box[0] = _WatchStreamHarness(e, max_streams=max_streams)
+        return trace_shaped_config(dur, base_rate * m, tenants=tenants,
+                                   seed=seed, burst_multiplier=3.0)
+
+    from spicedb_kubeapi_proxy_tpu.loadgen import OpenLoopDriver
+    from spicedb_kubeapi_proxy_tpu.loadgen.schedule import build_schedule
+
+    # everything from the tracer reconfiguration on runs under ONE
+    # try/finally: _measure treats a macro failure as non-fatal, so a
+    # mid-phase exception must not leave the process-global tracer at
+    # sweep settings or leak the watch loop thread into later phases
+    prev = (tracer.sample, tracer.slow_s * 1e3,
+            tracer._shards[0][1].maxlen * tracer.RING_SHARDS)
+    monitor = None
+    try:
+        # tracing: tail-sampled ring sized for the sweep; slow/shed
+        # macro ops are always kept (the attribution evidence)
+        tracer.configure(sample=0.01, slow_ms=1e3 * min(slo_s.values()),
+                         ring=1024)
+
+        # warmup pass (discarded): every jit shape the mixed schedule
+        # can draw compiles here, not inside the first measured point
+        warm_cfg = trace_shaped_config(dur * 0.5, base_rate * 0.5,
+                                       tenants=tenants, seed=7,
+                                       burst_multiplier=3.0)
+        OpenLoopDriver(ops, max_workers=workers, slo_s=slo_s,
+                       trace_ops=False,
+                       drain_timeout=10.0).run(build_schedule(warm_cfg),
+                                               duration=warm_cfg.duration)
+
+        # the warmup also drove op_watch: reset the counter AND rotate
+        # the harness so the recorded stats (opened, live peak) cover
+        # only the measured sweep
+        watch_opened[0] = 0
+        peak_streams[0] = 0
+        harness_box[0].close()
+        harness_box[0] = _WatchStreamHarness(e, max_streams=max_streams)
+
+        monitor = SLOMonitor(default_objectives(), windows=(30.0, 120.0),
+                             tick_seconds=0.5)
+        monitor.start()
+        sweep = run_sweep(
+            make_config,
+            ops, multipliers, slo_s, max_workers=workers,
+            trace_ops=True, drain_timeout=(8.0 if tiny else 15.0),
+            on_point=lambda p: log(
+                f"[macro x{p.multiplier}] offered={p.offered_rps:.0f}/s "
+                f"completed={p.completed_rps:.0f}/s "
+                f"goodput={p.goodput_rps:.0f}/s shed={p.shed_n} "
+                f"err={p.error_n} late={p.late_n}"))
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        peak_streams[0] = max(peak_streams[0],
+                              harness_box[0].live_streams)
+        harness_box[0].close()
+        tracer.configure(sample=prev[0], slow_ms=prev[1], ring=prev[2])
+
+    top_cfg = trace_shaped_config(dur, base_rate * multipliers[-1],
+                                  tenants=tenants, seed=seed,
+                                  burst_multiplier=3.0)
+    digest = hashlib.sha256(repr([
+        (round(a.t, 9), a.op, a.tenant, a.key, a.phase)
+        for a in build_schedule(top_cfg)]).encode()).hexdigest()[:16]
+
+    macro = sweep.to_dict()
+    macro["seed"] = seed
+    macro["schedule_digest"] = digest
+    macro["capacity_rps"] = round(cap_rps, 1)
+    macro["base_rate_rps"] = round(base_rate, 1)
+    macro["slo_ms"] = {k: round(v * 1e3, 1) for k, v in slo_s.items()}
+    macro["watch_streams_opened"] = watch_opened[0]
+    macro["watch_streams_peak"] = peak_streams[0]
+    macro["slo_monitor"] = {
+        o["name"]: {
+            "burn_rate": o["windows"]["30s"]["burn_rate"],
+            "attainment": o["windows"]["30s"]["attainment"],
+        }
+        for o in monitor.status()["objectives"]
+    }
+    result["macro"] = macro
+    knee_txt = ("~" if sweep.knee_saturated else ">= ") + (
+        f"{sweep.knee_rps:.0f}" if sweep.knee_rps is not None else "?")
+    log(f"[macro] knee {knee_txt} op/s offered"
+        f"{'' if sweep.knee_saturated else ' (never reached)'}; "
+        f"attainment {sweep.slo_attainment}; "
+        f"{watch_opened[0]} watch streams opened "
+        f"(tail attribution: {sweep.tail_attribution.get('burst')} "
+        f"burst, {sweep.tail_attribution.get('traces', 0)} traces)")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -1415,6 +1941,9 @@ def main() -> None:
                          "(CPU) backend")
     ap.add_argument("--suite", action="store_true",
                     help="also run BASELINE eval configs 3-5")
+    ap.add_argument("--macro-only", action="store_true",
+                    help="run ONLY the open-loop macrobench sweep "
+                         "(make bench-macro smoke; headline = knee)")
     ap.add_argument("--trials", type=int, default=21)
     ap.add_argument("--retries", type=int, default=2,
                     help="TPU probe attempts before CPU fallback")

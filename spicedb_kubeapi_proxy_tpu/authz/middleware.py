@@ -164,6 +164,24 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
         # (breaker open, deadline, leaderless engine) flags "error"
         if isinstance(e, AdmissionRejected):
             tracer.flag("shed")
+            # sheds never reach a verdict, so the decision audit would
+            # otherwise disagree with the trace ring about this request
+            # ever existing: emit the rate-capped shed line here, the
+            # ONE place every admission rejection funnels through
+            if deps.audit is not None:
+                try:
+                    deps.audit.shed(
+                        op_class=e.op_class,
+                        tenant=(req.user.name if req.user else ""),
+                        verb=(req.request_info.verb
+                              if req.request_info else req.method),
+                        resource=(req.request_info.resource
+                                  if req.request_info else ""),
+                        retry_after=e.retry_after,
+                        reason=e.reason,
+                        trace_id=tracer.current_trace_id())
+                except Exception:  # noqa: BLE001 - audit never gates
+                    metrics.counter("audit_write_errors_total").inc()
         else:
             tracer.flag("error", str(e))
         metrics.counter("proxy_dependency_unavailable_total",
@@ -172,6 +190,15 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
             503, f"dependency {e.dependency} unavailable: {e}",
             "ServiceUnavailable")
         resp.headers["Retry-After"] = str(max(1, int(e.retry_after + 0.5)))
+        # these early rejects return BEFORE the root span's normal finish
+        # path stamps headers, and some callers (in-memory transports,
+        # tests) invoke authorize() without the server's root-span
+        # wrapper at all — stamp the trace id HERE so a shed/breaker 503
+        # is always followable from the client into /debug/traces
+        # (server.handle's setdefault then keeps this value)
+        trace_id = tracer.current_trace_id()
+        if trace_id is not None:
+            resp.headers.setdefault("X-Trace-Id", trace_id)
         return resp
 
 

@@ -619,6 +619,43 @@ class Engine:
             subject_relation, now=now)
         return mask_to_ids(mask, interner)
 
+    def lookup_subjects(self, resource_type: str, resource_id: str,
+                        permission: str, subject_type: str,
+                        subject_relation: Optional[str] = None,
+                        now: Optional[float] = None,
+                        chunk: int = 4096) -> list[str]:
+        """LookupSubjects: which subjects of ``subject_type`` hold
+        ``permission`` on one resource — the reverse of
+        :meth:`lookup_resources` (reference LookupSubjects RPC; the
+        reconcile/debug shape "who can see this namespace?").
+
+        Evaluated as bulk checks over the store's KNOWN subject universe
+        (every distinct ``subject_type`` subject id appearing in any
+        relationship): the forward fixpoint batches subjects along B
+        already, so a reverse walk buys nothing a chunked bulk check
+        doesn't, and checks honor wildcard grants — a ``user:*`` tuple
+        makes every known subject pass. Wildcards are reported as the
+        checks resolve them (concrete ids), never as a literal ``'*'``
+        row. Sorted for determinism."""
+        from .store import RelationshipFilter
+
+        cands = sorted({
+            rel.subject_id
+            for rel in self.read_relationships(
+                RelationshipFilter(subject_type=subject_type))
+            if rel.subject_id != "*"
+        })
+        out: list[str] = []
+        for i in range(0, len(cands), chunk):
+            part = cands[i:i + chunk]
+            got = self.check_bulk(
+                [CheckItem(resource_type, resource_id, permission,
+                           subject_type, sid, subject_relation)
+                 for sid in part], now=now)
+            out.extend(sid for sid, ok in zip(part, got) if ok)
+        metrics.counter("engine_lookup_subjects_total").inc()
+        return out
+
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,
                               subject_relation: Optional[str] = None,

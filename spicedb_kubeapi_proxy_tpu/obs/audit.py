@@ -49,16 +49,25 @@ class AuditLog:
     QUEUE_DEPTH = 4096
 
     def __init__(self, dest: str, allow_rps: float = 10.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, shed_rps: Optional[float] = None):
         self.dest = dest
         self.allow_rps = float(allow_rps)
+        # sheds get their own budget (default: the allow cap): an
+        # overload sheds thousands/second by design, and the audit log
+        # must record that it HAPPENED (agreeing with the trace ring on
+        # every rejection path) without becoming a traffic mirror of the
+        # very storm being shed
+        self.shed_rps = float(allow_rps if shed_rps is None else shed_rps)
         self._clock = clock
         self._lock = threading.Lock()
         # burst = one second of allowance (min 1: a single allow must
         # always be loggable)
         self._burst = max(1.0, self.allow_rps)
         self._tokens = self._burst
+        self._shed_burst = max(1.0, self.shed_rps)
+        self._shed_tokens = self._shed_burst
         self._last = clock()
+        self._shed_last = clock()
         if dest == "stderr":
             self._fh = sys.stderr
             self._owns = False
@@ -95,6 +104,50 @@ class AuditLog:
                 self._tokens -= 1.0
                 return True
             return False
+
+    def _take_shed(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._shed_tokens = min(
+                self._shed_burst,
+                self._shed_tokens + (now - self._shed_last) * self.shed_rps)
+            self._shed_last = now
+            if self._shed_tokens >= 1.0:
+                self._shed_tokens -= 1.0
+                return True
+            return False
+
+    def shed(self, *, op_class: str, tenant: str = "", verb: str = "",
+             resource: str = "", retry_after: float = 0.0,
+             reason: str = "", trace_id: Optional[str] = None) -> None:
+        """One rate-capped line per admission shed — the rejection paths
+        that never reach a verdict (so :meth:`decision` never sees them)
+        still leave an audit record agreeing with the trace ring:
+        ``{"decision": "shed", "class": .., "tenant": .., "retry_after":
+        .., "trace_id": ..}``. Capped-out sheds are counted
+        (``audit_sheds_sampled_out_total``), not logged."""
+        if not self._take_shed():
+            metrics.counter("audit_sheds_sampled_out_total").inc()
+            return
+        rec = {
+            "ts": datetime.now(timezone.utc).isoformat(
+                timespec="milliseconds"),
+            "decision": "shed",
+            "class": op_class,
+            "tenant": tenant,
+            "verb": verb,
+            "resource": resource,
+            "retry_after": round(float(retry_after), 3),
+            "reason": reason,
+            "trace_id": trace_id,
+        }
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        try:
+            self._q.put_nowait(line)
+        except queue.Full:
+            metrics.counter("audit_dropped_total").inc()
+            return
+        metrics.counter("audit_decisions_total", decision="shed").inc()
 
     def decision(self, *, allow: bool, verb: str = "", resource: str = "",
                  subresource: str = "", namespace: str = "", name: str = "",

@@ -40,6 +40,8 @@ def main(argv=None) -> int:
         await stop.wait()
         await cfg.server.stop()
         await cfg.workflow.shutdown()
+        if cfg.slo_monitor is not None:
+            cfg.slo_monitor.stop()
         if cfg.deps.audit is not None:
             # drain + close the audit writer queue: the decisions
             # nearest a shutdown (deny storms before a crash-loop) are

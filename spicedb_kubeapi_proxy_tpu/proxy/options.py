@@ -258,6 +258,16 @@ class Options:
     # always, allows rate-capped at audit_allow_rps lines/second.
     audit_log: Optional[str] = None
     audit_allow_rps: float = 10.0
+    # live SLO monitor (obs/slo.py): "class=latency_ms:target_pct" list
+    # ("check=25:99.9,lookup=100:99"); None = monitor off unless
+    # enable_debug_slo turns it on with the default objective set.
+    # Burn rates are computed over slo_windows (seconds), sampled every
+    # slo_tick_seconds, exposed as slo_* metrics and (flag-gated,
+    # authenticated) at /debug/slo.
+    slo_objectives: Optional[str] = None
+    slo_windows: str = "60,300,3600"
+    slo_tick_seconds: float = 5.0
+    enable_debug_slo: bool = False
 
     def _parse_remote(self) -> Optional[list[tuple[str, int]]]:
         """[(host, port), ...] for tcp:// endpoints, None otherwise;
@@ -395,6 +405,29 @@ class Options:
             raise OptionsError("trace-ring must be >= 1")
         if self.audit_allow_rps <= 0:
             raise OptionsError("audit-allow-rps must be > 0")
+        if self.slo_objectives:
+            from ..obs.slo import SLOError, parse_objectives
+
+            try:
+                parse_objectives(self.slo_objectives)
+            except SLOError as e:
+                raise OptionsError(str(e)) from None
+        if self.slo_objectives or self.enable_debug_slo:
+            try:
+                windows = [float(w) for w in
+                           self.slo_windows.split(",") if w.strip()]
+            except ValueError:
+                windows = []
+            if not windows or any(w <= 0 for w in windows):
+                raise OptionsError(
+                    "slo-windows must be a comma list of seconds > 0")
+            if self.slo_tick_seconds <= 0:
+                raise OptionsError("slo-tick-seconds must be > 0")
+            if self.slo_tick_seconds > min(windows):
+                raise OptionsError(
+                    "slo-tick-seconds must not exceed the shortest "
+                    "slo-window (a window sampled less than once per "
+                    "span would be blind)")
         if self.authz_cache_size < 1:
             raise OptionsError("authz-cache-size must be >= 1")
         if self.authz_cache_mask_bytes < 0:
@@ -626,6 +659,22 @@ class Options:
         if self.audit_log:
             audit = AuditLog(self.audit_log,
                              allow_rps=self.audit_allow_rps)
+        slo_monitor = None
+        if self.slo_objectives or self.enable_debug_slo:
+            from ..obs.slo import (
+                SLOMonitor,
+                default_objectives,
+                parse_objectives,
+            )
+
+            objectives = (parse_objectives(self.slo_objectives)
+                          if self.slo_objectives else default_objectives())
+            slo_monitor = SLOMonitor(
+                objectives,
+                windows=[float(w) for w in self.slo_windows.split(",")
+                         if w.strip()],
+                tick_seconds=self.slo_tick_seconds)
+            slo_monitor.start()
         deps = AuthzDeps(
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
@@ -685,8 +734,11 @@ class Options:
                         requestheader_allowed_names=tuple(
                             self.tls_requestheader_allowed_names),
                         token_authenticator=token_authenticator,
-                        enable_debug_traces=self.enable_debug_traces)
-        return CompletedConfig(self, engine, workflow, deps, server)
+                        enable_debug_traces=self.enable_debug_traces,
+                        slo_monitor=slo_monitor,
+                        enable_debug_slo=self.enable_debug_slo)
+        return CompletedConfig(self, engine, workflow, deps, server,
+                               slo_monitor)
 
     # fields safe to expose on /debug/config — an ALLOWLIST so a future
     # credential-bearing Options field fails safe (omitted) instead of
@@ -710,6 +762,8 @@ class Options:
         "admission_queue_timeout",
         "trace_sample", "trace_slow_ms", "trace_ring",
         "enable_debug_traces", "audit_log", "audit_allow_rps",
+        "slo_objectives", "slo_windows", "slo_tick_seconds",
+        "enable_debug_slo",
     )
 
     def debug_dump(self) -> dict:
@@ -728,6 +782,7 @@ class CompletedConfig:
     workflow: WorkflowEngine
     deps: AuthzDeps
     server: Server
+    slo_monitor: Optional[object] = None
 
     async def run(self) -> None:
         """Start serving: resume pending dual-writes, listen, serve
@@ -994,6 +1049,22 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "always, allows rate-capped; see "
                              "docs/operations.md for the line schema). "
                              "Unset = no audit log")
+    parser.add_argument("--slo-objectives", default=None,
+                        help="declared SLOs as class=latency_ms:target_pct "
+                             "(comma list, e.g. "
+                             "'check=25:99.9,lookup=100:99'); enables the "
+                             "live burn-rate monitor and the slo_* metric "
+                             "family. Unset + no --enable-debug-slo = "
+                             "monitor off")
+    parser.add_argument("--slo-windows", default="60,300,3600",
+                        help="burn-rate windows in seconds (comma list)")
+    parser.add_argument("--slo-tick-seconds", type=float, default=5.0,
+                        help="SLO monitor sampling cadence")
+    parser.add_argument("--enable-debug-slo", action="store_true",
+                        help="serve the (authenticated) /debug/slo "
+                             "objective/burn-rate report; implies the "
+                             "monitor with default objectives when "
+                             "--slo-objectives is unset")
     parser.add_argument("--audit-allow-rps", type=float, default=10.0,
                         help="rate cap for ALLOW audit lines per second "
                              "(denies are never capped)")
@@ -1076,4 +1147,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         enable_debug_traces=args.enable_debug_traces,
         audit_log=args.audit_log,
         audit_allow_rps=args.audit_allow_rps,
+        slo_objectives=args.slo_objectives,
+        slo_windows=args.slo_windows,
+        slo_tick_seconds=args.slo_tick_seconds,
+        enable_debug_slo=args.enable_debug_slo,
     )

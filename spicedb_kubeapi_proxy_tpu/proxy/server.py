@@ -40,7 +40,8 @@ MAX_BODY = 64 * 1024 * 1024
 # fixed infra endpoints that never open a trace: probe/scrape cadence
 # would otherwise cycle real request traces out of the bounded ring
 _UNTRACED_PATHS = frozenset({
-    "/livez", "/readyz", "/metrics", "/debug/traces", "/debug/config"})
+    "/livez", "/readyz", "/metrics", "/debug/traces", "/debug/config",
+    "/debug/slo"})
 
 
 class Server:
@@ -55,7 +56,9 @@ class Server:
                  client_ca_configured: bool = False,
                  requestheader_allowed_names: tuple = (),
                  token_authenticator=None,
-                 enable_debug_traces: bool = False):
+                 enable_debug_traces: bool = False,
+                 slo_monitor=None,
+                 enable_debug_slo: bool = False):
         self.deps = deps
         self.authenticator = authenticator or HeaderAuthenticator()
         self.cert_authenticator = ClientCertAuthenticator()
@@ -81,6 +84,10 @@ class Server:
         # subjects' request paths and timings, so the endpoint is opt-in
         # (--enable-debug-traces) on top of authentication
         self.enable_debug_traces = enable_debug_traces
+        # live SLO monitor (obs/slo.py); /debug/slo posture mirrors
+        # /debug/traces — flag-gated on top of authentication
+        self.slo_monitor = slo_monitor
+        self.enable_debug_slo = enable_debug_slo
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()  # live connection-handler tasks
 
@@ -269,6 +276,22 @@ class Server:
             return ProxyResponse(
                 status=200, headers={"Content-Type": "application/json"},
                 body=_json.dumps({"traces": traces}).encode())
+        if req.path == "/debug/slo":
+            # flag-gated AND authenticated: declared objectives +
+            # multi-window burn rates, fresh-sampled so an operator
+            # debugging an alert reads NOW, not the last tick
+            if not self.enable_debug_slo or self.slo_monitor is None:
+                return kube_status(
+                    404, "SLO endpoint disabled "
+                         "(--enable-debug-slo, --slo-objectives)",
+                    "NotFound")
+            import json as _json
+
+            mon = self.slo_monitor
+            await asyncio.to_thread(mon.tick)
+            return ProxyResponse(
+                status=200, headers={"Content-Type": "application/json"},
+                body=_json.dumps(mon.status()).encode())
         if req.path == "/debug/config":
             # flag-gated (Options.enable_debug_config) AND authenticated:
             # the dump is allowlisted, but config topology still doesn't

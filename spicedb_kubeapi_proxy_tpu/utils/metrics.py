@@ -79,13 +79,17 @@ class Histogram:
                     return
             self.counts[-1] += 1
 
-    def quantile(self, q: float) -> float:
-        """Approximate quantile from bucket counts (upper bound). A target
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate quantile from bucket counts (upper bound) — any
+        ``q`` including deep tails (p99.9 = ``quantile(0.999)``). A target
         landing in the overflow bucket clamps to the LARGEST OBSERVED
-        value, never infinity — bench p99 fields must stay finite JSON."""
+        value, never infinity — bench p99/p99.9 fields must stay finite
+        JSON. An EMPTY histogram returns ``None``: a window that saw no
+        observations has no percentile, and 0.0 would read as "infinitely
+        fast" in a latency curve."""
         with self._lock:
             if self.n == 0:
-                return 0.0
+                return None
             target = q * self.n
             acc = 0
             for i, b in enumerate(self.buckets):
@@ -134,15 +138,21 @@ class Registry:
                 h = self._hists[key] = Histogram(buckets)
             return h
 
-    def hist_snapshot(self, name: str) -> Optional[dict]:
+    def hist_snapshot(self, name: str, **labels) -> Optional[dict]:
         """One merged :meth:`Histogram.snapshot` across every label set
         registered under ``name`` (or ``None`` when nothing is). Bench
         stage breakdowns aggregate over labels (e.g. per-dependency
         latency series) — label sets with differing bucket layouts keep
         the first layout and drop the rest, which cannot happen for
-        same-name histograms registered through this module's defaults."""
+        same-name histograms registered through this module's defaults.
+        ``labels`` restricts the merge to label sets CONTAINING every
+        given pair (how the SLO monitor reads one op class out of a
+        shared family, e.g. ``hist_snapshot("loadgen_op_seconds",
+        op="check")``)."""
+        want = set(labels.items())
         with self._lock:
-            hs = [h for key, h in self._hists.items() if key[0] == name]
+            hs = [h for key, h in self._hists.items()
+                  if key[0] == name and want <= set(key[1:])]
         merged: Optional[dict] = None
         for h in hs:
             s = h.snapshot()

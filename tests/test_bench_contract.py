@@ -48,15 +48,16 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     assert "hung plugin" in out["backend_error"]
     assert out["value"] is not None and out["value"] > 0
     assert "[DEGRADED: cpu]" in out["metric"]
-    # per-stage breakdown (ISSUE 6): every stage key serializes, counts
-    # are ints, percentiles are finite numbers or null — never Infinity
+    # per-stage breakdown (ISSUE 6/7): stages with NO samples in the
+    # window are omitted entirely; recorded stages have int counts >= 1
+    # and finite-or-null percentiles including p99.9 — never Infinity
     # (json.loads above already rejects bare Infinity-producing bugs at
     # the parse level only for NaN-strict parsers, so check explicitly)
     stages = out["stages"]
-    assert set(stages) == {"admission_wait", "device", "upstream"}
+    assert set(stages) <= {"admission_wait", "device", "upstream"}
     for st in stages.values():
-        assert isinstance(st["n"], int)
-        for k in ("p50_ms", "p99_ms"):
+        assert isinstance(st["n"], int) and st["n"] >= 1
+        for k in ("p50_ms", "p99_ms", "p999_ms"):
             v = st[k]
             assert v is None or (isinstance(v, (int, float))
                                  and v == v and abs(v) != float("inf"))
@@ -64,6 +65,77 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     # samples and real percentiles
     assert stages["device"]["n"] > 0
     assert stages["device"]["p50_ms"] is not None
+    _assert_macro_schema(out["macro"])
+
+
+def _assert_macro_schema(macro: dict) -> None:
+    """The ISSUE 7 macro-phase contract: goodput-vs-offered-load curve
+    with >= 4 points, a knee estimate, burst p99.9 per op class, per-
+    stage tail attribution for the worst burst window, SLO attainment,
+    and the reproducibility pin (seed + schedule digest)."""
+    curve = macro["curve"]
+    assert len(curve) >= 4
+    for pt in curve:
+        assert {"multiplier", "offered_rps", "completed_rps",
+                "goodput_rps", "shed", "errors", "late",
+                "classes"} <= set(pt)
+        assert pt["offered_rps"] > 0
+        for q in pt["classes"].values():
+            for k, v in q.items():
+                assert k in ("p50_ms", "p99_ms", "p999_ms")
+                assert isinstance(v, (int, float)) and v == v \
+                    and abs(v) != float("inf")
+    # offered load is monotone in the multiplier (open loop: the server
+    # cannot flatten it)
+    offered = [pt["offered_rps"] for pt in curve]
+    assert offered == sorted(offered)
+    assert isinstance(macro["knee_saturated"], bool)
+    assert macro["knee_rps"] is None or macro["knee_rps"] > 0
+    # burst windows with exact per-class tails including p99.9
+    assert set(macro["bursts"]) == {"watch-storm", "get-wave",
+                                    "reconcile"}
+    assert any(b["classes"] for b in macro["bursts"].values())
+    for b in macro["bursts"].values():
+        for st in b["classes"].values():
+            assert st["n"] >= 1
+            assert st["p999_ms"] >= st["p99_ms"] >= st["p50_ms"] >= 0
+    # tail attribution names the worst burst and splits its stage time
+    ta = macro["tail_attribution"]
+    assert ta["burst"] in macro["bursts"]
+    if ta["traces"] > 0:
+        assert ta["stages_us"]
+        if any(ta["stages_us"].values()):
+            assert sum(ta["stage_share"].values()) == pytest.approx(
+                1.0, abs=0.05)
+    assert macro["slo_attainment"]
+    for v in macro["slo_attainment"].values():
+        assert v is None or 0.0 <= v <= 1.0
+    assert macro["slo_monitor"]
+    # reproducibility pin: the recorded seed + the digest of the top
+    # point's REBUILT schedule (identical seed => identical schedule)
+    assert isinstance(macro["seed"], int)
+    assert isinstance(macro["schedule_digest"], str)
+    assert len(macro["schedule_digest"]) == 16
+    int(macro["schedule_digest"], 16)
+    assert macro["watch_streams_opened"] >= 0
+    assert macro["capacity_rps"] > 0 and macro["base_rate_rps"] > 0
+
+
+def test_macro_only_headline_is_knee():
+    """`bench.py --tiny --macro-only` (the make bench-macro smoke): only
+    the sweep runs, the headline metric is the knee estimate, and the
+    macro schema holds."""
+    p = subprocess.run(
+        [sys.executable, BENCH, "--tiny", "--macro-only",
+         "--probe-timeout", "10", "--retries", "1"],
+        env=_env("echo cpu"), capture_output=True, text=True, timeout=280)
+    out = _parse_only_line(p.stdout)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "macrobench goodput knee" in out["metric"]
+    assert out["unit"] == "op/s"
+    _assert_macro_schema(out["macro"])
+    # macro-only really skipped the closed-loop phases
+    assert "checks_per_s_per_chip" not in out
 
 
 def test_sigterm_flushes_partial_json():

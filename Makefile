@@ -29,8 +29,13 @@ bench-quick:
 	$(PY) bench.py --quick
 
 # CI-sized bench exercising the full hot path including the decision
-# cache's repeat-traffic phase (cold vs warm p50 + hit rate on stderr)
-bench-smoke: bench-quick
+# cache's repeat-traffic phase (cold vs warm p50 + hit rate on stderr),
+# gated by the write-path regression check: zero recompiles under
+# steady-state churn and read-after-write p50 within a fixed RATIO of
+# the same run's read-only p50 (relative, so any backend speed works;
+# the pre-overlay seed sat at 2.16x — tools/write_path_gate.py)
+bench-smoke:
+	$(PY) bench.py --quick | $(PY) tools/write_path_gate.py
 
 # open-loop macrobench smoke: ONLY the trace-shaped offered-load sweep
 # at --tiny scale (seconds, not minutes) — proves the goodput curve,

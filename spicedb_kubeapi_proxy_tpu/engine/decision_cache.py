@@ -228,6 +228,36 @@ class DecisionCache:
         metrics.gauge("engine_decision_cache_entries").dec(dropped)
         metrics.gauge("engine_decision_cache_mask_bytes").dec(freed)
 
+    def retire_below(self, revision: int) -> int:
+        """Drop every entry keyed at a revision below ``revision``.
+
+        Keys embed the store revision (``key[1]``), so entries of
+        superseded revisions can never be probed again — under sustained
+        write churn they would otherwise squat in the LRU until budget
+        eviction, displacing live entries. Probing is revision-exact, so
+        this sweep can never change an answer; the background compactor
+        runs it at fold cadence (compaction.py) — amortized, never on
+        the serving path. Entries AT ``revision`` survive: a compaction
+        swap preserves the revision, so their keys stay exactly valid
+        across it. Returns the number of entries dropped."""
+        revision = int(revision)
+        dropped = 0
+        freed = 0
+        for sh in self._shards:
+            with sh.lock:
+                dead = [k for k in sh.entries if k[1] < revision]
+                for k in dead:
+                    _, _, nb = sh.entries.pop(k)
+                    sh.mask_bytes -= nb
+                    freed += nb
+                dropped += len(dead)
+        if dropped:
+            metrics.counter("engine_decision_cache_retired_total").inc(
+                dropped)
+            metrics.gauge("engine_decision_cache_entries").dec(dropped)
+            metrics.gauge("engine_decision_cache_mask_bytes").dec(freed)
+        return dropped
+
     def stats(self) -> dict:
         with_entries = sum(len(sh.entries) for sh in self._shards)
         return {

@@ -25,7 +25,9 @@ from ..obs.profile import install_jax_compile_hook
 from ..obs.trace import tracer
 from ..ops.reachability import (
     CompiledGraph,
+    DELTA_CAPACITY,
     MAX_DELTA_RECORDS,
+    _fallback,
     compile_graph,
     incremental_update,
 )
@@ -114,7 +116,7 @@ class Engine:
     def __init__(self, bootstrap: Optional[str] = None,
                  schema: Optional[Schema] = None,
                  validate_writes: bool = True,
-                 mesh=None):
+                 mesh=None, delta_capacity: int = DELTA_CAPACITY):
         if schema is None:
             b: Bootstrap = parse_bootstrap(bootstrap or DEFAULT_BOOTSTRAP)
             schema = b.schema
@@ -129,6 +131,15 @@ class Engine:
         self._batcher = None
         self._decision_cache: Optional[DecisionCache] = None
         self._persistence = None  # persistence/manager.py, opt-in
+        # delta-overlay sizing for every graph this engine compiles, and
+        # the optional background compactor (engine/compaction.py) that
+        # folds the overlay into a fresh base off the write path
+        self._delta_capacity = max(int(delta_capacity), 64)
+        self._compactor = None
+        # (base revision, store revision) pair the incremental path
+        # declined at write time — the read path must not retry (and
+        # re-count) the identical suffix; any further write resets it
+        self._incremental_declined: Optional[tuple] = None
         # host-side (q_slots, q_batch) arrays per (offset, size): a mask
         # lookup's query arrays are a pure function of the slot layout, so
         # rebuilding 2x400KB of arange/zeros per request is waste (their
@@ -214,6 +225,41 @@ class Engine:
     def persistence(self):
         return self._persistence
 
+    def enable_compaction(self, threshold: float = 0.75,
+                          delta_capacity: Optional[int] = None):
+        """Start the background overlay compactor (engine/compaction.py):
+        a worker thread folds the accumulated delta tail into a fresh
+        double-buffered compiled base off the write path and swaps it
+        atomically at a recorded revision, and the write path sheds with
+        a bounded Retry-After (:class:`~.compaction.OverlayBackpressure`)
+        instead of letting overlay overflow force a synchronous full
+        recompile onto the next fully-consistent read. ``threshold`` is
+        the overlay-occupancy fraction that wakes the worker;
+        ``delta_capacity`` resizes the overlay for graphs compiled from
+        now on (``--delta-capacity``)."""
+        from .compaction import Compactor
+
+        with self._lock:
+            if self._compactor is not None:
+                raise RuntimeError("compaction is already enabled")
+            if delta_capacity is not None:
+                self._delta_capacity = max(int(delta_capacity), 64)
+            self._compactor = Compactor(self, threshold)
+        return self._compactor
+
+    def close_compaction(self, drain: bool = False) -> None:
+        """Stop the compactor worker (``drain=True`` folds once more
+        first); writes stop shedding and overlay overflow reverts to the
+        synchronous-recompile fallback."""
+        with self._lock:
+            c, self._compactor = self._compactor, None
+        if c is not None:
+            c.close(drain=drain)
+
+    @property
+    def compactor(self):
+        return self._compactor
+
     # -- write path ---------------------------------------------------------
 
     def _validate(self, rel: Relationship) -> None:
@@ -282,15 +328,73 @@ class Engine:
                 )
 
     def write_relationships(self, ops: list[WriteOp],
-                            preconditions: list[Precondition] = ()) -> int:
+                            preconditions: list[Precondition] = (),
+                            *, _headroom: bool = True) -> int:
         if self.validate_writes:
             for op in ops:
                 self._validate(op.rel)
-        return self.store.write(list(ops), list(preconditions))
+        if _headroom:
+            self._write_headroom(len(ops))
+        rev = self.store.write(list(ops), list(preconditions))
+        self._advance_incremental()
+        return rev
 
     def delete_relationships(self, f: RelationshipFilter,
-                             preconditions: list[Precondition] = ()) -> int:
-        return self.store.delete_by_filter(f, list(preconditions))
+                             preconditions: list[Precondition] = (),
+                             *, _headroom: bool = True) -> int:
+        # filter cardinality is unknown pre-scan: charge one record's
+        # headroom (deletes mostly reuse overlay slots / the dead ledger;
+        # a huge filter delete overflowing the ledger still falls back to
+        # a counted full recompile, it just isn't shed preemptively)
+        if _headroom:
+            self._write_headroom(1)
+        n = self.store.delete_by_filter(f, list(preconditions))
+        self._advance_incremental()
+        return n
+
+    def _write_headroom(self, n_records: int) -> None:
+        """Back-pressure gate run BEFORE any store mutation: when the
+        compactor is enabled and the current overlay cannot absorb the
+        write, shed with :class:`~.compaction.OverlayBackpressure`
+        (bounded Retry-After) instead of letting the next read pay a
+        synchronous full recompile. A shed write leaves no trace —
+        nothing journaled, replicated, or applied — so retrying is always
+        safe."""
+        c = self._compactor
+        if c is not None:
+            c.check_headroom(self._compiled, n_records)
+
+    def _advance_incremental(self) -> None:
+        """Eagerly fold the write just applied into the compiled graph —
+        an O(write) overlay append — so the write path itself keeps the
+        graph current and the next fully-consistent read dispatches
+        immediately. Never compiles: when the incremental path declines
+        (layout growth, stratification inversion, overflow), the decline
+        is counted and the read path's fallback recompile — or the
+        background compactor, when enabled — picks it up."""
+        with self._lock:
+            cur = self._compiled
+            if cur is None or cur.revision == self.store.revision:
+                return
+            inc = self._try_incremental(cur)
+            if inc is not None:
+                self._compiled = inc
+                self._publish_graph_gauges(inc)
+                c = self._compactor
+                if c is not None:
+                    c.notify(inc)
+            else:
+                # remember the exact (base, store) revision pair that
+                # declined: the read path retrying the same suffix would
+                # re-run the whole planning scan, fail identically, and
+                # double-count the fallback reason
+                self._incremental_declined = (cur.revision,
+                                              self.store.revision)
+                if self._compactor is not None:
+                    # the overlay could not express this write: fold in
+                    # the background so the serving path meets a fresh
+                    # base instead of recompiling synchronously
+                    self._compactor.request()
 
     def read_relationships(self, f: RelationshipFilter) -> Iterator[Relationship]:
         return self.store.read(f)
@@ -309,6 +413,15 @@ class Engine:
                 for tid, it in self.store.objects.items()
             }
 
+    def _publish_graph_gauges(self, cg: CompiledGraph) -> None:
+        # TrieJax-style kernel accounting: the compiled graph's shape
+        # gauges let a scrape correlate latency with graph scale (CSR
+        # nnz = adjacency edges, M = slot space). Called only when the
+        # graph CHANGED — compiled() itself is per-dispatch hot path
+        metrics.gauge("engine_csr_nnz").set(cg.n_edges)
+        metrics.gauge("engine_graph_slots").set(cg.M)
+        metrics.gauge("engine_delta_occupancy").set(cg.n_delta)
+
     def compiled(self) -> CompiledGraph:
         """Fully-consistent snapshot: a stale compiled graph is brought
         current by an O(delta) incremental update (small writes — the
@@ -316,29 +429,62 @@ class Engine:
         changes, oversized deltas)."""
         with self._lock:
             cur = self._compiled
-            if cur is not None and cur.revision != self.store.revision:
+            if cur is not None and cur.revision != self.store.revision \
+                    and (cur.revision, self.store.revision) \
+                    != self._incremental_declined:
                 inc = self._try_incremental(cur)
                 if inc is not None:
                     self._compiled = inc
-                    metrics.gauge("engine_csr_nnz").set(inc.n_edges)
-                    metrics.gauge("engine_graph_slots").set(inc.M)
+                    self._publish_graph_gauges(inc)
+                    c = self._compactor
+                    if c is not None:
+                        c.notify(inc)
                     return inc
             if self._compiled is None or \
                self._compiled.revision != self.store.revision:
-                t0 = time.perf_counter()
-                self._compiled = compile_graph(self.schema, self.store.snapshot())
-                metrics.counter("engine_graph_compiles_total").inc()
-                metrics.histogram("engine_graph_compile_seconds").observe(
-                    time.perf_counter() - t0)
-                # TrieJax-style kernel accounting: the compiled graph's
-                # shape gauges let a scrape correlate latency with graph
-                # scale (CSR nnz = adjacency edges, M = slot space).
-                # Set only when the graph CHANGED — compiled() itself is
-                # per-dispatch hot path (the incremental branch above
-                # sets them on its own updates)
-                metrics.gauge("engine_csr_nnz").set(self._compiled.n_edges)
-                metrics.gauge("engine_graph_slots").set(self._compiled.M)
+                self._compiled = self._compile_fresh()
+                self._publish_graph_gauges(self._compiled)
             return self._compiled
+
+    def _compile_fresh(self) -> CompiledGraph:
+        """One full compile from the current store snapshot — shared by
+        the serving-path fallback (under the engine lock) and the
+        background compactor's fold (deliberately OFF the lock: the old
+        base keeps serving while the fold runs)."""
+        t0 = time.perf_counter()
+        cg = compile_graph(self.schema, self.store.snapshot(),
+                           delta_capacity=self._delta_capacity)
+        metrics.counter("engine_graph_compiles_total").inc()
+        metrics.histogram("engine_graph_compile_seconds").observe(
+            time.perf_counter() - t0)
+        return cg
+
+    def _replay_onto(self, fresh: CompiledGraph
+                     ) -> Optional[CompiledGraph]:
+        """Bring a freshly-compiled base current with the watch-log
+        records that landed after its snapshot was cut (the compactor's
+        catch-up replay, run under the engine lock so no further write
+        can race the swap). Returns the advanced graph, ``fresh`` itself
+        when nothing landed, or ``None`` when the suffix cannot be
+        replayed incrementally (trimmed history, bulk load, overflow) —
+        the caller re-folds from a newer snapshot."""
+        st = self.store
+        with st._lock:
+            if fresh.revision < st.unlogged_revision:
+                return None
+            try:
+                records = st.watch_since(fresh.revision)
+            except StoreError:
+                return None
+            rev = st.revision
+        if not records:
+            return fresh
+        if len(records) > MAX_DELTA_RECORDS:
+            return None
+        from .store import OP_DELETE
+
+        delta = [(r.op == OP_DELETE, r.rel) for r in records]
+        return incremental_update(fresh, delta, rev, st)
 
     def _try_incremental(self, cur: CompiledGraph) -> Optional[CompiledGraph]:
         from ..utils.features import features
@@ -348,13 +494,17 @@ class Engine:
         st = self.store
         with st._lock:
             if cur.revision < st.unlogged_revision:
-                return None  # bulk-loaded/restored changes aren't in the log
+                # bulk-loaded/restored changes aren't in the log
+                _fallback("unlogged")
+                return None
             try:
                 records = st.watch_since(cur.revision)
             except StoreError:
-                return None  # history trimmed past our revision
+                _fallback("history-trimmed")
+                return None
             rev = st.revision
         if len(records) > MAX_DELTA_RECORDS:
+            _fallback("overflow")
             return None
         t0 = time.perf_counter()
         from .store import OP_DELETE

@@ -1685,6 +1685,18 @@ def main(argv=None) -> int:
     ap.add_argument("--authz-cache-mask-bytes", type=int,
                     default=256 << 20,
                     help="resident lookup-mask byte budget")
+    ap.add_argument("--delta-capacity", type=int, default=4096,
+                    help="device-resident delta-overlay slots per "
+                         "compiled graph (fixed jit signature; size to "
+                         "the write burst one compaction interval must "
+                         "absorb)")
+    ap.add_argument("--compact-threshold", type=float, default=0.75,
+                    help="overlay-occupancy fraction that wakes the "
+                         "background compactor; a full overlay sheds "
+                         "writes with a bounded Retry-After (rides the "
+                         "kind='admission' frame — breakers stay "
+                         "closed) instead of stalling reads on a "
+                         "synchronous recompile (0 disables)")
     ap.add_argument("--admission", type=parse_bool_flag, nargs="?",
                     const=True, default=False, metavar="BOOL",
                     help="admission control (admission/): cost-classed, "
@@ -1738,6 +1750,15 @@ def main(argv=None) -> int:
     if args.engine_insecure and args.tls_cert_file:
         ap.error("--engine-insecure and --tls-cert-file are mutually "
                  "exclusive")
+    from .compaction import validate_overlay_config
+
+    try:
+        # shared validator (also behind proxy/options.py): clean flag
+        # error at boot, not a constructor traceback
+        validate_overlay_config(args.delta_capacity,
+                                args.compact_threshold)
+    except ValueError as e:
+        ap.error(str(e))
     if args.admission:
         # shared validator (admission.validate_config, also behind
         # proxy/options.py): misconfiguration is a clean flag error at
@@ -1843,7 +1864,12 @@ def main(argv=None) -> int:
         except WalError as e:
             ap.error(str(e))
     bootstrap = "\n---\n".join(open(f).read() for f in args.bootstrap) or None
-    engine = Engine(bootstrap=bootstrap, mesh=mesh)
+    engine = Engine(bootstrap=bootstrap, mesh=mesh,
+                    delta_capacity=args.delta_capacity)
+    if args.compact_threshold > 0:
+        engine.enable_compaction(args.compact_threshold)
+        log.info("overlay compaction on: capacity %d, threshold %.2f",
+                 args.delta_capacity, args.compact_threshold)
     persistence = None
     if args.data_dir:
         persistence = engine.enable_persistence(
@@ -1946,6 +1972,11 @@ def main(argv=None) -> int:
         if coordinator is not None:
             coordinator.stop()
         await server.stop()
+        if args.compact_threshold > 0:
+            # stop the compactor before the final snapshot/checkpoint so
+            # no fold races the state capture below
+            await asyncio.get_running_loop().run_in_executor(
+                None, engine.close_compaction)
         if args.snapshot_path:
             engine.save_snapshot(args.snapshot_path)
             log.info("saved snapshot to %s", args.snapshot_path)

@@ -428,6 +428,7 @@ class Store:
         reference's rollback inverts CREATE/TOUCH into DELETE and retries
         until success (workflow.go:86-129), which requires idempotency.
         """
+        t0 = time.perf_counter()
         with self._lock:
             now = time.time()
             for pc in preconditions:
@@ -510,6 +511,11 @@ class Store:
                 self.journal({"kind": "write", "rev": rev,
                               "effects": effects}, None)
             self._watch_cond.notify_all()
+            # the journal/index share of one applied write transaction —
+            # the "journal" stage of the per-write breakdown (the overlay
+            # append and read dispatch are timed by their own layers)
+            metrics.histogram("store_write_seconds").observe(
+                time.perf_counter() - t0)
             return rev
 
     def bulk_load(self, rels_cols: dict,

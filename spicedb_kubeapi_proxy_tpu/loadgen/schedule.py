@@ -101,10 +101,16 @@ def trace_shaped_config(duration: float, rate: float, tenants: int = 8,
     wave_mix = dict(DEFAULT_MIX)
     wave_mix[OP_LIST_PREFILTER] = 0.40
     wave_mix[OP_TABLE] = 0.25
+    # write churn is the reconcile loop's defining trait (operators
+    # re-assert ownership tuples on every pass): the write share leads
+    # the mix, so this burst is the phase that finds write-path
+    # regressions — with the delta overlay each write is an O(write)
+    # append; without it every write forces a graph re-encode before the
+    # next fully-consistent read can dispatch (ISSUE 8)
     reconcile_mix = dict(DEFAULT_MIX)
-    reconcile_mix[OP_CHECK] = 0.35
-    reconcile_mix[OP_LOOKUP_SUBJECTS] = 0.15
-    reconcile_mix[OP_WRITE] = 0.20
+    reconcile_mix[OP_CHECK] = 0.25
+    reconcile_mix[OP_LOOKUP_SUBJECTS] = 0.12
+    reconcile_mix[OP_WRITE] = 0.35
     return ScheduleConfig(
         duration=duration, rate=rate, tenants=tenants, seed=seed,
         bursts=(

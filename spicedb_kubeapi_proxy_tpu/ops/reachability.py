@@ -46,7 +46,8 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from contextlib import nullcontext
+from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import TYPE_CHECKING, Optional
 
@@ -57,6 +58,7 @@ import jax.numpy as jnp
 
 from . import bitprop
 from .. import native
+from ..utils.metrics import metrics
 from ..models.schema import (
     Arrow,
     Exclude,
@@ -78,13 +80,29 @@ WILDCARD_IDX = 1  # reserved per-type object index for '*'
 DEFAULT_MAX_ITERS = 128
 
 # Incremental-update sizing: small writes append edges into a separate
-# dst-sorted "delta" segment (own gather/segment pass) instead of
-# recompiling the whole graph; invalidated base edges get their expiration
-# forced to -inf on device. Beyond these bounds a full recompile is cheaper
-# than dragging an ever-growing delta through every hop.
-DELTA_PAD_MIN = 1024  # delta segment floor (keeps the jit signature stable)
-DELTA_MAX_EDGES = 1 << 17
+# FIXED-CAPACITY "delta" overlay segment (own gather/segment pass per hop)
+# instead of recompiling the whole graph; invalidated base edges get their
+# expiration forced to -inf on device (residual) or their dense-block cell
+# cleared. The capacity is static — part of the jit signature — so overlay
+# appends NEVER re-specialize; running out of capacity is a back-pressure
+# signal (engine/compaction.py folds the tail into a fresh base off the
+# write path), not a growth event.
+DELTA_PAD_MIN = 1024  # legacy floor for hand-built graphs (signature only)
+DELTA_CAPACITY = 4096  # default overlay capacity (engine --delta-capacity)
 MAX_DELTA_RECORDS = 8192
+
+
+def _fallback(reason: str) -> None:
+    """Count one silent-no-more incremental fallback: the caller is about
+    to decline the O(write) path and force a full recompile. Reasons:
+    ``overflow`` (overlay/dead-ledger capacity or per-batch record cap),
+    ``stratification-inversion`` (a first-ever dependency direction),
+    ``closured-expiry`` (expiration attached to a closured block pair),
+    ``history-trimmed`` / ``unlogged`` (store-side, engine.py),
+    ``layout`` (tuple not expressible against the frozen slot layout),
+    ``unstratified`` (hand-built graph without overlay state)."""
+    metrics.counter("engine_graph_incremental_fallback_total",
+                    reason=reason).inc()
 
 # jitted fixpoint functions shared across CompiledGraph revisions with equal
 # signatures (bounded: distinct schemas/bucket layouts, not revisions)
@@ -372,15 +390,30 @@ class CompiledGraph:
     blocks: list = field(default_factory=list)
     res_idx: Optional[np.ndarray] = None
     # incremental-update state (engine write path, incremental_update()):
-    # a small dst-sorted delta edge segment consumed by its own
-    # gather/segment pass each hop, and the (src, dst) pairs of base edges
-    # invalidated since the last full compile (consumed by ShardedGraph so
-    # a sharded view of an incrementally-updated graph stays consistent)
-    delta_src: Optional[np.ndarray] = None  # int32 [D_pad], trash-padded
+    # a FIXED-CAPACITY delta overlay segment consumed by its own
+    # gather/segment pass each hop (append order, NOT dst-sorted), and the
+    # (src, dst) pairs of base edges invalidated since the last full
+    # compile (consumed by ShardedGraph so a sharded view of an
+    # incrementally-updated graph stays consistent). The host arrays are
+    # SHARED across incremental descendants of one compiled base and
+    # mutated in place under ``host_lock`` — per-revision immutability
+    # lives in the watermarks (n_delta / n_dead) and the functional
+    # device arrays, not in host copies.
+    delta_src: Optional[np.ndarray] = None  # int32 [cap], trash-padded
     delta_dst: Optional[np.ndarray] = None
     delta_exp: Optional[np.ndarray] = None  # float32 rel to base_time
     n_delta: int = 0
-    dead_pairs: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst)
+    dead_pairs: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst) view
+    n_dead: int = 0
+    delta_cap: int = 0  # static overlay capacity (0 = legacy/hand-built)
+    # shared writer-state (one object per compiled base, carried by every
+    # incremental descendant; reads/writes only under the engine's
+    # graph-advance lock + host_lock):
+    delta_pos: Optional[dict] = None  # (src, dst) -> overlay slot
+    dead_set: Optional[set] = None  # (src, dst) pairs killed in the base
+    dead_buf: Optional[np.ndarray] = None  # int64 [cap, 2] append buffer
+    host_lock: Optional[object] = None  # guards shared host-array reads
+    block_codes: Optional[dict] = None  # id(_BlockMeta) -> sorted codes
     # host residual views (padded; ordered by (level, dst) — see
     # _stratify/res_level_bounds) for device upload + incremental search
     res_src: Optional[np.ndarray] = None
@@ -509,7 +542,15 @@ class CompiledGraph:
     def _delta_pad(self) -> int:
         if self.delta_src is not None:
             return len(self.delta_src)
+        if self.delta_cap:
+            return self.delta_cap
         return _next_bucket(max(self.n_delta, 1), DELTA_PAD_MIN)
+
+    def _host_guard(self):
+        """Context guarding reads of the SHARED mutable host arrays
+        (delta segment, res_exp) against an in-flight overlay append."""
+        return self.host_lock if self.host_lock is not None \
+            else nullcontext()
 
     def run_meta(self) -> "RunMeta":
         """Slim static-metadata view for jit closures: everything the
@@ -556,69 +597,74 @@ class CompiledGraph:
     def _dev_locked(self):
         d = self._device
         if not d:
-            d = {}
-            if self.res_src is not None:
-                res_src, res_dst, res_exp = \
-                    self.res_src, self.res_dst, self.res_exp
-            elif self.res_idx is None:
-                # no dense split computed: everything rides the segment path
-                res_src, res_dst, res_exp = self.src, self.dst, self.exp_rel
-            else:
-                n_res = len(self.res_idx)
-                E_pad = _next_bucket(max(n_res, 1))
-                res_src = np.full(E_pad, self.M, dtype=np.int32)
-                res_dst = np.full(E_pad, self.M, dtype=np.int32)
-                res_exp = np.full(E_pad, -np.inf, dtype=np.float32)
-                # res_idx is ascending into dst-sorted edge arrays, so the
-                # residual stays dst-sorted (indices_are_sorted=True relies
-                # on this)
-                res_src[:n_res] = self.src[self.res_idx]
-                res_dst[:n_res] = self.dst[self.res_idx]
-                res_exp[:n_res] = self.exp_rel[self.res_idx]
-            d["src"] = jnp.asarray(res_src)
-            d["dst"] = jnp.asarray(res_dst)
-            d["exp"] = jnp.asarray(res_exp)
-            d["dsrc"], d["ddst"], d["dexp"] = (
-                jnp.asarray(a) for a in self._delta_host())
-
-            # dense blocks from host meta, minus any cells killed by
-            # incremental updates since the last full compile (host meta is
-            # not rewritten by incremental_update; dead_pairs is the ledger)
-            blocks_dev = []
-            bits_on = bitprop.kernel_enabled()
-            bits_dev = []
-            for b in self.blocks:
-                dl_dead, sl_dead = self._dead_cells(b)
-                A = jnp.zeros((b.n_dst, b.n_src), dtype=jnp.int8) \
-                    .at[jnp.asarray(b.dst_local),
-                        jnp.asarray(b.src_local)].set(1)
-                if len(dl_dead):
-                    A = A.at[jnp.asarray(dl_dead),
-                             jnp.asarray(sl_dead)].set(0)
-                blocks_dev.append(A)
-                # bit-packed dual for the small-batch latency path
-                # (ops/bitprop.py); None = block stays matmul-only. Packing
-                # + device residency is skipped entirely when the bit
-                # kernel cannot run (the toggle is part of the jit-cache
-                # key, so no trace reads the bits in that case).
-                if bits_on and bitprop.eligible(b.n_dst, b.n_src):
-                    bits = bitprop.pack_block_host(
-                        b.dst_local, b.src_local, b.n_dst, b.n_src)
-                    if len(dl_dead):
-                        np.bitwise_and.at(
-                            bits, (dl_dead, sl_dead // 32),
-                            ~(np.uint32(1) << (sl_dead % 32).astype(
-                                np.uint32)))
-                    bits_dev.append(jnp.asarray(bits))
-                else:
-                    bits_dev.append(None)
-            d["blocks"] = tuple(blocks_dev)
-            d["blocks_bits"] = tuple(bits_dev)
-            # the bit-kernel toggle is baked into traces, so it is part of
-            # the shared-function cache key
-            d["run"] = _jit_run_for(self)
-            self._device = d
+            with self._host_guard():
+                d = self._dev_build()
+                self._device = d
         return self._device
+
+    def _dev_build(self):
+        d = {}
+        if self.res_src is not None:
+            res_src, res_dst, res_exp = \
+                self.res_src, self.res_dst, self.res_exp
+        elif self.res_idx is None:
+            # no dense split computed: everything rides the segment path
+            res_src, res_dst, res_exp = self.src, self.dst, self.exp_rel
+        else:
+            n_res = len(self.res_idx)
+            E_pad = _next_bucket(max(n_res, 1))
+            res_src = np.full(E_pad, self.M, dtype=np.int32)
+            res_dst = np.full(E_pad, self.M, dtype=np.int32)
+            res_exp = np.full(E_pad, -np.inf, dtype=np.float32)
+            # res_idx is ascending into dst-sorted edge arrays, so the
+            # residual stays dst-sorted (indices_are_sorted=True relies
+            # on this)
+            res_src[:n_res] = self.src[self.res_idx]
+            res_dst[:n_res] = self.dst[self.res_idx]
+            res_exp[:n_res] = self.exp_rel[self.res_idx]
+        d["src"] = jnp.asarray(res_src)
+        d["dst"] = jnp.asarray(res_dst)
+        d["exp"] = jnp.asarray(res_exp)
+        d["dsrc"], d["ddst"], d["dexp"] = (
+            jnp.asarray(a) for a in self._delta_host())
+
+        # dense blocks from host meta, minus any cells killed by
+        # incremental updates since the last full compile (host meta is
+        # not rewritten by incremental_update; dead_pairs is the ledger)
+        blocks_dev = []
+        bits_on = bitprop.kernel_enabled()
+        bits_dev = []
+        for b in self.blocks:
+            dl_dead, sl_dead = self._dead_cells(b)
+            A = jnp.zeros((b.n_dst, b.n_src), dtype=jnp.int8) \
+                .at[jnp.asarray(b.dst_local),
+                    jnp.asarray(b.src_local)].set(1)
+            if len(dl_dead):
+                A = A.at[jnp.asarray(dl_dead),
+                         jnp.asarray(sl_dead)].set(0)
+            blocks_dev.append(A)
+            # bit-packed dual for the small-batch latency path
+            # (ops/bitprop.py); None = block stays matmul-only. Packing
+            # + device residency is skipped entirely when the bit
+            # kernel cannot run (the toggle is part of the jit-cache
+            # key, so no trace reads the bits in that case).
+            if bits_on and bitprop.eligible(b.n_dst, b.n_src):
+                bits = bitprop.pack_block_host(
+                    b.dst_local, b.src_local, b.n_dst, b.n_src)
+                if len(dl_dead):
+                    np.bitwise_and.at(
+                        bits, (dl_dead, sl_dead // 32),
+                        ~(np.uint32(1) << (sl_dead % 32).astype(
+                            np.uint32)))
+                bits_dev.append(jnp.asarray(bits))
+            else:
+                bits_dev.append(None)
+        d["blocks"] = tuple(blocks_dev)
+        d["blocks_bits"] = tuple(bits_dev)
+        # the bit-kernel toggle is baked into traces, so it is part of
+        # the shared-function cache key
+        d["run"] = _jit_run_for(self)
+        return d
 
     def _dead_cells(self, bm: _BlockMeta) -> tuple[np.ndarray, np.ndarray]:
         """Local (dst, src) coordinates of dead_pairs falling inside a
@@ -632,7 +678,9 @@ class CompiledGraph:
         return t[m] - bm.dst_off, s[m] - bm.src_off
 
     def _delta_host(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Host delta segment (padded, dst-sorted); empty = all trash."""
+        """Host delta overlay segment (fixed capacity, append order —
+        NOT dst-sorted); empty = all trash. Shared across incremental
+        descendants; callers snapshotting it hold ``_host_guard``."""
         if self.delta_src is not None:
             return self.delta_src, self.delta_dst, self.delta_exp
         pad = self._delta_pad()
@@ -902,13 +950,15 @@ def _propagate(cg, blocks, blocks_bits, src, dst, valid,
         ).T  # [B, Mp]
     else:
         prop = jnp.zeros((B, Mp), dtype=jnp.uint8)
-    # delta segment: edges appended by incremental updates since the last
-    # full compile (dst-sorted on host at update time). Applied at EVERY
+    # delta overlay segment: edges appended by incremental updates since
+    # the last full compile, in APPEND order (slots are assigned once and
+    # updated in place, so no sort exists to exploit). Applied at EVERY
     # level — contributions outside the level's ranges are masked off by
-    # the caller's merge, so correctness holds at O(delta) cost per phase.
+    # the caller's merge, so correctness holds at O(capacity) cost per
+    # phase.
     gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T  # [D_pad, B]
     prop = prop | jax.ops.segment_max(
-        gathered_d, ddst, num_segments=Mp, indices_are_sorted=True
+        gathered_d, ddst, num_segments=Mp, indices_are_sorted=False
     ).T
     # B is static under trace, so the representation choice is baked into
     # the compiled program: bit kernel streams 8x less HBM per hop at
@@ -1079,12 +1129,19 @@ def _topo_permissions(defn) -> list[str]:
     return out
 
 
-def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
+def compile_graph(schema: Schema, snapshot: Snapshot,
+                  delta_capacity: int = DELTA_CAPACITY) -> CompiledGraph:
     """Compile a store snapshot into device-ready slot-space form.
 
     Everything here is vectorized numpy over the snapshot's columnar arrays
     — no per-relationship Python loops — so 10M-edge graphs compile in
     seconds on the host.
+
+    ``delta_capacity`` preallocates the fixed-capacity delta overlay
+    (``incremental_update``): its length is part of the jit signature, so
+    overlay appends never re-specialize, and running out of slots is a
+    compaction/back-pressure signal (engine/compaction.py) instead of a
+    growth event.
     """
     types_in = snapshot.types
     rels_in = snapshot.relations
@@ -1401,6 +1458,15 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         res_exp[lo:lo + n_k] = exp_p[sel]
         pos += n_k
 
+    # fixed-capacity delta overlay: preallocated trash-padded segments the
+    # incremental path appends into IN PLACE (watermarked by n_delta /
+    # n_dead on each revision view); sized once so the jit signature never
+    # moves under write churn
+    # NO gauge writes here: engine_delta_occupancy belongs to the engine
+    # layer (_publish_graph_gauges / incremental_update) — a background
+    # compactor's off-path compile must not zero the LIVE overlay's
+    # occupancy reading while it is full and shedding
+    cap = max(int(delta_capacity), 64)
     return CompiledGraph(
         schema=schema,
         revision=snapshot.revision,
@@ -1415,6 +1481,18 @@ def compile_graph(schema: Schema, snapshot: Snapshot) -> CompiledGraph:
         programs=programs,
         blocks=blocks,
         res_idx=res_idx,
+        delta_src=np.full(cap, M, dtype=np.int32),
+        delta_dst=np.full(cap, M, dtype=np.int32),
+        delta_exp=np.full(cap, -np.inf, dtype=np.float32),
+        n_delta=0,
+        dead_pairs=None,
+        n_dead=0,
+        delta_cap=cap,
+        delta_pos={},
+        dead_set=set(),
+        dead_buf=np.zeros((cap, 2), dtype=np.int64),
+        host_lock=threading.Lock(),
+        block_codes={},
         res_src=res_src,
         res_dst=res_dst,
         res_exp=res_exp,
@@ -1527,139 +1605,172 @@ def _res_positions(cg: CompiledGraph, src: int, dst: int) -> list[int]:
     return out
 
 
+
+
+def _block_base_codes(cg: CompiledGraph, b: int) -> np.ndarray:
+    """Sorted ``dst_local * n_src + src_local`` codes of a closured
+    block's BASE edges, cached on the shared ``block_codes`` dict (keyed
+    by block index, validated against the block object's identity so a
+    re-close invalidates the entry). O(block log block) once per base
+    edge-set, O(log block) per membership probe after that."""
+    bm = cg.blocks[b]
+    cache = cg.block_codes
+    if cache is not None:
+        ent = cache.get(b)
+        if ent is not None and ent[0] == id(bm):
+            return ent[1]
+    codes = np.sort(bm.base_dst_local.astype(np.int64) * bm.n_src
+                    + bm.base_src_local)
+    if cache is not None:
+        cache[b] = (id(bm), codes)
+    return codes
+
+
 def incremental_update(cg: CompiledGraph, records, new_revision: int,
                        store) -> Optional[CompiledGraph]:
     """Apply a write delta — ``records`` is an ordered list of
     ``(is_delete, Relationship)`` derived from the store watch log since
-    cg.revision — to a compiled graph without recompiling: deleted/
-    re-touched base edges are invalidated in place (expiration forced to
-    -inf on device; dense-block cells cleared functionally), new edges
-    land in the small dst-sorted delta segment. Returns a new
-    CompiledGraph sharing all static state (in-flight queries keep the old
-    immutable one), or None when the delta cannot be expressed against the
-    existing slot layout — the caller then runs compile_graph from a fresh
-    snapshot.
+    cg.revision — to a compiled graph without recompiling.
+
+    The delta overlay is a FIXED-CAPACITY device-resident COO tail shared
+    (host side) by every incremental descendant of one compiled base:
+
+    - a new edge takes the next free overlay slot — a host write plus a
+      functional ``.at[slot].set`` on the resident device arrays, O(write)
+      regardless of how much delta has accumulated since the last
+      compaction (the previous implementation rebuilt a dict + re-sorted
+      + re-uploaded the whole segment per write);
+    - a re-touch/delete of an overlay edge updates its slot's expiration
+      in place (slots are reused, so touch/delete churn on the same pairs
+      never grows occupancy);
+    - a touched/deleted BASE edge is killed where it lives (residual
+      expiration forced to -inf, dense-block cell cleared) and recorded
+      once in the append-only dead ledger (``dead_buf``/``dead_set``) for
+      ShardedGraph replay and lazy device builds.
+
+    Capacity is static — part of the jit signature — so appends NEVER
+    re-specialize; running out of slots (or dead-ledger room) declines the
+    update, which the engine turns into compaction back-pressure rather
+    than a growth event. Returns a new CompiledGraph view sharing the
+    overlay (per-revision immutability lives in the n_delta/n_dead
+    watermarks and the functional device arrays), or None when the delta
+    cannot be expressed against the frozen layout — every decline is
+    counted in ``engine_graph_incremental_fallback_total{reason}``.
 
     Keeps the fully-consistent-read contract (reference
-    pkg/authz/check.go:42-44) at O(delta) instead of O(graph) per write.
+    pkg/authz/check.go:42-44) at O(write) instead of O(graph) per write.
     """
-    if len(records) > MAX_DELTA_RECORDS or cg.res_src is None \
-            or cg.self_off is None:
+    if cg.res_src is None or cg.self_off is None or cg.delta_pos is None \
+            or cg.delta_src is None or cg.dead_buf is None:
+        _fallback("unstratified")
+        return None
+    if len(records) > MAX_DELTA_RECORDS:
+        _fallback("overflow")
         return None
 
-    # current delta segment content -> last-state dict keyed by (src, dst)
-    delta_state: dict[tuple[int, int], float] = {}
-    if cg.delta_src is not None:
-        for i in range(cg.n_delta):
-            delta_state[(int(cg.delta_src[i]), int(cg.delta_dst[i]))] = \
-                float(cg.delta_exp[i])
+    delta_pos = cg.delta_pos
+    dead_set = cg.dead_set
 
-    res_inval: set[int] = set()
+    # ---- plan (NO mutation): a fallback must leave the shared overlay
+    # exactly as it was — the caller recompiles from a fresh snapshot and
+    # in-flight queries keep serving the untouched current view ----------
+    appends: dict[tuple[int, int], float] = {}  # pair -> exp (new slot)
+    updates: dict[int, float] = {}  # existing overlay slot -> new exp
+    res_kill: list[int] = []
     block_cells: dict[int, dict[tuple[int, int], int]] = {}
-    dead: list[tuple[int, int]] = []
+    new_dead: list[tuple[int, int]] = []
+    dead_seen: set = set()
     # closured blocks whose BASE edges lost pairs: re-closed wholesale
     reclose: dict[int, set] = {}  # block idx -> local (dst, src) pairs
-    base_codes_cache: dict[int, np.ndarray] = {}  # block idx -> sorted codes
 
     for is_delete, relationship in records:
         edges = _edges_for_tuple(cg, store, relationship)
         if edges is None:
+            _fallback("layout")
             return None
-        for src, dst in edges:
-            if not is_delete and not _level_order_ok(cg, src, dst):
-                # the new edge would invert the frozen stratification
-                # (e.g. a first-ever dependency creating a cycle across
-                # levels): re-stratify via a full recompile
-                return None
-        for src, dst in edges:
-            # invalidate everywhere the BASE edge may live (idempotent):
-            # dense-block cell cleared, residual expiration forced stale,
-            # and the pair recorded so ShardedGraph can replay the kill
-            # against the full host edge arrays
-            b = _pair_block(cg, src, dst)
-            if b is not None:
-                bm = cg.blocks[b]
-                if bm.closured and relationship.expiration is not None:
-                    # a touch attaching an expiration de-qualifies the
-                    # pair from closure entirely (expiring edges must
-                    # ride the residual path): re-stratify via recompile
+        if not is_delete:
+            for src, dst in edges:
+                if relationship.expiration is not None:
+                    b_ = _pair_block(cg, src, dst)
+                    if b_ is not None and cg.blocks[b_].closured:
+                        # a touch attaching an expiration de-qualifies
+                        # the pair from closure entirely (expiring edges
+                        # must ride the residual path). Classified
+                        # BEFORE the level-order check: a closured
+                        # self-block lifts its range out of the iterated
+                        # core, so the generic check would fire first
+                        # and miscount this as an inversion.
+                        _fallback("closured-expiry")
+                        return None
+                if not _level_order_ok(cg, src, dst):
+                    # the new edge would invert the frozen stratification
+                    # (e.g. a first-ever dependency creating a cycle
+                    # across levels): re-stratify via a full recompile
+                    _fallback("stratification-inversion")
                     return None
-                if bm.closured and is_delete:
+        for src, dst in edges:
+            pair = (src, dst)
+            b = _pair_block(cg, src, dst)
+            bm = cg.blocks[b] if b is not None else None
+            if bm is not None and bm.closured:
+                # (expiration-attaching touches on closured pairs already
+                # fell back in the pre-classification loop above)
+                if is_delete:
                     # closure cells are DERIVED reachability — clearing
                     # one cell would leave multi-hop products of the
                     # deleted edge alive (over-allow) and could kill
                     # cells still justified by alternative paths
                     # (under-allow). Instead RE-CLOSE the block from its
                     # base edges minus the deleted pair, O(block); the
-                    # pair must NOT enter dead_pairs/block_cells — the
-                    # recomputed closure is the sole truth (a surviving
-                    # alternative path may legitimately keep the direct
-                    # cell set).
-                    dl_, sl_ = int(dst - bm.dst_off), int(src - bm.src_off)
-                    codes = base_codes_cache.get(b)
-                    if codes is None:
-                        codes = np.sort(
-                            bm.base_dst_local.astype(np.int64) * bm.n_src
-                            + bm.base_src_local)
-                        base_codes_cache[b] = codes
+                    # pair must NOT enter the dead ledger/block_cells —
+                    # the recomputed closure is the sole truth.
+                    dl_ = int(dst - bm.dst_off)
+                    sl_ = int(src - bm.src_off)
+                    codes = _block_base_codes(cg, b)
                     code = dl_ * bm.n_src + sl_
                     p_ = int(np.searchsorted(codes, code))
                     if p_ < len(codes) and codes[p_] == code:
                         reclose.setdefault(b, set()).add((dl_, sl_))
-                    # not in base (delta-only or nonexistent): popping the
-                    # delta edge below is the entire delete — re-closing
-                    # an unchanged base would rebuild device/sharded
-                    # state for a no-op
-                    delta_state.pop((src, dst), None)
+                    # overlay copy (delta-only or re-added): killing the
+                    # slot is the rest of the delete
+                    slot = delta_pos.get(pair)
+                    if slot is not None:
+                        updates[slot] = float("-inf")
+                    appends.pop(pair, None)
                     continue
-                block_cells.setdefault(b, {})[
-                    (dst - bm.dst_off, src - bm.src_off)] = 0
-            for p in _res_positions(cg, src, dst):
-                res_inval.add(p)
-            delta_state.pop((src, dst), None)
-            dead.append((src, dst))
+            # invalidate everywhere the BASE edge may live (once per pair
+            # across the base's whole incremental lifetime — the dead
+            # ledger makes the kill idempotent and the host arrays are
+            # mutated in place, so an already-dead pair costs nothing):
+            # dense-block cell cleared, residual expiration forced stale,
+            # and the pair recorded so ShardedGraph can replay the kill
+            if pair not in dead_set and pair not in dead_seen:
+                dead_seen.add(pair)
+                new_dead.append(pair)
+                if bm is not None:
+                    block_cells.setdefault(b, {})[
+                        (dst - bm.dst_off, src - bm.src_off)] = 0
+                res_kill.extend(_res_positions(cg, src, dst))
+            slot = delta_pos.get(pair)
             if is_delete:
+                if slot is not None:
+                    updates[slot] = float("-inf")
+                appends.pop(pair, None)
                 continue
             # adds (including re-touches of block-covered pairs) always
-            # land in the delta segment — one ledger for both the
-            # single-chip and sharded consumers; blocks are only cleared
+            # land in the overlay — one ledger for both the single-chip
+            # and sharded consumers; base copies are only ever cleared
             exp_rel = (np.inf if relationship.expiration is None
                        else relationship.expiration - cg.base_time)
-            delta_state[(src, dst)] = float(exp_rel)
+            if slot is not None:
+                updates[slot] = float(exp_rel)
+            else:
+                appends[pair] = float(exp_rel)
 
-    n_delta = len(delta_state)
-    if n_delta > DELTA_MAX_EDGES:
-        return None
-
-    # rebuild the delta segment, dst-sorted (indices_are_sorted in the
-    # delta segment pass relies on this), padded to its bucket
-    pad = max(_next_bucket(max(n_delta, 1), DELTA_PAD_MIN), cg._delta_pad())
-    d_src = np.full(pad, cg.M, dtype=np.int32)
-    d_dst = np.full(pad, cg.M, dtype=np.int32)
-    d_exp = np.full(pad, -np.inf, dtype=np.float32)
-    if n_delta:
-        pairs = np.array(list(delta_state.keys()), dtype=np.int64)
-        exps = np.array(list(delta_state.values()), dtype=np.float32)
-        order = np.argsort(pairs[:, 1], kind="stable")
-        d_src[:n_delta] = pairs[order, 0]
-        d_dst[:n_delta] = pairs[order, 1]
-        d_exp[:n_delta] = exps[order]
-
-    # update host residual expirations (next incremental builds on them)
-    res_exp = cg.res_exp
-    if res_inval:
-        res_exp = res_exp.copy()
-        res_exp[list(res_inval)] = -np.inf
-
-    dead_pairs = np.array(dead, dtype=np.int64).reshape(-1, 2)
-    if cg.dead_pairs is not None and len(cg.dead_pairs):
-        dead_pairs = np.concatenate([cg.dead_pairs, dead_pairs])
-    if len(dead_pairs):
-        # dedup: a hot tuple retouched N times must not grow the kill list
-        # N entries deep (it would eventually force spurious recompiles and
-        # slow every ShardedGraph replay)
-        dead_pairs = np.unique(dead_pairs, axis=0)
-    if len(dead_pairs) > DELTA_MAX_EDGES:
+    n_app = len(appends)
+    if cg.n_delta + n_app > cg.delta_cap \
+            or cg.n_dead + len(new_dead) > len(cg.dead_buf):
+        _fallback("overflow")
         return None
 
     blocks_host = cg.blocks
@@ -1668,108 +1779,125 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
         for b, pairs in reclose.items():
             nb = blocks_host[b].reclosed(pairs)
             if nb is None:  # closure overflow: re-stratify instead
+                _fallback("overflow")
                 return None
             blocks_host[b] = nb
+        if cg.block_codes is not None:
+            for b in reclose:
+                cg.block_codes.pop(b, None)
 
-    new = CompiledGraph(
-        schema=cg.schema,
-        revision=new_revision,
-        base_time=cg.base_time,
-        M=cg.M,
-        slot_offset=cg.slot_offset,
-        type_sizes=cg.type_sizes,
-        src=cg.src,
-        dst=cg.dst,
-        exp_rel=cg.exp_rel,
-        n_edges=cg.n_edges,
-        programs=cg.programs,
-        blocks=blocks_host,
-        res_idx=cg.res_idx,
-        delta_src=d_src,
-        delta_dst=d_dst,
-        delta_exp=d_exp,
-        n_delta=n_delta,
-        dead_pairs=dead_pairs,
-        res_src=cg.res_src,
-        res_dst=cg.res_dst,
-        res_exp=res_exp,
-        res_level_bounds=cg.res_level_bounds,
-        n_levels=cg.n_levels,
-        range_levels=cg.range_levels,
-        range_offs=cg.range_offs,
-        block_index=cg.block_index,
-        self_off=cg.self_off,
-        rel_off=cg.rel_off,
-        relperm_off=cg.relperm_off,
-        arrow_maps=cg.arrow_maps,
-    )
+    # ---- apply: in-place host mutation under host_lock. Descendant
+    # views see the appended slots via their n_delta watermark; an OLDER
+    # revision that lazily builds device state afterwards may observe
+    # newer writes — fully-consistent reads only promise at-least-as-
+    # fresh, so that is correct (and rare: device state initializes on
+    # the first query after compile) ------------------------------------
+    app_items = list(appends.items())
+    n0 = cg.n_delta
+    nd0 = cg.n_dead
+    with cg.host_lock:
+        for i, ((s, t), ex) in enumerate(app_items):
+            slot = n0 + i
+            cg.delta_src[slot] = s
+            cg.delta_dst[slot] = t
+            cg.delta_exp[slot] = ex
+            delta_pos[(s, t)] = slot
+        for slot, ex in updates.items():
+            cg.delta_exp[slot] = ex
+        if res_kill:
+            cg.res_exp[np.asarray(res_kill, dtype=np.int64)] = -np.inf
+        for j, (s, t) in enumerate(new_dead):
+            cg.dead_buf[nd0 + j, 0] = s
+            cg.dead_buf[nd0 + j, 1] = t
+        dead_set.update(new_dead)
+    n_delta2 = n0 + len(app_items)
+    n_dead2 = nd0 + len(new_dead)
+    metrics.gauge("engine_delta_occupancy").set(n_delta2)
 
-    # device state: functional updates against the old graph's arrays —
-    # published into the NEW graph only, so concurrent queries against the
-    # old graph keep a consistent view. If the old graph never initialized
-    # single-chip device state (mesh engines query through ShardedGraph
-    # instead), don't force it here: a later lazy _dev_locked builds
-    # correctly from the updated host arrays + dead pairs.
+    # ---- device state: functional O(write) updates against the current
+    # resident arrays — published into the NEW view only, so concurrent
+    # queries against older revisions keep their immutable arrays. If the
+    # base never initialized single-chip device state (mesh engines query
+    # through ShardedGraph instead), don't force it here: a later lazy
+    # _dev_locked builds correctly from the updated host arrays ----------
     old = cg._device
-    if not old:
-        return new
-    d = dict(old)
-    if res_inval:
-        d["exp"] = old["exp"].at[np.fromiter(
-            res_inval, dtype=np.int64)].set(-np.inf)
-    if block_cells or reclose:
-        blocks_dev = list(old["blocks"])
-        bits_dev = list(old["blocks_bits"])
-        for b in reclose:
-            # re-closed block: fresh device matrix scattered from the new
-            # closure COO (uploading the pairs, not the dense matrix)
-            bm = blocks_host[b]
-            blocks_dev[b] = jnp.zeros(
-                (bm.n_dst, bm.n_src), dtype=jnp.int8
-            ).at[jnp.asarray(bm.dst_local),
-                 jnp.asarray(bm.src_local)].set(1)
-            if bits_dev[b] is not None:
-                bits_dev[b] = jnp.asarray(bitprop.pack_block_host(
-                    bm.dst_local, bm.src_local, bm.n_dst, bm.n_src))
-        for b, cells in block_cells.items():
-            dl = np.fromiter((c[0] for c in cells), dtype=np.int32,
-                             count=len(cells))
-            sl = np.fromiter((c[1] for c in cells), dtype=np.int32,
-                             count=len(cells))
-            vals = np.fromiter(cells.values(), dtype=np.int8,
-                               count=len(cells))
-            blocks_dev[b] = blocks_dev[b].at[dl, sl].set(vals)
-            bits = bits_dev[b]
-            if bits is not None:
-                # group per (row, word): multiple cells can share a packed
-                # word, and a gather-modify-scatter with duplicate indices
-                # would drop updates
-                agg: dict[tuple[int, int], tuple[int, int]] = {}
-                for (dli, sli), v in cells.items():
-                    k = (dli, sli // 32)
-                    setm, clrm = agg.get(k, (0, 0))
-                    bit = 1 << (sli % 32)
-                    if v:
-                        setm |= bit
-                    else:
-                        clrm |= bit
-                    agg[k] = (setm, clrm)
-                rows = np.array([k[0] for k in agg], dtype=np.int32)
-                words = np.array([k[1] for k in agg], dtype=np.int32)
-                sets = np.array([v[0] for v in agg.values()],
-                                dtype=np.uint32)
-                clrs = np.array([v[1] for v in agg.values()],
-                                dtype=np.uint32)
-                cur = bits[rows, words]
-                bits_dev[b] = bits.at[rows, words].set(
-                    (cur & jnp.asarray(~clrs)) | jnp.asarray(sets))
-        d["blocks"] = tuple(blocks_dev)
-        d["blocks_bits"] = tuple(bits_dev)
-    d["dsrc"] = jnp.asarray(d_src)
-    d["ddst"] = jnp.asarray(d_dst)
-    d["dexp"] = jnp.asarray(d_exp)
-    if new.signature() != cg.signature():
-        # delta bucket grew: re-specialize (cached per signature)
-        d["run"] = _jit_run_for(new)
-    new._device = d
-    return new
+    d = {}
+    if old:
+        d = dict(old)
+        if app_items:
+            ai = np.arange(n0, n0 + len(app_items), dtype=np.int64)
+            d["dsrc"] = old["dsrc"].at[ai].set(np.asarray(
+                [p[0] for p, _ in app_items], dtype=np.int32))
+            d["ddst"] = old["ddst"].at[ai].set(np.asarray(
+                [p[1] for p, _ in app_items], dtype=np.int32))
+        if app_items or updates:
+            ui = np.asarray(
+                [n0 + i for i in range(len(app_items))]
+                + list(updates.keys()), dtype=np.int64)
+            uv = np.asarray(
+                [ex for _, ex in app_items] + list(updates.values()),
+                dtype=np.float32)
+            d["dexp"] = d["dexp"].at[ui].set(uv)
+        if res_kill:
+            d["exp"] = old["exp"].at[np.asarray(
+                res_kill, dtype=np.int64)].set(-np.inf)
+        if block_cells or reclose:
+            blocks_dev = list(old["blocks"])
+            bits_dev = list(old["blocks_bits"])
+            for b in reclose:
+                # re-closed block: fresh device matrix scattered from the
+                # new closure COO (uploading the pairs, not the matrix)
+                bm = blocks_host[b]
+                blocks_dev[b] = jnp.zeros(
+                    (bm.n_dst, bm.n_src), dtype=jnp.int8
+                ).at[jnp.asarray(bm.dst_local),
+                     jnp.asarray(bm.src_local)].set(1)
+                if bits_dev[b] is not None:
+                    bits_dev[b] = jnp.asarray(bitprop.pack_block_host(
+                        bm.dst_local, bm.src_local, bm.n_dst, bm.n_src))
+            for b, cells in block_cells.items():
+                dl = np.fromiter((c[0] for c in cells), dtype=np.int32,
+                                 count=len(cells))
+                sl = np.fromiter((c[1] for c in cells), dtype=np.int32,
+                                 count=len(cells))
+                vals = np.fromiter(cells.values(), dtype=np.int8,
+                                   count=len(cells))
+                blocks_dev[b] = blocks_dev[b].at[dl, sl].set(vals)
+                bits = bits_dev[b]
+                if bits is not None:
+                    # group per (row, word): multiple cells can share a
+                    # packed word, and a gather-modify-scatter with
+                    # duplicate indices would drop updates
+                    agg: dict[tuple[int, int], tuple[int, int]] = {}
+                    for (dli, sli), v in cells.items():
+                        k = (dli, sli // 32)
+                        setm, clrm = agg.get(k, (0, 0))
+                        bit = 1 << (sli % 32)
+                        if v:
+                            setm |= bit
+                        else:
+                            clrm |= bit
+                        agg[k] = (setm, clrm)
+                    rows = np.array([k[0] for k in agg], dtype=np.int32)
+                    words = np.array([k[1] for k in agg], dtype=np.int32)
+                    sets = np.array([v[0] for v in agg.values()],
+                                    dtype=np.uint32)
+                    clrs = np.array([v[1] for v in agg.values()],
+                                    dtype=np.uint32)
+                    cur = bits[rows, words]
+                    bits_dev[b] = bits.at[rows, words].set(
+                        (cur & jnp.asarray(~clrs)) | jnp.asarray(sets))
+            d["blocks"] = tuple(blocks_dev)
+            d["blocks_bits"] = tuple(bits_dev)
+        # capacity is static, so the signature — and with it d["run"] —
+        # cannot change across overlay appends
+
+    return replace(
+        cg,
+        revision=new_revision,
+        n_delta=n_delta2,
+        n_dead=n_dead2,
+        dead_pairs=cg.dead_buf[:n_dead2],
+        blocks=blocks_host,
+        _device=d,
+    )

@@ -505,10 +505,25 @@ class MirroredEngine:
                 f"floor of {self._min_sync_replicas}: refusing the write "
                 "(an unreplicated ack would not survive leader failover)")
 
-    def write_relationships(self, ops, preconditions=()):
+    def _write_headroom(self, n_records: int) -> None:
+        """Overlay back-pressure must run BEFORE the frame is published:
+        a shed after publish would leave followers holding a write the
+        leader never applied (a silent lineage fork). The local apply —
+        and every follower's replay (apply_mirror_frame) — then runs
+        with the headroom gate off: once published, the mutation is
+        committed to the replication stream and MUST land everywhere,
+        even if the overlay overflows into a counted fallback recompile."""
+        hr = getattr(self.engine, "_write_headroom", None)
+        if hr is not None:
+            hr(n_records)
+
+    def write_relationships(self, ops, preconditions=(), *,
+                            _headroom: bool = True):
         from ..engine.remote import _rel_to_dict
         from dataclasses import asdict
 
+        if _headroom:
+            self._write_headroom(len(ops))
         self._require_replicas()
         with self._lock:
             seq = self._publish("write_relationships", {
@@ -520,13 +535,16 @@ class MirroredEngine:
                     for p in preconditions],
             })
             result = self.engine.write_relationships(
-                list(ops), list(preconditions))
+                list(ops), list(preconditions), _headroom=False)
         self._maybe_wait(seq)
         return result
 
-    def delete_relationships(self, f, preconditions=()):
+    def delete_relationships(self, f, preconditions=(), *,
+                             _headroom: bool = True):
         from dataclasses import asdict
 
+        if _headroom:
+            self._write_headroom(1)
         self._require_replicas()
         with self._lock:
             seq = self._publish("delete_relationships", {
@@ -537,7 +555,7 @@ class MirroredEngine:
                     for p in preconditions],
             })
             result = self.engine.delete_relationships(
-                f, list(preconditions))
+                f, list(preconditions), _headroom=False)
         self._maybe_wait(seq)
         return result
 
@@ -740,16 +758,24 @@ def _apply_one(engine, frame: dict, m: str,
     from ..engine.store import Precondition, WriteOp
 
     if m == "write_relationships":
+        # _headroom=False: a replicated frame is already committed to
+        # the stream — a follower shedding it on overlay back-pressure
+        # would silently fork the store lineages. The overlay still
+        # absorbs it when it fits; overflow falls back to a counted
+        # recompile (and the follower's own compactor, when enabled,
+        # folds in the background).
         engine.write_relationships(
             [WriteOp(o["op"], _rel_from_dict(o["rel"]))
              for o in frame["ops"]],
             [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
-             for p in frame.get("preconditions", [])])
+             for p in frame.get("preconditions", [])],
+            _headroom=False)
     elif m == "delete_relationships":
         engine.delete_relationships(
             _filter_from_dict(frame["filter"]),
             [Precondition(_filter_from_dict(p["filter"]), p["must_exist"])
-             for p in frame.get("preconditions", [])])
+             for p in frame.get("preconditions", [])],
+            _headroom=False)
     elif m == "bulk_load":
         if blob is not None:
             from ..persistence.codec import decode_bulk_cols

@@ -91,11 +91,12 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
         prop = jax.ops.segment_max(
             gathered, dst, num_segments=Mp, indices_are_sorted=True
         ).T  # [B, Mp] — this chip's partial
-        # incremental delta segment: applied at every phase; off-level
-        # contributions are dropped by the caller's range-scoped merge
+        # incremental delta overlay: applied at every phase (append
+        # order — NOT dst-sorted); off-level contributions are dropped
+        # by the caller's range-scoped merge
         gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T
         prop = prop | jax.ops.segment_max(
-            gathered_d, ddst, num_segments=Mp, indices_are_sorted=True
+            gathered_d, ddst, num_segments=Mp, indices_are_sorted=False
         ).T
         # dense blocks of this level: this chip contracts its src-axis
         # chunk of A against the matching frontier columns
@@ -217,20 +218,25 @@ class ShardedGraph:
         self._edge_sh = NamedSharding(mesh, P("graph"))
         self._block_sh = NamedSharding(mesh, P(None, "graph"))
 
-        level_arrays, kept = self._host_level_edges()
-        # host copies for the incremental dead-pair search (per level,
-        # each dst-sorted)
-        self._h_levels = level_arrays
-        self._level_edges = tuple(
-            tuple(jax.device_put(a, self._edge_sh) for a in triple)
-            for triple in level_arrays
-        )
-        self._block_meta = tuple(kept)
-        self._blocks = tuple(
-            jax.device_put(self._block_matrix(bm), self._block_sh)
-            for bm in kept
-        )
-        self._dsrc, self._ddst, self._dexp = self._delta_device(cg)
+        # the overlay host arrays (delta segment, res_exp, dead ledger)
+        # are SHARED and mutated in place by incremental_update — read
+        # them under the graph's host guard so a racing overlay append
+        # cannot tear the snapshot this build uploads
+        with cg._host_guard():
+            level_arrays, kept = self._host_level_edges()
+            # host copies for the incremental dead-pair search (per
+            # level, each dst-sorted)
+            self._h_levels = level_arrays
+            self._level_edges = tuple(
+                tuple(jax.device_put(a, self._edge_sh) for a in triple)
+                for triple in level_arrays
+            )
+            self._block_meta = tuple(kept)
+            self._blocks = tuple(
+                jax.device_put(self._block_matrix(bm), self._block_sh)
+                for bm in kept
+            )
+            self._dsrc, self._ddst, self._dexp = self._delta_device(cg)
         # dead pairs already folded into this build (updated() applies
         # only the new tail)
         self._applied_dead = _pair_keys(cg.dead_pairs)
@@ -244,20 +250,24 @@ class ShardedGraph:
                 "level edge arrays out of step with stratification")
         fn = partial(_run_sharded, meta, self._block_meta, self.ng,
                      max_iters=max_iters)
-        self._run = jax.jit(
-            shard_map(
-                fn,
-                mesh=mesh,
-                in_specs=(
-                    tuple((P("graph"),) * 3 for _ in self._level_edges),
-                    tuple(P(None, "graph") for _ in kept),
-                    P("graph"), P("graph"), P("graph"),
-                    P("data", None), P("data", None), P(),
-                ),
-                out_specs=(P(None, None), P(), P()),
-                check_vma=False,
-            )
+        smap_kw = dict(
+            mesh=mesh,
+            in_specs=(
+                tuple((P("graph"),) * 3 for _ in self._level_edges),
+                tuple(P(None, "graph") for _ in kept),
+                P("graph"), P("graph"), P("graph"),
+                P("data", None), P("data", None), P(),
+            ),
+            out_specs=(P(None, None), P(), P()),
         )
+        try:
+            smapped = shard_map(fn, check_vma=False, **smap_kw)
+        except TypeError:
+            # older jax spells the replication-check toggle check_rep —
+            # and its default (True) has no replication rule for
+            # while_loop, so it must be disabled, not defaulted
+            smapped = shard_map(fn, check_rep=False, **smap_kw)
+        self._run = jax.jit(smapped)
 
     # -- host-side construction ---------------------------------------------
 
@@ -465,7 +475,8 @@ class ShardedGraph:
                         blocks[i].at[dl, sl].set(0), self._block_sh)
                 new._blocks = tuple(blocks)
         new._applied_dead = keys
-        new._dsrc, new._ddst, new._dexp = new._delta_device(cg)
+        with cg._host_guard():
+            new._dsrc, new._ddst, new._dexp = new._delta_device(cg)
         return new
 
     # -- dispatch -----------------------------------------------------------

@@ -49,6 +49,11 @@ def main(argv=None) -> int:
             # SIGTERM, never leave a torn half-written tail line
             await asyncio.get_running_loop().run_in_executor(
                 None, cfg.deps.audit.close)
+        if hasattr(cfg.engine, "close_compaction"):
+            # stop the overlay compactor before the final snapshot /
+            # checkpoint so no fold races the state capture below
+            await asyncio.get_running_loop().run_in_executor(
+                None, cfg.engine.close_compaction)
         if opts.snapshot_path and hasattr(cfg.engine, "save_snapshot"):
             cfg.engine.save_snapshot(opts.snapshot_path)
             logging.info("saved snapshot to %s", opts.snapshot_path)

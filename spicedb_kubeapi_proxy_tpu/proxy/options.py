@@ -185,6 +185,15 @@ class Options:
     authz_cache: bool = True
     authz_cache_size: int = 65536  # max cached decisions (LRU entries)
     authz_cache_mask_bytes: int = 256 << 20  # resident lookup-mask budget
+    # device-resident delta overlay (ops/reachability.py): fixed overlay
+    # capacity per compiled graph (part of the jit signature — appends
+    # never re-specialize) and the occupancy fraction that wakes the
+    # background compactor (engine/compaction.py). 0 threshold disables
+    # compaction: overlay overflow then falls back to a synchronous
+    # recompile on the next fully-consistent read. In-process engines
+    # only — a tcp:// engine host owns its own overlay (same flags there).
+    delta_capacity: int = 4096
+    compact_threshold: float = 0.75
     # >0 probes the device backend in a SUBPROCESS with this timeout
     # before building an in-process engine: the remotely-attached TPU
     # plugin HANGS (not errors) when its tunnel is down, which would
@@ -432,6 +441,15 @@ class Options:
             raise OptionsError("authz-cache-size must be >= 1")
         if self.authz_cache_mask_bytes < 0:
             raise OptionsError("authz-cache-mask-bytes must be >= 0")
+        from ..engine.compaction import validate_overlay_config
+
+        try:
+            # ONE owner for the overlay flag bounds, shared with the
+            # engine-host CLI
+            validate_overlay_config(self.delta_capacity,
+                                    self.compact_threshold)
+        except ValueError as e:
+            raise OptionsError(str(e)) from None
         if bool(self.tls_cert_file) != bool(self.tls_key_file):
             raise OptionsError(
                 "tls-cert-file and tls-key-file must be set together")
@@ -556,7 +574,13 @@ class Options:
                 from ..parallel import make_mesh
 
                 mesh = make_mesh(**_parse_mesh_spec(self.engine_mesh))
-            engine = Engine(bootstrap=bootstrap or None, mesh=mesh)
+            engine = Engine(bootstrap=bootstrap or None, mesh=mesh,
+                            delta_capacity=self.delta_capacity)
+            if self.compact_threshold > 0:
+                # background overlay folds + overlay-full write
+                # back-pressure (engine/compaction.py); 0 restores the
+                # synchronous-recompile fallback on overflow
+                engine.enable_compaction(self.compact_threshold)
             if self.data_dir:
                 engine.enable_persistence(
                     self.data_dir, wal_fsync=self.wal_fsync,
@@ -751,6 +775,7 @@ class Options:
         "data_dir", "wal_fsync", "checkpoint_wal_bytes",
         "checkpoint_wal_records", "checkpoint_keep",
         "authz_cache", "authz_cache_size", "authz_cache_mask_bytes",
+        "delta_capacity", "compact_threshold",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
@@ -926,6 +951,23 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         default=256 << 20,
                         help="resident lookup-mask byte budget; the "
                              "cold end evicts past it")
+    parser.add_argument("--delta-capacity", type=int, default=4096,
+                        help="device-resident delta-overlay slots per "
+                             "compiled graph (fixed — part of the jit "
+                             "signature, so writes never re-specialize); "
+                             "size to the write burst one compaction "
+                             "interval must absorb (in-process engines "
+                             "only; pass the same flag to a tcp:// "
+                             "engine host)")
+    parser.add_argument("--compact-threshold", type=float, default=0.75,
+                        help="overlay-occupancy fraction that wakes the "
+                             "background compactor folding the delta "
+                             "tail into a fresh base off the write path; "
+                             "a full overlay then SHEDS writes with a "
+                             "bounded Retry-After instead of stalling a "
+                             "read on a synchronous recompile (0 "
+                             "disables compaction and restores the "
+                             "synchronous fallback)")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
     parser.add_argument("--enable-debug-config", action="store_true",
@@ -1118,6 +1160,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         authz_cache=args.authz_cache,
         authz_cache_size=args.authz_cache_size,
         authz_cache_mask_bytes=args.authz_cache_mask_bytes,
+        delta_capacity=args.delta_capacity,
+        compact_threshold=args.compact_threshold,
         engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,

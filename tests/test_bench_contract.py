@@ -111,6 +111,17 @@ def _assert_macro_schema(macro: dict) -> None:
     for v in macro["slo_attainment"].values():
         assert v is None or 0.0 <= v <= 1.0
     assert macro["slo_monitor"]
+    # ISSUE 8: every macro result carries the overlay on/off comparison
+    # (the same trace re-swept with IncrementalGraphUpdates off) and its
+    # per-multiplier goodput ratio, plus the scale annotation
+    off = macro["overlay_off"]
+    assert off["curve"]
+    for pt in off["curve"]:
+        assert pt["offered_rps"] > 0
+    assert off["goodput_ratio_on_over_off"]
+    for v in off["goodput_ratio_on_over_off"].values():
+        assert isinstance(v, (int, float)) and v > 0
+    assert macro["scale"]["n_ns"] >= 1
     # reproducibility pin: the recorded seed + the digest of the top
     # point's REBUILT schedule (identical seed => identical schedule)
     assert isinstance(macro["seed"], int)

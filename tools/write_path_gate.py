@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""CI regression gate for the write path (delta overlay, ISSUE 8).
+
+Reads a bench.py result JSON (argument path or stdin) and enforces the
+two hardware-independent write-path invariants:
+
+1. **Zero full recompiles in the steady-state churn loop.** The
+   measured write->read pairs run against pre-existing objects, so every
+   write must be absorbed by the device-resident overlay
+   (``read_after_write.recompiles == 0``). A single recompile means the
+   incremental path silently regressed to the per-write re-encode.
+
+2. **Read-after-write tracks the read-only dispatch.** The gate is the
+   RATIO of fully-consistent read-after-write p50 to the same run's
+   read-only list-filter p50 — a quantity internal to one run, so it
+   holds on any backend speed. The recorded seed (BENCH_r05, before the
+   overlay) sat at 3.43ms / 1.59ms = **2.16x**: every write paid a
+   host-side re-encode before the next query could dispatch. With the
+   overlay a write adds only an O(write) append, so the ratio must stay
+   under ``WRITE_PATH_RATIO`` (default 1.8 — comfortably below the
+   seed's 2.16, comfortably above measurement jitter).
+
+Exit 0 on pass, 1 with a named reason on fail, 2 on malformed input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+MAX_RATIO = float(os.environ.get("WRITE_PATH_RATIO", "1.8"))
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        with open(sys.argv[1]) as f:
+            raw = f.read()
+    else:
+        raw = sys.stdin.read()
+    # bench.py's contract is exactly one JSON line on stdout, but be
+    # lenient about surrounding log noise: take the last parseable line
+    result = None
+    for line in reversed(raw.strip().splitlines()):
+        try:
+            result = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if not isinstance(result, dict):
+        print("write-path gate: no JSON result found", file=sys.stderr)
+        return 2
+    if result.get("error"):
+        print(f"write-path gate: bench errored: {result['error']}",
+              file=sys.stderr)
+        return 2
+
+    raw_block = result.get("read_after_write")
+    if not isinstance(raw_block, dict):
+        print("write-path gate: result carries no read_after_write "
+              "block (bench too old, or the phase was skipped)",
+              file=sys.stderr)
+        return 1
+    failures = []
+    recompiles = raw_block.get("recompiles")
+    if recompiles != 0:
+        failures.append(
+            f"{recompiles} full recompile(s) during steady-state write "
+            "churn (expected 0: every write must ride the delta overlay)")
+    p50_raw = result.get("p50_read_after_write_ms")
+    p50_read = result.get("p50_wall_ms")
+    if not p50_raw or not p50_read:
+        failures.append("missing p50_read_after_write_ms / p50_wall_ms")
+    else:
+        ratio = p50_raw / p50_read
+        verdict = "OK" if ratio <= MAX_RATIO else "FAIL"
+        print(f"write-path gate: read-after-write {p50_raw:.2f}ms / "
+              f"read-only {p50_read:.2f}ms = {ratio:.2f}x "
+              f"(limit {MAX_RATIO}x, seed was 2.16x) [{verdict}]")
+        if ratio > MAX_RATIO:
+            failures.append(
+                f"read-after-write p50 is {ratio:.2f}x the read-only "
+                f"p50 (limit {MAX_RATIO}x): the write path is paying "
+                "more than an overlay append again")
+    if failures:
+        for f_ in failures:
+            print(f"write-path gate FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(f"write-path gate PASS: {raw_block.get('incremental_updates')} "
+          "overlay updates, 0 recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,7 @@ lint:
 # on their own before the big run).
 verify: lint
 	@if [ "$(CHAOS)" = "1" ]; then $(MAKE) chaos; fi
+	$(PY) -m pytest -q -p no:cacheprovider tests/test_caveats.py
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1016,6 +1016,14 @@ def _measure(args, result: dict) -> None:
         except Exception as ex:  # noqa: BLE001 - aux measurement only
             log(f"admission section failed (non-fatal): {ex}")
 
+    # -- device-side caveat evaluation (ISSUE 9): caveated-mix cold/warm
+    # check p50 with and without request context. Runs at EVERY scale
+    # including --tiny (the result schema is contract-test-pinned).
+    try:
+        _caveat_phase(result, quick)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        log(f"caveat section failed (non-fatal): {ex}")
+
     # -- open-loop trace-shaped macrobench (ROADMAP item 5) --
     # Runs at EVERY scale including --tiny: the macro result schema is
     # contract-test-pinned, and the sweep is the harness later
@@ -1627,6 +1635,94 @@ class _WatchStreamHarness:
         self._thread.join(timeout=5)
         if not self._thread.is_alive():
             self._loop.close()  # release the selector/self-pipe fds
+
+
+def _caveat_phase(result: dict, quick: bool) -> None:
+    """Conditional grants (ISSUE 9): a caveated-mix graph — 30% of the
+    viewer tuples carry an IP-allowlist caveat — measured for cold and
+    warm (decision-cached) bulk-check p50 WITH a satisfying request
+    context, WITHOUT context (missing-context fail-closed denies), and
+    against the uncaveated baseline. The acceptance bar is the
+    caveated/uncaveated cold ratio (the caveat VM rides the same
+    dispatch as the fixpoint, so it should be well under 1.5x)."""
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem, Engine
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    n_docs = 256 if quick else 2048
+    share = 0.3
+    n_cav = int(n_docs * share)
+    e = Engine(bootstrap="""
+schema: |-
+  caveat ip_allowlist(ip ipaddress, allowed list<ipaddress>) {
+    ip in allowed
+  }
+  definition user {}
+  definition doc {
+    relation viewer: user | user with ip_allowlist
+    permission view = viewer
+  }
+relationships: ""
+""")
+    names = np.char.add("d", np.arange(n_docs).astype(str))
+    ctx_json = '{"allowed":["10.0.0.0/8","192.168.0.0/16"]}'
+    e.bulk_load({
+        "resource_type": np.full(n_docs, "doc"),
+        "resource_id": names,
+        "relation": np.full(n_docs, "viewer"),
+        "subject_type": np.full(n_docs, "user"),
+        "subject_id": np.full(n_docs, "alice"),
+        "caveat": np.where(np.arange(n_docs) < n_cav,
+                           "ip_allowlist", ""),
+        "caveat_context": np.where(np.arange(n_docs) < n_cav,
+                                   ctx_json, ""),
+    })
+    items_cav = [CheckItem("doc", f"d{i}", "view", "user", "alice")
+                 for i in range(n_cav)]
+    items_unc = [CheckItem("doc", f"d{i}", "view", "user", "alice")
+                 for i in range(n_cav, 2 * n_cav)]
+    req_ctx = {"ip": "10.1.2.3"}
+    # correctness spot check + jit warmup (compiles happen HERE, not in
+    # the timed loops)
+    assert all(e.check_bulk(items_cav, context=req_ctx))
+    assert all(e.check_bulk(items_unc))
+    miss0 = metrics.counter(
+        "engine_caveat_denied_missing_context_total").value
+    assert not any(e.check_bulk(items_cav))  # missing ctx: fail closed
+    denied_missing = metrics.counter(
+        "engine_caveat_denied_missing_context_total").value - miss0
+
+    def p50(fn, trials=9):
+        lat = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            fn()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return float(np.percentile(lat, 50))
+
+    cold_unc = p50(lambda: e.check_bulk(items_unc))
+    cold_ctx = p50(lambda: e.check_bulk(items_cav, context=req_ctx))
+    cold_noctx = p50(lambda: e.check_bulk(items_cav))
+    e.enable_decision_cache()
+    e.check_bulk(items_cav, context=req_ctx)  # prime
+    e.check_bulk(items_unc)
+    warm_ctx = p50(lambda: e.check_bulk(items_cav, context=req_ctx))
+    warm_unc = p50(lambda: e.check_bulk(items_unc))
+    e.disable_decision_cache()
+    ratio = cold_ctx / max(cold_unc, 1e-9)
+    result["caveats"] = {
+        "n_tuples": int(n_docs),
+        "caveated_share": share,
+        "check_p50_uncaveated_ms": round(cold_unc, 3),
+        "check_p50_caveated_ctx_ms": round(cold_ctx, 3),
+        "check_p50_caveated_noctx_ms": round(cold_noctx, 3),
+        "warm_p50_caveated_ctx_ms": round(warm_ctx, 4),
+        "warm_p50_uncaveated_ms": round(warm_unc, 4),
+        "caveated_over_uncaveated": round(ratio, 3),
+        "missing_context_denials": int(denied_missing),
+    }
+    log(f"caveat mix: {n_docs} tuples ({share:.0%} caveated) "
+        f"cold ctx p50 {cold_ctx:.2f}ms vs uncaveated {cold_unc:.2f}ms "
+        f"(ratio {ratio:.2f}x), warm ctx {warm_ctx:.3f}ms")
 
 
 def _macro_phase(result: dict, quick: bool, tiny: bool,

@@ -50,6 +50,7 @@ _OP_CLASSES = {
     "check_bulk": CHECK,  # promoted to BULK_CHECK by item count
     "lookup_resources": LOOKUP_PREFILTER,
     "lookup_mask": LOOKUP_PREFILTER,
+    "lookup_subjects": LOOKUP_PREFILTER,  # chunked bulk checks inside
     "read_relationships": CHECK,
     "watch_since": WATCH_RECOMPUTE,
     "write_relationships": WRITE_DTX,

@@ -40,33 +40,41 @@ def collect_all_items(rules: list[RunnableRule], input: ResolveInput,
 
 def run_checks(engine: Engine, rules: list[RunnableRule],
                input: ResolveInput, post: bool = False,
-               items: Optional[list[CheckItem]] = None) -> bool:
+               items: Optional[list[CheckItem]] = None,
+               context: Optional[dict] = None) -> bool:
     """True iff every generated check passes (fully consistent).
     ``items`` skips re-generating the check relationships when the caller
-    already collected them (the cached-probe fast path)."""
+    already collected them (the cached-probe fast path). ``context`` is
+    the request's caveat context (client IP, caller attributes) gating
+    conditional grants — missing context fails closed at the engine."""
     if items is None:
         items = collect_all_items(rules, input, post)
     if not items:
         return True
+    if context:
+        return all(engine.check_bulk(items, context=context))
     return all(engine.check_bulk(items))
 
 
 def cached_verdict(engine: Engine, rules: list[RunnableRule],
-                   input: ResolveInput, post: bool = False
+                   input: ResolveInput, post: bool = False,
+                   context: Optional[dict] = None
                    ) -> tuple[list[CheckItem], Optional[bool]]:
     """Non-blocking decision-cache probe: ``(items, verdict)`` where
     ``verdict`` is the combined answer when EVERY generated check hit the
     engine's decision cache, else ``None`` (caller falls back to
     :func:`run_checks` off-loop — the probe never dispatches or blocks,
     so the middleware can run it on the event loop and skip the
-    ``asyncio.to_thread`` hop entirely on a full hit)."""
+    ``asyncio.to_thread`` hop entirely on a full hit). Contexted
+    requests probe under their context digest — a conditional verdict
+    can never be served across contexts."""
     items = collect_all_items(rules, input, post)
     if not items:
         return items, True
     probe = getattr(engine, "try_cached_check", None)
     if probe is None:  # remote engines have no local cache to probe
         return items, None
-    got = probe(items)
+    got = probe(items, context=context) if context else probe(items)
     if got is None:
         return items, None
     return items, all(got)

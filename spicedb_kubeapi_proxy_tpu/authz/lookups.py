@@ -93,7 +93,8 @@ def single_prefilter(rules: list[RunnableRule]) -> Optional[tuple[RunnableRule, 
 
 def run_prefilter_sync(engine: Engine, pf: PreFilter,
                        input: ResolveInput,
-                       strict: bool = True, lookup=None) -> AllowedSet:
+                       strict: bool = True, lookup=None,
+                       context: Optional[dict] = None) -> AllowedSet:
     """``strict=False`` skips ids whose name/namespace mapping expression
     fails instead of raising — for MID-STREAM recomputes, where one
     unmappable id must not freeze the allowed set (a frozen set fails
@@ -115,7 +116,17 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
             f"prefilter resource ID must be {MATCHING_ID_FIELD_VALUE!r}, "
             f"got {rel.resource_id!r} (reference lookups.go:49-56)")
     if lookup is not None:
+        # shared-batcher recomputes (watch hub) carry no request context:
+        # conditional grants resolve from tuple context alone, missing
+        # request-only parameters fail closed — the safe direction for a
+        # mid-stream allowed-set refresh
         ids = lookup(rel)
+    elif context:
+        ids = engine.lookup_resources(
+            rel.resource_type, rel.resource_relation,
+            rel.subject_type, rel.subject_id, rel.subject_relation or None,
+            context=context,
+        )
     else:
         ids = engine.lookup_resources(
             rel.resource_type, rel.resource_relation,
@@ -174,9 +185,10 @@ def run_prefilter_sync(engine: Engine, pf: PreFilter,
 
 async def run_prefilter(engine: Engine, pf: PreFilter,
                         input: ResolveInput,
-                        strict: bool = True, lookup=None) -> AllowedSet:
+                        strict: bool = True, lookup=None,
+                        context: Optional[dict] = None) -> AllowedSet:
     """Async wrapper so the device query overlaps the upstream kube request
     (the reference overlaps via goroutine+channel,
     responsefilterer.go:165-183)."""
     return await asyncio.to_thread(run_prefilter_sync, engine, pf, input,
-                                   strict, lookup)
+                                   strict, lookup, context)

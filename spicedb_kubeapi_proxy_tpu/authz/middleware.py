@@ -77,6 +77,52 @@ class AuthzDeps:
     # authorization verdict — denies always, allows rate-capped;
     # None = no audit (today's behavior)
     audit: Optional[object] = None
+    # request caveat context (caveats/): when enabled, every engine-bound
+    # phase carries the caller's attributes (client IP from the trusted
+    # header below, user name/groups, verb/resource) so conditional
+    # grants — IP allowlists, attribute gates — resolve on-device;
+    # missing context fails closed at the engine
+    caveat_context_enabled: bool = True
+    # the header the proxy trusts for the client IP (set by your LB /
+    # ingress; the LAST hop of a comma-separated X-Forwarded-For — the
+    # one the trusted proxy appended)
+    caveat_ip_header: str = "x-forwarded-for"
+
+
+def request_caveat_context(info, user, headers: dict,
+                           ip_header: str = "x-forwarded-for") -> dict:
+    """The request's caveat-context dict: what a caveat expression can
+    see about the CALLER. Keys are caveat parameter names (SpiceDB
+    passes request context the same way); the engine auto-injects the
+    dispatch clock as ``now``. The client IP comes only from the
+    configured trusted header — never from unauthenticated ones."""
+    ctx: dict = {
+        "user": (user.name if user else "") or "",
+        "groups": list(user.groups) if user and user.groups else [],
+        "verb": info.verb,
+        "resource": info.resource,
+        "namespace": info.namespace,
+        "name": info.name,
+    }
+    raw = ""
+    want = ip_header.lower()
+    for k, v in (headers or {}).items():
+        if k.lower() == want:
+            raw = v
+            break
+    if raw:
+        # LAST hop of a comma-separated chain: standard LBs/ingresses
+        # APPEND the address they verified to whatever the client sent,
+        # so earlier entries are attacker-controlled — trusting the
+        # first hop would let any caller spoof an allowlisted IP with a
+        # forged header. (Single-value headers the ingress overwrites,
+        # e.g. x-real-ip, are unaffected.) Tolerate a :port suffix.
+        hop = raw.split(",")[-1].strip()
+        if hop.count(":") == 1 and "." in hop:
+            hop = hop.split(":")[0]
+        if hop:
+            ctx["ip"] = hop
+    return ctx
 
 
 def _audit(deps: AuthzDeps, info, user, *, allow: bool,
@@ -242,6 +288,14 @@ async def _authorize_inner(req: ProxyRequest,
                 f"user {user.name!r} cannot {info.verb} {info.resource}",
                 "Forbidden")
 
+    # -- request caveat context: the caller attributes conditional grants
+    # evaluate against (client IP, user, verb...), extracted ONCE and
+    # carried by every engine-bound phase of this request. None when
+    # disabled — caveats needing request context then fail closed.
+    caveat_ctx = (request_caveat_context(info, user, req.headers,
+                                         deps.caveat_ip_header)
+                  if deps.caveat_context_enabled else None)
+
     # -- admission control (admission/): the request is about to touch the
     # engine — acquire a cost-classed slot under the caller's tenant
     # identity FIRST, so one subject's LookupResources storm queues behind
@@ -250,7 +304,8 @@ async def _authorize_inner(req: ProxyRequest,
     # authorize() above turns it into the fail-closed 503 + Retry-After —
     # before any check dispatch, workflow enqueue, or upstream byte.
     if deps.admission is None:
-        return await _authorized(req, deps, info, user, input, rules)
+        return await _authorized(req, deps, info, user, input, rules,
+                                 caveat_ctx=caveat_ctx)
     from ..admission import classify_request
 
     with tracer.span("admission_wait") as sp:
@@ -260,7 +315,7 @@ async def _authorize_inner(req: ProxyRequest,
             user.name or "system:anonymous", cls)
     try:
         return await _authorized(req, deps, info, user, input, rules,
-                                 ticket)
+                                 ticket, caveat_ctx=caveat_ctx)
     finally:
         # backstop for the paths whose engine work OVERLAPS or FOLLOWS
         # the upstream call (prefilter, postfilter, postchecks): they
@@ -276,7 +331,7 @@ async def _authorize_inner(req: ProxyRequest,
 
 async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
                       input: ResolveInput, rules,
-                      ticket=None) -> ProxyResponse:
+                      ticket=None, caveat_ctx=None) -> ProxyResponse:
     """The engine-bound phases (checks onward). The admission ticket,
     when admission is enabled, is held from the check phase until the
     last engine-bound segment of the request: it is released before
@@ -294,7 +349,8 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         # (concurrent requests pipeline their dispatches; the reference
         # fans checks out over goroutines, check.go:77-93)
         with tracer.span("cache_probe") as sp:
-            items, verdict = cached_verdict(deps.engine, rules, input)
+            items, verdict = cached_verdict(deps.engine, rules, input,
+                                            context=caveat_ctx)
             sp.set("hit", verdict is not None)
         # a fully-cached verdict means this span dispatched NOTHING: its
         # (floor-clamped) duration must not feed the limiter's baseline,
@@ -304,7 +360,8 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         if verdict is None:
             with tracer.span("engine_dispatch", items=len(items)):
                 verdict = await asyncio.to_thread(
-                    run_checks, deps.engine, rules, input, items=items)
+                    run_checks, deps.engine, rules, input, items=items,
+                    context=caveat_ctx)
         if not verdict:
             _audit(deps, info, user, allow=False, rules=rules,
                    reason="check denied", cache_hit=not engine_sampled)
@@ -392,7 +449,8 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
             # lands on this request's trace even though the prefilter
             # runs concurrently with the upstream round trip
             with tracer.span("prefilter"):
-                return await run_prefilter(deps.engine, pf[1], input)
+                return await run_prefilter(deps.engine, pf[1], input,
+                                           context=caveat_ctx)
 
         prefilter_task = asyncio.ensure_future(_traced_prefilter())
     if ticket is not None and prefilter_task is None \
@@ -436,7 +494,7 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
             with tracer.span("postfilter"):
                 resp = await asyncio.to_thread(
                     filter_list_response, deps.engine, post_filters,
-                    input, resp)
+                    input, resp, caveat_ctx)
         except ExprError as e:
             return kube_status(401, f"postfilter: {e}")
 
@@ -452,12 +510,13 @@ async def _authorized(req: ProxyRequest, deps: AuthzDeps, info, user,
         try:
             with tracer.span("postcheck"):
                 post_items, post_verdict = cached_verdict(
-                    deps.engine, rules, input, post=True)
+                    deps.engine, rules, input, post=True,
+                    context=caveat_ctx)
                 post_cached = post_verdict is not None
                 if post_verdict is None:
                     post_verdict = await asyncio.to_thread(
                         run_checks, deps.engine, rules, input, post=True,
-                        items=post_items)
+                        items=post_items, context=caveat_ctx)
             _audit(deps, info, user, allow=bool(post_verdict),
                    rules=rules,
                    reason=("postchecks passed" if post_verdict

@@ -35,7 +35,8 @@ def _item_input(input: ResolveInput, obj: dict) -> ResolveInput:
 
 def filter_list_response(engine: Engine, post_filters: list[PostFilter],
                          input: ResolveInput,
-                         resp: ProxyResponse) -> ProxyResponse:
+                         resp: ProxyResponse,
+                         context: dict = None) -> ProxyResponse:
     if resp.status != 200:
         return resp
     try:
@@ -65,7 +66,8 @@ def filter_list_response(engine: Engine, post_filters: list[PostFilter],
                     rel.subject_relation or None,
                 ))
                 item_index.append(i)
-    results = engine.check_bulk(items)
+    results = (engine.check_bulk(items, context=context) if context
+               else engine.check_bulk(items))
     ok = [True] * len(objs)
     for ci, passed in enumerate(results):
         if not passed:

@@ -1,0 +1,34 @@
+"""Device-side caveat evaluation: conditional grants as masked tensor ops.
+
+SpiceDB caveats are CEL expressions attached to relationships; a caveated
+tuple participates in a check only when its expression evaluates true
+under the union of the tuple's stored context and the request's context
+(missing context fails CLOSED). The reference evaluates them one
+relationship at a time inside the dispatcher; this package compiles each
+caveat into a flat op tape evaluated for EVERY caveated tuple in a batch
+by a vectorized expression VM (``lax.scan`` over the tape, ``lax.switch``
+over opcodes — one jitted program per tape shape, never per caveat), so
+the per-tuple tri-state (grant / deny / missing-context) lands in the
+same device dispatch as the reachability fixpoint.
+
+Layout:
+
+- :mod:`.ast` — expression grammar (comparisons, boolean ops, arithmetic,
+  ``in`` membership, timestamp/ipaddress literals), a recursive-descent
+  parser, and the pure-Python tri-state interpreter (the differential
+  oracle for the VM);
+- :mod:`.compile` — constant folding + lowering to the register tape;
+- :mod:`.vm` — the jax evaluator and the host-side instance tables
+  (per-tuple context columns, request-context encoding, cache-deadline
+  time bounds).
+"""
+
+from .ast import (  # noqa: F401
+    CaveatDef,
+    CaveatError,
+    CaveatParam,
+    interpret,
+    parse_caveat_body,
+)
+from .compile import CaveatProgram, compile_caveat  # noqa: F401
+from .vm import CompiledCaveats, build_caveat_table  # noqa: F401

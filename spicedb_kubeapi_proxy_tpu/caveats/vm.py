@@ -1,0 +1,761 @@
+"""The vectorized caveat VM and its host-side instance tables.
+
+Execution model
+---------------
+One caveat = one op tape (:mod:`.compile`); one *instance* = one distinct
+``(caveat, context)`` pair carried by at least one live tuple. The VM
+evaluates every instance of every caveat in ONE traced pass per device
+dispatch (``lax.scan`` over the tape, ``lax.switch`` over opcodes), and
+the reachability fixpoint consumes the result as a per-instance validity
+row — edge activation becomes ``(exp > now) & cav_ok[edge_row]``, fused
+into the same jit as the fixpoint (zero per-tuple host round trips).
+
+Value representation
+--------------------
+TPUs run without x64, so a single f32 plane cannot hold IPv4 addresses,
+interned string codes past 2^24, or unix timestamps exactly. Every
+scalar therefore rides TWO f32 planes::
+
+    ext = floor(v / 2**16)        val = v - ext * 2**16
+
+a monotone split that is exact for all integers |v| < 2^40 (both planes
+stay under 2^24): comparisons are lexicographic on (ext, val), equality
+is plane-wise, and ``in`` is a lexicographic [lo, hi] range check per
+list element — which makes CIDR allowlists ordinary interval tests.
+Additions renormalize the carry; mul/div recombine into one f32 (wide
+products lose low bits, but arithmetic on wide domains — IPs — is
+meaningless anyway and timestamp arithmetic already disables caching).
+
+Three-valued logic rides an explicit ``known`` plane (never NaN):
+missing context flows structurally, ``&&``/``||`` are Kleene, and the
+top-level UNKNOWN is the missing-context verdict the engine fails
+closed and counts.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .ast import (
+    CaveatError,
+    StringInterner,
+    encode_list,
+    encode_scalar,
+)
+from .compile import (
+    CaveatProgram,
+    N_OPCODES,
+    OP_ADD,
+    OP_AND,
+    OP_CONST,
+    OP_DIV,
+    OP_EQ,
+    OP_GE,
+    OP_GT,
+    OP_IN,
+    OP_LE,
+    OP_LOAD,
+    OP_LT,
+    OP_MUL,
+    OP_NE,
+    OP_NOT,
+    OP_OR,
+    OP_SUB,
+    compile_caveat,
+)
+
+SPLIT = 65536.0  # the plane radix (2^16)
+
+#: the auto-injected request-context key: a ``now timestamp`` parameter
+#: is filled with the dispatch clock unless the caller supplied it
+NOW_PARAM = "now"
+
+
+def split_planes(v) -> tuple[np.ndarray, np.ndarray]:
+    """f64 value(s) -> (ext, val) f32 planes (monotone, integer-exact
+    to 2^40)."""
+    v = np.asarray(v, dtype=np.float64)
+    ext = np.floor(v / SPLIT)
+    return ext.astype(np.float32), (v - ext * SPLIT).astype(np.float32)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass(frozen=True)
+class CavMeta:
+    """Static shape of one caveat's VM invocation — baked into the jit
+    signature (RunMeta.caveats), shared across revisions. ``P``/``L``
+    are the ALLOCATED context/list rows (>= 1 so every lax.switch
+    branch traces against real shapes even when unused)."""
+
+    name: str
+    T: int  # tape length
+    n_regs: int
+    out_reg: int
+    P: int  # scalar context columns (allocated)
+    L: int  # list ids (allocated)
+    K: int  # list capacity (elements per list)
+    n_pad: int  # instance rows (padded)
+    n_real: int  # real instances at compile time
+    row_off: int  # global cav_ok row of this caveat's instance 0
+
+
+@dataclass
+class _CavHost:
+    """One caveat's host-side arrays (shared across incremental
+    descendants; mutated only under the graph's host lock)."""
+
+    program: CaveatProgram
+    ctx_e: np.ndarray  # f32 [P, n_pad]
+    ctx_v: np.ndarray
+    ctx_k: np.ndarray  # bool [P, n_pad]
+    lo_e: np.ndarray  # f32 [L, K, n_pad]
+    lo_v: np.ndarray
+    hi_e: np.ndarray
+    hi_v: np.ndarray
+    list_k: np.ndarray  # bool [L, n_pad]
+    real: np.ndarray  # bool [n_pad] — 1 = a live instance row
+
+
+def _dict_timestamps(prog: CaveatProgram, ctx: dict) -> list[float]:
+    """Timestamp values a context dict supplies for a program's declared
+    timestamp parameters (scalars and list elements) — the verdict-flip
+    instants a `now` comparison can cross."""
+    from .ast import parse_timestamp
+
+    out: list[float] = []
+    for p in prog.params:
+        if p.name == NOW_PARAM or p.name not in ctx:
+            continue
+        try:
+            if p.type.is_list and p.type.elem == "timestamp":
+                vals = ctx[p.name]
+                if isinstance(vals, list):
+                    out.extend(parse_timestamp(v) for v in vals)
+            elif not p.type.is_list and p.type.name == "timestamp":
+                out.append(parse_timestamp(ctx[p.name]))
+        except CaveatError:
+            continue
+    return out
+
+
+def _ctx_timestamps(prog: CaveatProgram, ctx_json: str) -> list[float]:
+    try:
+        ctx = json.loads(ctx_json) if ctx_json else {}
+    except ValueError:
+        return []
+    return _dict_timestamps(prog, ctx) if isinstance(ctx, dict) else []
+
+
+def _encode_instance_cols(meta: CavMeta, prog: CaveatProgram,
+                          interner: StringInterner, ctx_json: str):
+    """Encode one stored context JSON into one instance row's columns
+    (strict: tuple contexts intern new strings)."""
+    ctx = json.loads(ctx_json) if ctx_json else {}
+    if not isinstance(ctx, dict):
+        raise CaveatError(f"caveat context must be an object: {ctx_json!r}")
+    sce = np.zeros(meta.P, dtype=np.float32)
+    scv = np.zeros(meta.P, dtype=np.float32)
+    sck = np.zeros(meta.P, dtype=bool)
+    lle = np.zeros((meta.L, meta.K), dtype=np.float32)
+    llv = np.zeros((meta.L, meta.K), dtype=np.float32)
+    lhe = np.full((meta.L, meta.K), -1.0, dtype=np.float32)
+    lhv = np.zeros((meta.L, meta.K), dtype=np.float32)
+    lk = np.zeros(meta.L, dtype=bool)
+    # constant lists are "known" with their literal ranges on every row
+    for lid, spec in enumerate(prog.lists):
+        if spec.ranges is None:
+            continue
+        if len(spec.ranges) > meta.K:
+            raise CaveatError(
+                f"caveat {meta.name!r}: constant list exceeds capacity")
+        for j, (lo, hi) in enumerate(spec.ranges):
+            lle[lid, j], llv[lid, j] = split_planes(lo)
+            lhe[lid, j], lhv[lid, j] = split_planes(hi)
+        lk[lid] = True
+    for p in prog.params:
+        if p.name not in ctx:
+            continue
+        if p.type.is_list:
+            lid = prog.list_id.get(p.name)
+            if lid is None:
+                continue  # declared but unused in the expression
+            ranges = encode_list(ctx[p.name], p.type.elem, interner,
+                                 strict=True)
+            if len(ranges) > meta.K:
+                raise CaveatError(
+                    f"caveat {meta.name!r}: list {p.name!r} exceeds "
+                    f"row capacity {meta.K}")
+            for j, (lo, hi) in enumerate(ranges):
+                lle[lid, j], llv[lid, j] = split_planes(lo)
+                lhe[lid, j], lhv[lid, j] = split_planes(hi)
+            lk[lid] = True
+        else:
+            col = prog.scalar_col.get(p.name)
+            if col is None:
+                continue
+            x = encode_scalar(ctx[p.name], p.type.name, interner,
+                              strict=True)
+            sce[col], scv[col] = split_planes(x)
+            sck[col] = True
+    return sce, scv, sck, lle, llv, lhe, lhv, lk
+
+
+@dataclass
+class CompiledCaveats:
+    """Every caveat instance in one compiled graph, device-ready.
+
+    Shared (like the delta overlay) by every incremental descendant of
+    one compiled base: instance appends mutate the host arrays in place
+    under the graph's host lock and publish functional device updates
+    into the new revision's view only.
+    """
+
+    metas: tuple  # tuple[CavMeta, ...]
+    hosts: list  # list[_CavHost] aligned with metas
+    interner: StringInterner
+    n_rows: int  # 1 + sum(n_pad): row 0 = uncaveated/always-valid
+    inst_row: np.ndarray  # store caveat-instance id -> global row (0=none)
+    key_row: dict  # (name, ctx_json) -> global row
+    n_inst: int  # live instance rows (incl. appended)
+    time_bounds: np.ndarray  # sorted unique unix seconds (verdict flips)
+    time_exact: bool  # False: flips not enumerable (timestamp arith)
+    any_now: bool  # some program reads the auto-injected clock
+
+    @property
+    def n_instances(self) -> int:
+        return self.n_inst
+
+    def param_names(self) -> frozenset:
+        """Every parameter name any compiled caveat declares — the ONLY
+        request-context keys that can influence a verdict."""
+        got = getattr(self, "_param_names", None)
+        if got is None:
+            got = frozenset(
+                p.name for h in self.hosts for p in h.program.params)
+            self._param_names = got
+        return got
+
+    def relevant_context(self, context: Optional[dict]
+                         ) -> Optional[dict]:
+        """The subset of a request context the compiled caveats can
+        actually read. Decision-cache digests hash ONLY this — fields
+        no caveat declares (the middleware's name/verb/resource/...)
+        would otherwise fragment the cache per request while provably
+        unable to change any verdict."""
+        if not context:
+            return None
+        names = self.param_names()
+        out = {k: v for k, v in context.items() if k in names}
+        return out or None
+
+    def request_ts(self, context: Optional[dict]) -> list:
+        """Request-supplied verdict-flip timestamps (cache-deadline
+        input) — a cheap scan, not a full array encode."""
+        if not context or not self.any_now:
+            return []
+        out: list = []
+        for h in self.hosts:
+            if h.program.uses_now:
+                out.extend(_dict_timestamps(h.program, context))
+        return out
+
+    def signature(self) -> tuple:
+        return tuple(
+            (m.name, m.T, m.n_regs, m.out_reg, m.P, m.L, m.K, m.n_pad,
+             m.n_real, m.row_off) for m in self.metas)
+
+    # -- request-context encoding -------------------------------------------
+
+    def encode_request(self, context: Optional[dict], now: float
+                       ) -> tuple[tuple, list]:
+        """(per-caveat request arrays pytree, request timestamp values).
+
+        Unknown context keys are ignored (SpiceDB passes extra context
+        through); malformed values for a declared parameter leave the
+        parameter UNKNOWN — missing context, which fails closed — rather
+        than erroring the whole dispatch."""
+        context = context or {}
+        out = []
+        req_ts: list[float] = []
+        # ONE scratch per call: unseen request strings get distinct
+        # negative codes (consistent across this call's caveats; a
+        # shared -1 sentinel would make any two unseen strings compare
+        # equal — fail open), and nothing accumulates on the shared
+        # table under adversarial request values
+        scratch = self.interner.scratch()
+        for m, h in zip(self.metas, self.hosts):
+            prog = h.program
+            rce = np.zeros(m.P, dtype=np.float32)
+            rcv = np.zeros(m.P, dtype=np.float32)
+            rck = np.zeros(m.P, dtype=bool)
+            rloe = np.zeros((m.L, m.K), dtype=np.float32)
+            rlov = np.zeros((m.L, m.K), dtype=np.float32)
+            rhie = np.full((m.L, m.K), -1.0, dtype=np.float32)
+            rhiv = np.zeros((m.L, m.K), dtype=np.float32)
+            rlk = np.zeros(m.L, dtype=bool)
+            for p in prog.params:
+                if p.type.is_list:
+                    lid = prog.list_id.get(p.name)
+                    if lid is None or p.name not in context:
+                        continue
+                    try:
+                        ranges = encode_list(context[p.name], p.type.elem,
+                                             scratch, strict=False)
+                    except CaveatError:
+                        continue
+                    if len(ranges) > m.K:
+                        # oversized request list: the parameter stays
+                        # UNKNOWN (fails closed) — counted so operators
+                        # can tell capacity overflow from genuinely
+                        # absent context and raise the tuple-side lists
+                        # (K sizes from them) or trim the request's
+                        from ..utils.metrics import metrics
+
+                        metrics.counter(
+                            "engine_caveat_request_list_overflow_total"
+                        ).inc()
+                        continue
+                    for j, (lo, hi) in enumerate(ranges):
+                        rloe[lid, j], rlov[lid, j] = split_planes(lo)
+                        rhie[lid, j], rhiv[lid, j] = split_planes(hi)
+                        if p.type.elem == "timestamp":
+                            req_ts.extend((lo, hi))
+                    rlk[lid] = True
+                    continue
+                col = prog.scalar_col.get(p.name)
+                if col is None:
+                    continue
+                if p.name in context:
+                    try:
+                        x = encode_scalar(context[p.name], p.type.name,
+                                          scratch, strict=False)
+                    except CaveatError:
+                        continue
+                elif p.name == NOW_PARAM and p.type.name == "timestamp":
+                    x = float(now)
+                else:
+                    continue
+                rce[col], rcv[col] = split_planes(x)
+                rck[col] = True
+                if p.type.name == "timestamp" and p.name != NOW_PARAM:
+                    req_ts.append(x)
+            out.append({"ce": rce, "cv": rcv, "ck": rck,
+                        "loe": rloe, "lov": rlov, "hie": rhie,
+                        "hiv": rhiv, "lk": rlk})
+        return tuple(out), req_ts
+
+    def next_time_bound(self, now: float, extra_ts=()) -> float:
+        """Earliest verdict-flip instant strictly after ``now`` — the
+        caveat analog of the store's expiration watermark, joined into
+        decision-cache deadlines. ``now`` itself when flips are not
+        enumerable (timestamp arithmetic): entries are born dead, i.e.
+        contexted queries effectively uncached."""
+        if not self.metas or not self.any_now:
+            return float("inf")
+        if not self.time_exact:
+            return now
+        bounds = self.time_bounds
+        if extra_ts:
+            bounds = np.union1d(bounds, np.asarray(list(extra_ts),
+                                                   dtype=np.float64))
+        i = int(np.searchsorted(bounds, now, side="right"))
+        return float(bounds[i]) if i < len(bounds) else float("inf")
+
+    # -- device upload -------------------------------------------------------
+
+    def device_static(self) -> tuple:
+        """Per-caveat device arrays (called under the graph host guard;
+        the result lives in CompiledGraph._device)."""
+        out = []
+        for h in self.hosts:
+            ime, imv = split_planes(h.program.imm)
+            out.append({
+                "ops": jnp.asarray(h.program.ops),
+                "ime": jnp.asarray(ime), "imv": jnp.asarray(imv),
+                "ce": jnp.asarray(h.ctx_e), "cv": jnp.asarray(h.ctx_v),
+                "ck": jnp.asarray(h.ctx_k),
+                "loe": jnp.asarray(h.lo_e), "lov": jnp.asarray(h.lo_v),
+                "hie": jnp.asarray(h.hi_e), "hiv": jnp.asarray(h.hi_v),
+                "lk": jnp.asarray(h.list_k),
+                "real": jnp.asarray(h.real),
+            })
+        return tuple(out)
+
+    # -- incremental instance appends ---------------------------------------
+
+    def lookup_row(self, name: str, ctx_json: str) -> Optional[int]:
+        return self.key_row.get((name, ctx_json))
+
+    def plan_append(self, name: str, ctx_json: str,
+                    planned: dict) -> Optional[int]:
+        """Reserve (in ``planned``, not yet applied) a free instance row
+        for a new (caveat, context) pair; None when the caveat has no
+        compiled tape, its row bucket is full, or the context cannot be
+        encoded against the frozen layout — the caller falls back to a
+        full recompile."""
+        got = planned.get((name, ctx_json))
+        if got is not None:
+            return got[0]
+        for ci, (m, h) in enumerate(zip(self.metas, self.hosts)):
+            if m.name != name:
+                continue
+            used = int(h.real.sum()) + sum(
+                1 for (n2, _), (_, ci2, _) in planned.items()
+                if n2 == name and ci2 == ci)
+            if used >= m.n_pad:
+                return None
+            try:
+                cols = _encode_instance_cols(m, h.program, self.interner,
+                                             ctx_json)
+            except (CaveatError, ValueError):
+                return None
+            row = m.row_off + used
+            planned[(name, ctx_json)] = (row, ci, (used, cols))
+            return row
+        return None  # caveat had no instances at compile: no tape
+
+    def apply_appends(self, planned: dict) -> list:
+        """Write planned instance rows into the shared host arrays
+        (caller holds the graph host lock) and return
+        ``[(c_idx, local_row, cols), ...]`` for the device-side
+        functional updates."""
+        out = []
+        new_ts: list[float] = []
+        for (name, ctx_json), (row, ci, (local, cols)) in planned.items():
+            h = self.hosts[ci]
+            sce, scv, sck, lle, llv, lhe, lhv, lk = cols
+            h.ctx_e[:, local] = sce
+            h.ctx_v[:, local] = scv
+            h.ctx_k[:, local] = sck
+            h.lo_e[:, :, local] = lle
+            h.lo_v[:, :, local] = llv
+            h.hi_e[:, :, local] = lhe
+            h.hi_v[:, :, local] = lhv
+            h.list_k[:, local] = lk
+            h.real[local] = True
+            self.key_row[(name, ctx_json)] = row
+            self.n_inst += 1
+            out.append((ci, local, cols))
+            # verdict-flip watermark: a `now`-reading caveat's NEW
+            # instance brings new flip instants — without extending the
+            # bounds, a cached ALLOW filled before this append could
+            # outlive the new tuple's window (stale grant past
+            # revocation, exactly what the watermark exists to prevent)
+            if h.program.uses_now:
+                new_ts.extend(_ctx_timestamps(h.program, ctx_json))
+        if new_ts:
+            # replace, never mutate: readers (next_time_bound on cache
+            # fills, off the engine lock) see either array atomically
+            self.time_bounds = np.union1d(
+                self.time_bounds,
+                np.asarray([t for t in new_ts if np.isfinite(t)],
+                           dtype=np.float64))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Table construction (compile_graph time)
+# ---------------------------------------------------------------------------
+
+
+def build_caveat_table(caveat_defs: dict, inst_table: list,
+                       used_ids) -> CompiledCaveats:
+    """Compile every caveat with live instances and lay out the instance
+    tables. ``inst_table`` is the store's append-only
+    ``(name, ctx_json)`` list (index 0 reserved); ``used_ids`` the
+    distinct nonzero instance ids among live tuples."""
+    interner = StringInterner()
+    by_name: dict[str, list[int]] = {}
+    for iid in sorted(int(x) for x in used_ids):
+        name = inst_table[iid][0]
+        by_name.setdefault(name, []).append(iid)
+
+    metas: list[CavMeta] = []
+    hosts: list[_CavHost] = []
+    inst_row = np.zeros(max(len(inst_table), 1), dtype=np.int64)
+    key_row: dict = {}
+    ts_bounds: list[float] = []
+    time_exact = True
+    any_now = False
+    row_off = 1  # row 0 = uncaveated / always valid
+    for name in sorted(by_name):
+        defn = caveat_defs.get(name)
+        if defn is None:
+            raise CaveatError(
+                f"tuples reference undeclared caveat {name!r}")
+        prog = compile_caveat(defn, interner)
+        ids = by_name[name]
+        n_real = len(ids)
+        n_pad = _bucket(n_real, 8)
+        # list capacity: the longest tuple-context or constant list,
+        # with bucket headroom so appended instances rarely force a
+        # recompile. Floor 16: request-supplied lists (e.g. the
+        # middleware's `groups`) have no tuple-side sizing signal, and
+        # a floor of 4 would silently drop any 5-group caller to
+        # missing context
+        k_need = 1
+        for spec in prog.lists:
+            if spec.ranges is not None:
+                k_need = max(k_need, len(spec.ranges))
+        for iid in ids:
+            try:
+                ctx = json.loads(inst_table[iid][1] or "{}")
+            except ValueError:
+                ctx = {}
+            if isinstance(ctx, dict):
+                for p in prog.params:
+                    if p.type.is_list \
+                            and isinstance(ctx.get(p.name), list):
+                        k_need = max(k_need, len(ctx[p.name]))
+        meta = CavMeta(
+            name=name, T=len(prog.ops), n_regs=prog.n_regs,
+            out_reg=prog.out_reg, P=max(prog.n_scalars, 1),
+            L=max(len(prog.lists), 1), K=_bucket(k_need, 16),
+            n_pad=n_pad, n_real=n_real, row_off=row_off)
+        host = _CavHost(
+            program=prog,
+            ctx_e=np.zeros((meta.P, n_pad), dtype=np.float32),
+            ctx_v=np.zeros((meta.P, n_pad), dtype=np.float32),
+            ctx_k=np.zeros((meta.P, n_pad), dtype=bool),
+            lo_e=np.zeros((meta.L, meta.K, n_pad), dtype=np.float32),
+            lo_v=np.zeros((meta.L, meta.K, n_pad), dtype=np.float32),
+            hi_e=np.full((meta.L, meta.K, n_pad), -1.0, dtype=np.float32),
+            hi_v=np.zeros((meta.L, meta.K, n_pad), dtype=np.float32),
+            list_k=np.zeros((meta.L, n_pad), dtype=bool),
+            real=np.zeros(n_pad, dtype=bool),
+        )
+        for local, iid in enumerate(ids):
+            name_i, ctx_json = inst_table[iid]
+            cols = _encode_instance_cols(meta, prog, interner, ctx_json)
+            sce, scv, sck, lle, llv, lhe, lhv, lk = cols
+            host.ctx_e[:, local] = sce
+            host.ctx_v[:, local] = scv
+            host.ctx_k[:, local] = sck
+            host.lo_e[:, :, local] = lle
+            host.lo_v[:, :, local] = llv
+            host.hi_e[:, :, local] = lhe
+            host.hi_v[:, :, local] = lhv
+            host.list_k[:, local] = lk
+            host.real[local] = True
+            inst_row[iid] = row_off + local
+            key_row[(name_i, ctx_json)] = row_off + local
+        metas.append(meta)
+        hosts.append(host)
+        if prog.time_arith:
+            time_exact = False
+        if prog.uses_now:
+            any_now = True
+            # verdict-flip instants: every timestamp the stored contexts
+            # (and constant tape immediates) can compare now against
+            ts_bounds.extend(float(x) for x in prog.imm[
+                prog.ops[:, 0] == OP_CONST].tolist())
+            for iid in ids:
+                ts_bounds.extend(
+                    _ctx_timestamps(prog, inst_table[iid][1]))
+        row_off += n_pad
+
+    bounds = np.unique(np.asarray(
+        [t for t in ts_bounds if np.isfinite(t)], dtype=np.float64)) \
+        if ts_bounds else np.empty(0, dtype=np.float64)
+    return CompiledCaveats(
+        metas=tuple(metas), hosts=hosts, interner=interner,
+        n_rows=row_off, inst_row=inst_row, key_row=key_row,
+        n_inst=sum(m.n_real for m in metas),
+        time_bounds=bounds, time_exact=time_exact, any_now=any_now)
+
+
+# ---------------------------------------------------------------------------
+# Traced evaluation (called from inside the reachability jit)
+# ---------------------------------------------------------------------------
+
+
+def _truthy(e, v):
+    return (e != 0) | (v != 0)
+
+
+def _vm_eval(meta: CavMeta, stat: dict, req: dict):
+    """Evaluate one caveat's tape over its padded instance rows.
+    Returns (allow uint8 [n_pad], missing bool [n_pad]) — allow is the
+    known-true tri-state arm; missing is UNKNOWN."""
+    N = meta.n_pad
+    # merge: tuple context overrides request context (SpiceDB precedence)
+    rce = jnp.broadcast_to(req["ce"][:, None], (meta.P, N))
+    rcv = jnp.broadcast_to(req["cv"][:, None], (meta.P, N))
+    rck = jnp.broadcast_to(req["ck"][:, None], (meta.P, N))
+    ce = jnp.where(stat["ck"], stat["ce"], rce)
+    cv = jnp.where(stat["ck"], stat["cv"], rcv)
+    ck = stat["ck"] | rck
+    tlk = stat["lk"]
+    pick = tlk[:, None, :]  # [L, 1, N]
+    shape = (meta.L, meta.K, N)
+    loe = jnp.where(pick, stat["loe"],
+                    jnp.broadcast_to(req["loe"][:, :, None], shape))
+    lov = jnp.where(pick, stat["lov"],
+                    jnp.broadcast_to(req["lov"][:, :, None], shape))
+    hie = jnp.where(pick, stat["hie"],
+                    jnp.broadcast_to(req["hie"][:, :, None], shape))
+    hiv = jnp.where(pick, stat["hiv"],
+                    jnp.broadcast_to(req["hiv"][:, :, None], shape))
+    lk = tlk | jnp.broadcast_to(req["lk"][:, None], (meta.L, N))
+
+    R = max(meta.n_regs, 1)
+    regs_e = jnp.zeros((R, N), dtype=jnp.float32)
+    regs_v = jnp.zeros((R, N), dtype=jnp.float32)
+    regs_k = jnp.zeros((R, N), dtype=jnp.bool_)
+    ones = jnp.ones(N, dtype=jnp.bool_)
+
+    def step(carry, ins):
+        re_, rv, rk = carry
+        row, ime, imv = ins
+        op, dst, a, b = row[0], row[1], row[2], row[3]
+        ae = jnp.take(re_, a, axis=0)
+        av = jnp.take(rv, a, axis=0)
+        ak = jnp.take(rk, a, axis=0)
+        be = jnp.take(re_, b, axis=0)
+        bv = jnp.take(rv, b, axis=0)
+        bk = jnp.take(rk, b, axis=0)
+        at = ak & _truthy(ae, av)
+        af = ak & ~_truthy(ae, av)
+        bt = bk & _truthy(be, bv)
+        bf = bk & ~_truthy(be, bv)
+        kab = ak & bk
+
+        def as_bool(val, known):
+            return (jnp.zeros(N, jnp.float32),
+                    val.astype(jnp.float32), known)
+
+        def c_const():
+            return (jnp.full(N, ime, jnp.float32),
+                    jnp.full(N, imv, jnp.float32), ones)
+
+        def c_load():
+            return (jnp.take(ce, a, axis=0), jnp.take(cv, a, axis=0),
+                    jnp.take(ck, a, axis=0))
+
+        def c_and():
+            return as_bool(at & bt, (af | bf) | (at & bt))
+
+        def c_or():
+            return as_bool(at | bt, (at | bt) | (af & bf))
+
+        def c_not():
+            return as_bool(af, ak)
+
+        def c_eq():
+            return as_bool((ae == be) & (av == bv), kab)
+
+        def c_ne():
+            return as_bool((ae != be) | (av != bv), kab)
+
+        def c_lt():
+            return as_bool((ae < be) | ((ae == be) & (av < bv)), kab)
+
+        def c_le():
+            return as_bool((ae < be) | ((ae == be) & (av <= bv)), kab)
+
+        def c_gt():
+            return as_bool((ae > be) | ((ae == be) & (av > bv)), kab)
+
+        def c_ge():
+            return as_bool((ae > be) | ((ae == be) & (av >= bv)), kab)
+
+        def _renorm(e, v):
+            carry_ = jnp.floor(v / SPLIT)
+            return e + carry_, v - carry_ * SPLIT
+
+        def c_add():
+            e, v = _renorm(ae + be, av + bv)
+            return e, v, kab
+
+        def c_sub():
+            e, v = _renorm(ae - be, av - bv)
+            return e, v, kab
+
+        def _combine(e, v):
+            return e * jnp.float32(SPLIT) + v
+
+        def c_mul():
+            r = _combine(ae, av) * _combine(be, bv)
+            e = jnp.floor(r / SPLIT)
+            return e, r - e * SPLIT, kab
+
+        def c_div():
+            denom = _combine(be, bv)
+            safe = jnp.where(denom == 0, jnp.float32(1), denom)
+            r = _combine(ae, av) / safe
+            e = jnp.floor(r / SPLIT)
+            # division by zero: no verdict (missing context, fail closed)
+            return e, r - e * SPLIT, kab & (denom != 0)
+
+        def c_in():
+            le = jnp.take(loe, b, axis=0)  # [K, N]
+            lv = jnp.take(lov, b, axis=0)
+            he = jnp.take(hie, b, axis=0)
+            hv = jnp.take(hiv, b, axis=0)
+            ge = (ae > le) | ((ae == le) & (av >= lv))
+            lte = (ae < he) | ((ae == he) & (av <= hv))
+            hit = jnp.any(ge & lte, axis=0)
+            return as_bool(hit, ak & jnp.take(lk, b, axis=0))
+
+        branches = [None] * N_OPCODES
+        branches[OP_CONST] = c_const
+        branches[OP_LOAD] = c_load
+        branches[OP_AND] = c_and
+        branches[OP_OR] = c_or
+        branches[OP_NOT] = c_not
+        branches[OP_EQ] = c_eq
+        branches[OP_NE] = c_ne
+        branches[OP_LT] = c_lt
+        branches[OP_LE] = c_le
+        branches[OP_GT] = c_gt
+        branches[OP_GE] = c_ge
+        branches[OP_ADD] = c_add
+        branches[OP_SUB] = c_sub
+        branches[OP_MUL] = c_mul
+        branches[OP_DIV] = c_div
+        branches[OP_IN] = c_in
+        ve, vv, vk = jax.lax.switch(op, branches)
+        re_ = jax.lax.dynamic_update_index_in_dim(re_, ve, dst, axis=0)
+        rv = jax.lax.dynamic_update_index_in_dim(rv, vv, dst, axis=0)
+        rk = jax.lax.dynamic_update_index_in_dim(rk, vk, dst, axis=0)
+        return (re_, rv, rk), None
+
+    (regs_e, regs_v, regs_k), _ = jax.lax.scan(
+        step, (regs_e, regs_v, regs_k),
+        (stat["ops"], stat["ime"], stat["imv"]))
+    oe = regs_e[meta.out_reg]
+    ov = regs_v[meta.out_reg]
+    ok = regs_k[meta.out_reg]
+    allow = (ok & _truthy(oe, ov)).astype(jnp.uint8)
+    missing = ~ok
+    return allow, missing
+
+
+def eval_caveats(metas: tuple, statics: tuple, reqs: tuple,
+                 n_rows: int):
+    """All caveats' tri-states for one dispatch.
+
+    Returns ``(cav_ok uint8 [n_rows], missing_total int32)``: row 0 is
+    the always-valid uncaveated row; missing-context instances read 0
+    (fail closed) and count toward the total only on live rows."""
+    parts = [jnp.ones(1, dtype=jnp.uint8)]
+    missing_total = jnp.int32(0)
+    for meta, stat, req in zip(metas, statics, reqs):
+        allow, missing = _vm_eval(meta, stat, req)
+        parts.append(allow)
+        missing_total = missing_total + jnp.sum(
+            (missing & stat["real"]).astype(jnp.int32))
+    return jnp.concatenate(parts), missing_total

@@ -203,6 +203,14 @@ class LookupBatcher:
                 observed.set()
                 metrics.histogram("engine_lookup_seconds").observe(
                     time.perf_counter() - t0)
+                # fused dispatches deny missing-context conditional
+                # grants fail-closed like every other path — they must
+                # tick the same counter (once per dispatch, not per row)
+                missing = getattr(qfut, "caveats_missing", lambda: 0)()
+                if missing:
+                    metrics.counter(
+                        "engine_caveat_denied_missing_context_total"
+                    ).inc(missing)
             return mask_pseudo_objects(np.array(out[pos:pos + n])), interner
 
         pos = 0

@@ -293,14 +293,22 @@ class DecisionCache:
                 del self._flights[key]
 
 
-def check_key(revision: int, item) -> tuple:
-    return ("check", revision, item.resource_type, item.resource_id,
+def check_key(revision: int, item,
+              ctx_digest: Optional[str] = None) -> tuple:
+    """``ctx_digest`` (engine.context_digest) joins the key for
+    caveat-contexted queries so a conditional verdict can never leak
+    across request contexts; context-free queries keep the historical
+    key shape unchanged."""
+    base = ("check", revision, item.resource_type, item.resource_id,
             item.permission, item.subject_type, item.subject_id,
             item.subject_relation)
+    return base if ctx_digest is None else base + (ctx_digest,)
 
 
 def lookup_key(revision: int, resource_type: str, permission: str,
                subject_type: str, subject_id: str,
-               subject_relation: Optional[str]) -> tuple:
-    return ("lookup", revision, resource_type, permission, subject_type,
+               subject_relation: Optional[str],
+               ctx_digest: Optional[str] = None) -> tuple:
+    base = ("lookup", revision, resource_type, permission, subject_type,
             subject_id, subject_relation)
+    return base if ctx_digest is None else base + (ctx_digest,)

@@ -65,6 +65,24 @@ class WatchEvent:
     relationship: Relationship
 
 
+def context_digest(context) -> Optional[str]:
+    """Stable digest of a request caveat-context dict, appended to
+    decision-cache keys so conditional verdicts never leak across
+    contexts. ``None`` for no/empty context — context-free queries keep
+    today's cache keys byte-identical."""
+    if not context:
+        return None
+    import hashlib
+    import json
+
+    try:
+        blob = json.dumps(context, sort_keys=True,
+                          separators=(",", ":"), default=str)
+    except (TypeError, ValueError):
+        blob = repr(sorted((str(k), str(v)) for k, v in context.items()))
+    return hashlib.sha1(blob.encode()).hexdigest()
+
+
 def mask_to_ids(mask, interner) -> list:
     """Materialize allowed id strings from a lookup mask: the ONE place
     the padded-index guard lives (padding indices can never be true — no
@@ -262,17 +280,47 @@ class Engine:
 
     # -- write path ---------------------------------------------------------
 
+    def _validate_caveat(self, rel: Relationship) -> None:
+        """A caveated write must name a DECLARED caveat and carry a
+        context that encodes under the declared parameter types — a
+        malformed context stored now would become missing-context
+        denials (or a recompile-time error) at read time."""
+        from ..caveats.ast import (
+            CaveatError,
+            StringInterner,
+            encode_list,
+            encode_scalar,
+        )
+
+        cdef = (self.schema.caveat_defs or {}).get(rel.caveat)
+        if cdef is None:
+            raise SchemaViolation(
+                f"relationship names undeclared caveat {rel.caveat!r}")
+        if not rel.caveat_context:
+            return
+        try:
+            ctx = rel.context_dict()
+        except ValueError as e:
+            raise SchemaViolation(
+                f"caveat {rel.caveat!r}: invalid context: {e}") from None
+        scratch = StringInterner()
+        for k, v in (ctx or {}).items():
+            p = cdef.param(k)
+            if p is None:
+                raise SchemaViolation(
+                    f"caveat {rel.caveat!r} has no parameter {k!r}")
+            try:
+                if p.type.is_list:
+                    encode_list(v, p.type.elem, scratch)
+                else:
+                    encode_scalar(v, p.type.name, scratch)
+            except CaveatError as e:
+                raise SchemaViolation(
+                    f"caveat {rel.caveat!r} context {k!r}: {e}") from None
+
     def _validate(self, rel: Relationship) -> None:
         if getattr(rel, "caveat", None):
-            # caveats parse (models/tuples.py) but are NOT enforced;
-            # storing a conditional grant as unconditional would fail
-            # OPEN on every check/lookup that touches it — refuse instead
-            # (lookups then trivially skip conditional results, the
-            # reference's pkg/authz/lookups.go:83-90 direction)
-            raise SchemaViolation(
-                f"relationship carries caveat {rel.caveat!r}, which this "
-                "engine does not enforce; refusing to store a "
-                "conditional grant as unconditional")
+            self._validate_caveat(rel)
         d = self.schema.definitions.get(rel.resource_type)
         if d is None:
             raise SchemaViolation(f"unknown resource type {rel.resource_type!r}")
@@ -291,6 +339,7 @@ class Engine:
             raise SchemaViolation(f"unknown subject type {rel.subject_type!r}")
         ok = False
         expiration_blocked = False
+        caveat_blocked = False
         for a in r.allowed:
             if a.type != rel.subject_type:
                 continue
@@ -298,6 +347,14 @@ class Engine:
                 if not a.wildcard:
                     continue
             elif a.wildcard or (a.relation or None) != rel.subject_relation:
+                continue
+            if (a.caveat or None) != (rel.caveat or None):
+                # SpiceDB matches the caveat trait exactly: a caveated
+                # tuple needs a `with <caveat>` entry, and an entry
+                # REQUIRING a caveat never accepts an unconditional
+                # tuple — another entry of the same subject type may
+                # still match (`user | user with ip_allowlist`)
+                caveat_blocked = True
                 continue
             if rel.expiration is not None and not a.expiration:
                 # another allowed entry of the same subject type may carry
@@ -311,6 +368,12 @@ class Engine:
             raise SchemaViolation(
                 f"{rel.resource_type}#{rel.relation} does not allow "
                 "expiring relationships"
+            )
+        if not ok and caveat_blocked:
+            raise SchemaViolation(
+                f"{rel.resource_type}#{rel.relation} does not allow "
+                + (f"subjects with caveat {rel.caveat!r}" if rel.caveat
+                   else "uncaveated subjects of this type")
             )
         if not ok:
             raise SchemaViolation(
@@ -400,6 +463,28 @@ class Engine:
         return self.store.read(f)
 
     def bulk_load(self, rels_cols: dict) -> int:
+        if self.validate_writes and rels_cols.get("caveat") is not None:
+            # validate the DISTINCT (caveat, context) pairs before any
+            # store mutation: an undeclared name or a type-mismatched
+            # context interned here would not fail until the next
+            # compile_graph — bricking every subsequent query instead
+            # of rejecting one bad load (the write path rejects the
+            # same row cleanly via _validate_caveat)
+            from ..models.tuples import canonical_context
+
+            names = np.asarray(rels_cols["caveat"], dtype=str)
+            ctx_col = rels_cols.get("caveat_context")
+            ctxs = (np.asarray(ctx_col, dtype=str)
+                    if ctx_col is not None
+                    else np.full(len(names), "", dtype=str))
+            seen: set = set()
+            for nm, cx in zip(names.tolist(), ctxs.tolist()):
+                if not nm or (nm, cx) in seen:
+                    continue
+                seen.add((nm, cx))
+                self._validate_caveat(Relationship(
+                    "", "", "", "", "", None, None, nm,
+                    canonical_context(cx)))
         return self.store.bulk_load(rels_cols)
 
     # -- query path ---------------------------------------------------------
@@ -517,8 +602,22 @@ class Engine:
                 time.perf_counter() - t0)
         return new
 
-    def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
-        return self.check_bulk([item], now=now)[0]
+    def check(self, item: CheckItem, now: Optional[float] = None,
+              context: Optional[dict] = None) -> bool:
+        return self.check_bulk([item], now=now, context=context)[0]
+
+    def _cache_deadline(self, cg: CompiledGraph, now0: float,
+                        context: Optional[dict]) -> float:
+        """Validity horizon for a decision-cache entry filled at
+        ``now0``: the store's next expiration boundary joined with the
+        caveat table's next verdict-flip instant (time-window caveats
+        revoke/grant without a write, exactly like tuple expiry)."""
+        deadline = self.store.next_expiry(now0)
+        cav = cg.caveats
+        if cav is not None and cav.metas:
+            deadline = min(deadline, cav.next_time_bound(
+                now0, cav.request_ts(context)))
+        return deadline
 
     def watch_gate(self, resource_type: str, name: str
                    ) -> tuple[frozenset, bool]:
@@ -534,13 +633,19 @@ class Engine:
         return watch_relevance(self.schema, resource_type, name)
 
     def check_bulk(self, items: list[CheckItem],
-                   now: Optional[float] = None) -> list[bool]:
+                   now: Optional[float] = None,
+                   context: Optional[dict] = None) -> list[bool]:
         """CheckBulkPermissions: evaluate all items in one device pass,
         batching distinct subjects along B (reference check.go:22-48 issues
-        one bulk RPC per request; here the whole bulk is one fixpoint)."""
-        return self.check_bulk_async(items, now=now).result()
+        one bulk RPC per request; here the whole bulk is one fixpoint).
+        ``context`` is the request's caveat context (client IP, caller
+        attributes...) gating conditional grants; the dispatch clock is
+        auto-injected as the ``now`` caveat parameter."""
+        return self.check_bulk_async(items, now=now,
+                                     context=context).result()
 
-    def try_cached_check(self, items: list[CheckItem]
+    def try_cached_check(self, items: list[CheckItem],
+                         context: Optional[dict] = None
                          ) -> Optional[list[bool]]:
         """Non-blocking decision-cache probe: the full verdict list when
         EVERY item is a hit at the current revision, else ``None``
@@ -555,10 +660,23 @@ class Engine:
         if not items:
             return []
         rev = self.store.revision
+        # digest-free keys for caveat-less graphs, parameter-scoped
+        # digests otherwise (see check_bulk_async) — but ONLY when the
+        # current compiled graph provably matches this revision; when
+        # unsure, digesting the full context is merely a cache miss,
+        # never a wrong answer
+        cg = self._compiled
+        if cg is not None and cg.revision == rev:
+            digest = (context_digest(
+                cg.caveats.relevant_context(context))
+                if cg.caveats is not None and cg.caveats.metas
+                else None)
+        else:
+            digest = context_digest(context)
         now = time.time()
         out: list[bool] = []
         for it in items:
-            v = cache.get(check_key(rev, it), now, record=False)
+            v = cache.get(check_key(rev, it, digest), now, record=False)
             if v is MISS:
                 return None
             out.append(v)
@@ -573,6 +691,15 @@ class Engine:
         compiled graph changes revision. Both expose the same
         ``query_async(seeds, q_slots, q_batch, now)`` surface."""
         if self.mesh is None:
+            return cg
+        cav = cg.caveats
+        if cav is not None and cav.metas:
+            # the sharded fixpoint does not evaluate caveats yet: its
+            # level arrays would serve conditional edges UNCONDITIONALLY
+            # (fail open). Route caveated graphs through the single-
+            # device path instead — counted, so a mesh deployment that
+            # starts loading conditional grants sees why its mesh idles.
+            metrics.counter("engine_caveat_mesh_fallback_total").inc()
             return cg
         with self._lock:
             sg = self._sharded
@@ -654,7 +781,9 @@ class Engine:
         return np.asarray(seed_rows, dtype=np.int32), q_slots, q_batch
 
     def check_bulk_async(self, items: list[CheckItem],
-                         now: Optional[float] = None) -> "EngineFuture":
+                         now: Optional[float] = None,
+                         context: Optional[dict] = None
+                         ) -> "EngineFuture":
         """Dispatch a bulk check without blocking (device→host readback
         overlaps with other in-flight queries); ``.result()`` to wait.
 
@@ -663,11 +792,13 @@ class Engine:
         bypasses the cache), per-item verdicts are served from the cache
         and only the miss residue dispatches; the answer list reassembles
         in the caller's order. Verdicts — positive and negative — are
-        cached keyed by the snapshot revision with the store's
-        next-expiry watermark as deadline."""
+        cached keyed by the snapshot revision (plus the request-context
+        digest when a caveat context rides the call) with the store's
+        next-expiry watermark ∧ the caveat table's next verdict flip as
+        deadline."""
         cache = self._decision_cache
         if cache is None or now is not None or not items:
-            return self._check_bulk_dispatch(items, now)
+            return self._check_bulk_dispatch(items, now, context=context)
         # pin ONE compiled snapshot for the whole bulk — hits are keyed
         # at its revision and the miss residue dispatches against the
         # same graph, so the answer list reflects a single revision even
@@ -675,7 +806,16 @@ class Engine:
         # guarantee)
         cg = self.compiled()
         now0 = time.time()
-        keys = [check_key(cg.revision, it) for it in items]
+        # the digest partitions cache keys ONLY when the graph actually
+        # carries caveat instances, and ONLY over the context keys the
+        # compiled caveats declare — an uncaveated graph's verdicts
+        # cannot depend on request context at all, and digesting
+        # undeclared fields (the middleware's per-request name/verb/...)
+        # would fragment the repeat-traffic working set for nothing
+        digest = (context_digest(cg.caveats.relevant_context(context))
+                  if cg.caveats is not None and cg.caveats.metas
+                  else None)
+        keys = [check_key(cg.revision, it, digest) for it in items]
         out: list = [None] * len(items)
         miss_idx: list[int] = []
         for i, k in enumerate(keys):
@@ -687,11 +827,11 @@ class Engine:
         if not miss_idx:
             return EngineFuture(None, lambda _: list(out))
         inner = self._check_bulk_dispatch(
-            [items[i] for i in miss_idx], now0, cg=cg)
+            [items[i] for i in miss_idx], now0, cg=cg, context=context)
 
         def fin(_):
             got = inner.result()
-            deadline = self.store.next_expiry(now0)
+            deadline = self._cache_deadline(cg, now0, context)
             for j, i in enumerate(miss_idx):
                 v = bool(got[j])
                 cache.put(keys[i], v, deadline, 0, now0)
@@ -702,7 +842,8 @@ class Engine:
 
     def _check_bulk_dispatch(self, items: list[CheckItem],
                              now: Optional[float] = None,
-                             cg: Optional[CompiledGraph] = None
+                             cg: Optional[CompiledGraph] = None,
+                             context: Optional[dict] = None
                              ) -> "EngineFuture":
         """The raw (cache-less) bulk check: one chunked device pass.
         ``cg`` pins an already-obtained snapshot (the cached path passes
@@ -721,6 +862,13 @@ class Engine:
             # mask must see the same instant (one CheckBulkPermissions =
             # one consistency snapshot, reference check.go:41-48)
             now = time.time()
+        # request caveat context encodes ONCE for the whole logical call
+        # (chunks share it; a per-chunk encode would also multi-count
+        # the request-list-overflow counter by the chunk count)
+        cav_req = None
+        cavs = cg.caveats
+        if cavs is not None and cavs.metas:
+            cav_req, _ = cavs.encode_request(context, now)
         # chunked pipeline: dispatches are async, so encoding chunk k+1 on
         # the host overlaps chunk k's device execution and readback —
         # wall ≈ one_chunk_encode + transport + device, not encode + both
@@ -728,7 +876,9 @@ class Engine:
         for s in range(0, n, chunk):
             seeds, q_slots, q_batch = self._encode_checks(
                 cg, objs, items[s:s + chunk])
-            futs.append(backend.query_async(seeds, q_slots, q_batch, now=now))
+            futs.append(backend.query_async(seeds, q_slots, q_batch,
+                                            now=now, context=context,
+                                            cav_req=cav_req))
         metrics.counter("engine_checks_total").inc(n)
         metrics.histogram(
             "engine_dispatch_batch_rows",
@@ -750,6 +900,20 @@ class Engine:
                 time.perf_counter() - t0)
             it = iters()
             metrics.histogram("engine_fixpoint_iterations").observe(it)
+            # caveat instances that resolved missing-context this call:
+            # denied fail-closed, and LOUD — this counter replaces the
+            # old silent load-time exclusion of conditional grants.
+            # Semantics: DISTINCT instances lacking context per logical
+            # call (every chunk shares one graph + one context, so the
+            # per-chunk counts are identical — max, not sum), counted
+            # whether or not the queried slots depended on them (the
+            # mask evaluates once for the whole graph per dispatch).
+            missing = max((getattr(f, "caveats_missing", lambda: 0)()
+                           for f in futs), default=0)
+            if missing:
+                metrics.counter(
+                    "engine_caveat_denied_missing_context_total").inc(
+                    missing)
             if dev_span is not None:
                 dev_span.set("fixpoint_iters", it)
                 dev_span.finish()
@@ -760,19 +924,21 @@ class Engine:
     def lookup_resources(self, resource_type: str, permission: str,
                          subject_type: str, subject_id: str,
                          subject_relation: Optional[str] = None,
-                         now: Optional[float] = None) -> list[str]:
+                         now: Optional[float] = None,
+                         context: Optional[dict] = None) -> list[str]:
         """LookupResources: ids of ``resource_type`` on which the subject has
         ``permission`` (reference lookups.go:49-65 streams these; we return
         the whole set from one device pass)."""
         mask, interner = self.lookup_resources_mask(
             resource_type, permission, subject_type, subject_id,
-            subject_relation, now=now)
+            subject_relation, now=now, context=context)
         return mask_to_ids(mask, interner)
 
     def lookup_subjects(self, resource_type: str, resource_id: str,
                         permission: str, subject_type: str,
                         subject_relation: Optional[str] = None,
                         now: Optional[float] = None,
+                        context: Optional[dict] = None,
                         chunk: int = 4096) -> list[str]:
         """LookupSubjects: which subjects of ``subject_type`` hold
         ``permission`` on one resource — the reverse of
@@ -801,7 +967,7 @@ class Engine:
             got = self.check_bulk(
                 [CheckItem(resource_type, resource_id, permission,
                            subject_type, sid, subject_relation)
-                 for sid in part], now=now)
+                 for sid in part], now=now, context=context)
             out.extend(sid for sid, ok in zip(part, got) if ok)
         metrics.counter("engine_lookup_subjects_total").inc()
         return out
@@ -809,20 +975,22 @@ class Engine:
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,
                               subject_relation: Optional[str] = None,
-                              now: Optional[float] = None):
+                              now: Optional[float] = None,
+                              context: Optional[dict] = None):
         """Vectorized variant for the list-filter hot path: returns
         (bool mask over the type's object index space, per-type interner).
         Callers with a list of candidate names map name->index and test the
         mask directly — no per-object RPC or string materialization."""
         return self.lookup_resources_mask_async(
             resource_type, permission, subject_type, subject_id,
-            subject_relation, now=now,
+            subject_relation, now=now, context=context,
         ).result()
 
     def lookup_resources_mask_async(self, resource_type: str, permission: str,
                                     subject_type: str, subject_id: str,
                                     subject_relation: Optional[str] = None,
-                                    now: Optional[float] = None):
+                                    now: Optional[float] = None,
+                                    context: Optional[dict] = None):
         """Non-blocking mask lookup; ``.result()`` -> (mask, interner).
         Concurrent list requests dispatch back-to-back and overlap their
         readbacks — the reference's goroutine-per-prefilter overlap
@@ -840,10 +1008,14 @@ class Engine:
         if cache is None or now is not None:
             return self._lookup_submit(resource_type, permission,
                                        subject_type, subject_id,
-                                       subject_relation, now)
-        rev = self.compiled().revision
-        key = lookup_key(rev, resource_type, permission, subject_type,
-                         subject_id, subject_relation)
+                                       subject_relation, now, context)
+        cg = self.compiled()
+        key = lookup_key(cg.revision, resource_type, permission,
+                         subject_type, subject_id, subject_relation,
+                         context_digest(cg.caveats.relevant_context(
+                             context))
+                         if cg.caveats is not None and cg.caveats.metas
+                         else None)
         now0 = time.time()
         hit = cache.get(key, now0)
         if hit is not MISS:
@@ -861,7 +1033,7 @@ class Engine:
         try:
             inner = self._lookup_submit(resource_type, permission,
                                         subject_type, subject_id,
-                                        subject_relation, None)
+                                        subject_relation, None, context)
         except BaseException as e:  # dispatch died before a future existed
             flight.abort(e)
             cache.release(key, flight)
@@ -874,7 +1046,7 @@ class Engine:
                 cache.release(key, flight)  # errors are never cached
                 raise
             mask, interner = value
-            deadline = self.store.next_expiry(now0)
+            deadline = self._cache_deadline(cg, now0, context)
             flight.deadline = deadline
             cache.put(key, (mask, interner), deadline,
                       0 if mask is None else int(mask.nbytes), now0)
@@ -893,10 +1065,21 @@ class Engine:
     def _lookup_submit(self, resource_type: str, permission: str,
                        subject_type: str, subject_id: str,
                        subject_relation: Optional[str],
-                       now: Optional[float]):
+                       now: Optional[float],
+                       context: Optional[dict] = None):
         """Route one true-miss lookup: fused through the batcher when
         enabled, direct otherwise."""
-        if self._batcher is not None and now is None:
+        cg = self._compiled
+        # a request context only matters when the graph actually holds
+        # caveat instances: a fused batch evaluates ONE caveat mask per
+        # dispatch, so rows with different contexts cannot share it —
+        # but contexted lookups against a provably caveat-less current
+        # graph still fuse (the middleware sends context on EVERY
+        # request; bypassing unconditionally would disable batching)
+        ctx_matters = bool(context) and not (
+            cg is not None and cg.revision == self.store.revision
+            and (cg.caveats is None or not cg.caveats.metas))
+        if self._batcher is not None and now is None and not ctx_matters:
             # explicit-now callers bypass the batcher: a fused batch runs
             # at one dispatch-time clock, which is only equivalent to the
             # unbatched path for now-less queries
@@ -904,12 +1087,14 @@ class Engine:
                 resource_type, permission, subject_type, subject_id,
                 subject_relation)
         return self._lookup_direct(resource_type, permission, subject_type,
-                                   subject_id, subject_relation, now)
+                                   subject_id, subject_relation, now,
+                                   context)
 
     def _lookup_direct(self, resource_type: str, permission: str,
                        subject_type: str, subject_id: str,
                        subject_relation: Optional[str],
-                       now: Optional[float]):
+                       now: Optional[float],
+                       context: Optional[dict] = None):
         cg = self.compiled()
         objs = self._objects_by_name()
         off = cg.offset_of(resource_type, permission)
@@ -950,7 +1135,8 @@ class Engine:
         # on remotely-attached chips)
         fut = self._backend(cg).query_async(
             seeds, q_slots, q_batch, now=now,
-            q_cache_key=("lookup", off, n), q_contiguous=True)
+            q_cache_key=("lookup", off, n), q_contiguous=True,
+            context=context)
         metrics.counter("engine_lookups_total").inc()
         metrics.histogram(
             "engine_dispatch_batch_rows",
@@ -963,6 +1149,11 @@ class Engine:
                 time.perf_counter() - t0)
             it = fut.iterations()
             metrics.histogram("engine_fixpoint_iterations").observe(it)
+            missing = getattr(fut, "caveats_missing", lambda: 0)()
+            if missing:
+                metrics.counter(
+                    "engine_caveat_denied_missing_context_total").inc(
+                    missing)
             # QueryFuture.result() already materialized a fresh host
             # array; only copy again if it came back read-only
             m = np.asarray(out)
@@ -1039,5 +1230,7 @@ class Engine:
 
     # -- debugging ----------------------------------------------------------
 
-    def oracle(self, now: Optional[float] = None) -> OracleEvaluator:
-        return OracleEvaluator(self.schema, self.store.snapshot(), now=now)
+    def oracle(self, now: Optional[float] = None,
+               context: Optional[dict] = None) -> OracleEvaluator:
+        return OracleEvaluator(self.schema, self.store.snapshot(),
+                               now=now, context=context)

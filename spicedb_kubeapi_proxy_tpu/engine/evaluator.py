@@ -34,13 +34,22 @@ class DepthExceeded(Exception):
 
 
 class OracleEvaluator:
-    def __init__(self, schema: Schema, snapshot: Snapshot, now: Optional[float] = None):
+    def __init__(self, schema: Schema, snapshot: Snapshot,
+                 now: Optional[float] = None,
+                 context: Optional[dict] = None):
         self.schema = schema
         self.now = time.time() if now is None else now
-        # (rtype, rid, relation) -> list[(stype, sid, srel|None)]
+        # the request's caveat context; merged UNDER each tuple's stored
+        # context (tuple wins), with the evaluation clock auto-injected
+        # as the `now` parameter — mirroring the VM's semantics
+        self.context = dict(context or {})
+        # (rtype, rid, relation) -> list[(stype, sid, srel|None, cav id)]
         self.adj: dict[tuple, list[tuple]] = {}
         # type -> live object ids
         self.objects: dict[str, set] = {}
+        self._cav_table = getattr(snapshot, "caveat_instances",
+                                  None) or [("", "")]
+        self._cav_memo: dict[int, Optional[bool]] = {0: True}
         c = snapshot.cols
         types, rels, objs = snapshot.types, snapshot.relations, snapshot.objects
         for i in range(len(c)):
@@ -52,8 +61,63 @@ class OracleEvaluator:
             st = types.string(int(c.st[i]))
             sid = objs[int(c.st[i])].string(int(c.sid[i]))
             srl = rels.string(int(c.srl[i])) or None
-            self.adj.setdefault((rt, rid, rl), []).append((st, sid, srl))
+            self.adj.setdefault((rt, rid, rl), []).append(
+                (st, sid, srl, int(c.cav[i])))
             self.objects.setdefault(rt, set()).add(rid)
+
+    def _cav_ok(self, cav: int) -> bool:
+        """Tri-state caveat verdict for an instance id, collapsed to the
+        edge's activation (missing context == False: fail closed).
+        Memoized — instances are few and context is fixed per oracle."""
+        got = self._cav_memo.get(cav)
+        if got is None and cav not in self._cav_memo:
+            got = self._eval_caveat(cav)
+            self._cav_memo[cav] = got
+        return bool(got)
+
+    def _eval_caveat(self, cav: int) -> Optional[bool]:
+        import json
+
+        from ..caveats.ast import StringInterner, interpret
+        from ..caveats.vm import NOW_PARAM
+
+        name, ctx_json = self._cav_table[cav]
+        defn = (getattr(self.schema, "caveat_defs", None) or {}).get(name)
+        if defn is None:
+            return False  # undeclared: never grant
+        params = {p.name: p.type for p in defn.params}
+        merged = dict(self.context)
+        if NOW_PARAM in params and NOW_PARAM not in merged:
+            merged[NOW_PARAM] = self.now
+        if ctx_json:
+            try:
+                merged.update(json.loads(ctx_json))
+            except ValueError:
+                return None  # unreadable stored context: no verdict
+        # one shared interner is enough for the oracle: strings compare
+        # by code, and interning everything visible keeps codes aligned
+        interner = StringInterner()
+        for v in merged.values():
+            if isinstance(v, str):
+                interner.intern(v)
+            elif isinstance(v, list):
+                for x in v:
+                    if isinstance(x, str):
+                        interner.intern(x)
+        from ..caveats.ast import CaveatError, Lit, walk
+
+        for node in walk(defn.expr):
+            if isinstance(node, Lit):
+                if node.type == "string":
+                    interner.intern(node.value)
+                elif node.type == "list":
+                    for x in node.value:
+                        if isinstance(x, str):
+                            interner.intern(x)
+        try:
+            return interpret(defn.expr, merged, params, interner)
+        except CaveatError:
+            return None  # unencodable context: no verdict, fail closed
 
     # -- public ------------------------------------------------------------
 
@@ -122,7 +186,9 @@ class OracleEvaluator:
 
     def _eval_relation(self, rtype, rid, relname, subject, memo, path, depth) -> bool:
         stype_q, sid_q, srel_q = subject
-        for st, sid, srl in self.adj.get((rtype, rid, relname), ()):
+        for st, sid, srl, cav in self.adj.get((rtype, rid, relname), ()):
+            if not self._cav_ok(cav):
+                continue  # conditional grant not satisfied: edge is off
             if srl is None:
                 if st == stype_q and srel_q is None and (
                     sid == sid_q or sid == WILDCARD_ID
@@ -153,9 +219,12 @@ class OracleEvaluator:
                 and not self._eval_expr(expr.subtract, rtype, rid, subject, memo,
                                         path, depth)
         if isinstance(expr, Arrow):
-            for st, sid, srl in self.adj.get((rtype, rid, expr.tupleset), ()):
+            for st, sid, srl, cav in self.adj.get(
+                    (rtype, rid, expr.tupleset), ()):
                 if srl is not None or sid == WILDCARD_ID:
                     continue  # arrows walk concrete subjects only
+                if not self._cav_ok(cav):
+                    continue  # conditional tupleset edge not satisfied
                 sub_def = self.schema.definitions.get(st)
                 if sub_def and sub_def.relation_or_permission(expr.target):
                     if self._eval(st, sid, expr.target, subject, memo, path,

@@ -132,8 +132,8 @@ _ERROR_KINDS = {
 # the wire the server may have applied them, and a replay would
 # double-apply (the no-retry-after-send invariant in _transact).
 _IDEMPOTENT_OPS = frozenset({
-    "check_bulk", "lookup_resources", "lookup_mask", "object_ids",
-    "revision", "exists", "watch_since", "watch_gate",
+    "check_bulk", "lookup_resources", "lookup_mask", "lookup_subjects",
+    "object_ids", "revision", "exists", "watch_since", "watch_gate",
     "read_relationships", "traces",
 })
 
@@ -508,13 +508,20 @@ class EngineServer:
 
     def _op_check_bulk(self, req: dict):
         items = [CheckItem(*it) for it in req["items"]]
-        return self.engine.check_bulk(items, now=req.get("now"))
+        return self.engine.check_bulk(items, now=req.get("now"),
+                                      context=req.get("ctx") or None)
 
     def _op_lookup_resources(self, req: dict):
         return self.engine.lookup_resources(
             req["resource_type"], req["permission"], req["subject_type"],
             req["subject_id"], req.get("subject_relation"),
-            now=req.get("now"))
+            now=req.get("now"), context=req.get("ctx") or None)
+
+    def _op_lookup_subjects(self, req: dict):
+        return self.engine.lookup_subjects(
+            req["resource_type"], req["resource_id"], req["permission"],
+            req["subject_type"], req.get("subject_relation"),
+            now=req.get("now"), context=req.get("ctx") or None)
 
     def _op_lookup_mask(self, req: dict):
         """The hot-path variant: packed bitmask over the type's object
@@ -531,7 +538,8 @@ class EngineServer:
             mask, interner = self.engine.lookup_resources_mask(
                 req["resource_type"], req["permission"],
                 req["subject_type"], req["subject_id"],
-                req.get("subject_relation"), now=req.get("now"))
+                req.get("subject_relation"), now=req.get("now"),
+                context=req.get("ctx") or None)
             if self.engine.store.epoch != epoch:
                 continue
             if mask is None:
@@ -1092,33 +1100,50 @@ class RemoteEngine:
 
     # -- engine surface ------------------------------------------------------
 
-    def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
-        return self.check_bulk([item], now=now)[0]
+    def check(self, item: CheckItem, now: Optional[float] = None,
+              context: Optional[dict] = None) -> bool:
+        return self.check_bulk([item], now=now, context=context)[0]
 
-    def check_bulk(self, items: list, now: Optional[float] = None) -> list:
+    def check_bulk(self, items: list, now: Optional[float] = None,
+                   context: Optional[dict] = None) -> list:
+        # the request caveat context rides the frame as "ctx" (omitted
+        # when empty so context-free frames stay byte-stable for older
+        # hosts); the HOST's decision cache applies the context digest
         return self._call(
-            "check_bulk", now=now,
+            "check_bulk", now=now, ctx=context or None,
             items=[[it.resource_type, it.resource_id, it.permission,
                     it.subject_type, it.subject_id, it.subject_relation]
                    for it in items])
 
+    def lookup_subjects(self, resource_type: str, resource_id: str,
+                        permission: str, subject_type: str,
+                        subject_relation: Optional[str] = None,
+                        now: Optional[float] = None,
+                        context: Optional[dict] = None) -> list:
+        return self._call(
+            "lookup_subjects", resource_type=resource_type,
+            resource_id=resource_id, permission=permission,
+            subject_type=subject_type, subject_relation=subject_relation,
+            now=now, ctx=context or None)
+
     def lookup_resources(self, resource_type: str, permission: str,
                          subject_type: str, subject_id: str,
                          subject_relation: Optional[str] = None,
-                         now: Optional[float] = None) -> list:
+                         now: Optional[float] = None,
+                         context: Optional[dict] = None) -> list:
         """Materialize allowed id strings from the mask wire (one ~16KB
         frame + an amortized id-table delta, not a multi-MB JSON list);
         falls back to the JSON op against hosts predating lookup_mask."""
         try:
             mask, interner = self.lookup_resources_mask(
                 resource_type, permission, subject_type, subject_id,
-                subject_relation, now=now)
+                subject_relation, now=now, context=context)
         except RemoteEngineError:
             return self._call(
                 "lookup_resources", resource_type=resource_type,
                 permission=permission, subject_type=subject_type,
                 subject_id=subject_id, subject_relation=subject_relation,
-                now=now)
+                now=now, ctx=context or None)
         from .engine import mask_to_ids
 
         return mask_to_ids(mask, interner)
@@ -1126,7 +1151,8 @@ class RemoteEngine:
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,
                               subject_relation: Optional[str] = None,
-                              now: Optional[float] = None):
+                              now: Optional[float] = None,
+                              context: Optional[dict] = None):
         """(bool mask over the type's object index space, id view) — the
         same vectorized surface the in-process engine exposes
         (engine.py lookup_resources_mask), over the binary wire."""
@@ -1137,7 +1163,7 @@ class RemoteEngine:
                 "lookup_mask", resource_type=resource_type,
                 permission=permission, subject_type=subject_type,
                 subject_id=subject_id, subject_relation=subject_relation,
-                now=now)
+                now=now, ctx=context or None)
             if not isinstance(r, tuple):
                 return None, None  # {"found": False}
             meta, payload = r
@@ -1466,27 +1492,41 @@ class FailoverEngine:
 
     # -- engine surface (the slice the proxy consumes) -----------------------
 
-    def check(self, item: CheckItem, now: Optional[float] = None) -> bool:
-        return self.check_bulk([item], now=now)[0]
+    def check(self, item: CheckItem, now: Optional[float] = None,
+              context: Optional[dict] = None) -> bool:
+        return self.check_bulk([item], now=now, context=context)[0]
 
-    def check_bulk(self, items: list, now: Optional[float] = None) -> list:
-        return self._invoke(lambda c: c.check_bulk(items, now=now))
+    def check_bulk(self, items: list, now: Optional[float] = None,
+                   context: Optional[dict] = None) -> list:
+        return self._invoke(lambda c: c.check_bulk(items, now=now,
+                                                   context=context))
+
+    def lookup_subjects(self, resource_type: str, resource_id: str,
+                        permission: str, subject_type: str,
+                        subject_relation: Optional[str] = None,
+                        now: Optional[float] = None,
+                        context: Optional[dict] = None) -> list:
+        return self._invoke(lambda c: c.lookup_subjects(
+            resource_type, resource_id, permission, subject_type,
+            subject_relation, now=now, context=context))
 
     def lookup_resources(self, resource_type: str, permission: str,
                          subject_type: str, subject_id: str,
                          subject_relation: Optional[str] = None,
-                         now: Optional[float] = None) -> list:
+                         now: Optional[float] = None,
+                         context: Optional[dict] = None) -> list:
         return self._invoke(lambda c: c.lookup_resources(
             resource_type, permission, subject_type, subject_id,
-            subject_relation, now=now))
+            subject_relation, now=now, context=context))
 
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,
                               subject_relation: Optional[str] = None,
-                              now: Optional[float] = None):
+                              now: Optional[float] = None,
+                              context: Optional[dict] = None):
         return self._invoke(lambda c: c.lookup_resources_mask(
             resource_type, permission, subject_type, subject_id,
-            subject_relation, now=now))
+            subject_relation, now=now, context=context))
 
     def write_relationships(self, ops: list,
                             preconditions: list = ()) -> int:

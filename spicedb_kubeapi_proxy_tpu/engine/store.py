@@ -55,7 +55,9 @@ class AlreadyExists(StoreError):
 
 @dataclass
 class Columns:
-    """Columnar relationship block: parallel int32 arrays + expiration."""
+    """Columnar relationship block: parallel int32 arrays + expiration
+    + caveat-instance id (0 = unconditional; else an index into the
+    store's append-only ``caveat_instances`` table)."""
 
     rt: np.ndarray  # resource type id      (types interner)
     rid: np.ndarray  # resource object id   (per-type objects interner)
@@ -64,6 +66,11 @@ class Columns:
     sid: np.ndarray  # subject object id
     srl: np.ndarray  # subject relation id; 0 == none (ELLIPSIS)
     exp: np.ndarray  # float64 unix seconds; +inf == never expires
+    cav: np.ndarray = None  # int32 caveat-instance id; 0 == none
+
+    def __post_init__(self):
+        if self.cav is None:
+            self.cav = np.zeros(len(self.rt), dtype=np.int32)
 
     def __len__(self) -> int:
         return len(self.rt)
@@ -72,7 +79,7 @@ class Columns:
     def empty() -> "Columns":
         z = np.empty(0, dtype=np.int32)
         return Columns(z, z.copy(), z.copy(), z.copy(), z.copy(), z.copy(),
-                       np.empty(0, dtype=np.float64))
+                       np.empty(0, dtype=np.float64), z.copy())
 
     @staticmethod
     def concat(blocks: list["Columns"]) -> "Columns":
@@ -80,12 +87,13 @@ class Columns:
             return Columns.empty()
         return Columns(*[
             np.concatenate([getattr(b, f) for b in blocks])
-            for f in ("rt", "rid", "rl", "st", "sid", "srl", "exp")
+            for f in ("rt", "rid", "rl", "st", "sid", "srl", "exp", "cav")
         ])
 
     def take(self, idx) -> "Columns":
         return Columns(self.rt[idx], self.rid[idx], self.rl[idx], self.st[idx],
-                       self.sid[idx], self.srl[idx], self.exp[idx])
+                       self.sid[idx], self.srl[idx], self.exp[idx],
+                       self.cav[idx])
 
 
 @dataclass(frozen=True)
@@ -130,6 +138,11 @@ class Snapshot:
     types: Interner
     relations: Interner
     objects: dict[int, Interner]  # type id -> per-type object interner
+    # append-only (name, canonical ctx JSON) caveat-instance table;
+    # index 0 reserved for "no caveat". Shared with the live store
+    # (monotone like the interners), so sharing with an immutable
+    # snapshot is safe.
+    caveat_instances: list = field(default_factory=lambda: [("", "")])
 
 
 # chunks at or above this many rows get the vectorized sorted index; below
@@ -268,6 +281,12 @@ class Store:
         # relation id 0 reserved for "no subject relation"
         self.relations = Interner(reserved=("",))
         self.objects: dict[int, Interner] = {}
+        # caveat-instance table: one row per distinct (caveat name,
+        # canonical context JSON) pair; append-only within an epoch so
+        # snapshots/compiled graphs can share it by reference. Index 0
+        # reserved for "no caveat".
+        self.caveat_instances: list[tuple[str, str]] = [("", "")]
+        self._caveat_key: dict[tuple, int] = {("", ""): 0}
         self._chunks: list[Columns] = []
         self._alive: list[np.ndarray] = []  # bool per chunk
         self._index = StoreIndex()
@@ -321,8 +340,22 @@ class Store:
             self.relations.intern(rel.subject_relation or ""),
         )
 
-    def _extern_rel(self, key: tuple, exp: float) -> Relationship:
+    def _intern_cav(self, rel: Relationship) -> int:
+        """Caveat-instance id for a relationship (0 = unconditional)."""
+        if not rel.caveat:
+            return 0
+        k = (rel.caveat, rel.caveat_context or "")
+        i = self._caveat_key.get(k)
+        if i is None:
+            i = len(self.caveat_instances)
+            self.caveat_instances.append(k)
+            self._caveat_key[k] = i
+        return i
+
+    def _extern_rel(self, key: tuple, exp: float,
+                    cav: int = 0) -> Relationship:
         rt, rid, rl, st, sid, srl = key
+        name, ctx = self.caveat_instances[cav] if cav else ("", "")
         return Relationship(
             self.types.string(rt),
             self.objects[rt].string(rid),
@@ -331,6 +364,8 @@ class Store:
             self.objects[st].string(sid),
             self.relations.string(srl) or None,
             None if not np.isfinite(exp) else float(exp),
+            name or None,
+            ctx or None,
         )
 
     # -- index -------------------------------------------------------------
@@ -445,7 +480,7 @@ class Store:
             # the same tuple within one write are rejected, so the plan is
             # order-free.
             seen: set[tuple] = set()
-            plan: list[tuple[int, tuple, float]] = []
+            plan: list[tuple[int, tuple, float, int]] = []
             for wop in ops:
                 code = _OPS[wop.op]
                 key = self._intern_rel(wop.rel)
@@ -464,19 +499,20 @@ class Store:
                     raise AlreadyExists(f"relationship already exists: {wop.rel}")
                 if code == OP_DELETE:
                     if pos is not None:  # tombstone even expired rows
-                        plan.append((OP_DELETE, key, NO_EXPIRATION))
+                        plan.append((OP_DELETE, key, NO_EXPIRATION, 0))
                     continue
-                plan.append((OP_TOUCH, key, float(exp)))
+                plan.append((OP_TOUCH, key, float(exp),
+                             self._intern_cav(wop.rel)))
 
             if not plan:
                 return self.revision
 
             # Pass 2 — apply.
             rev = self.revision + 1
-            new_rows: list[tuple[tuple, float]] = []
+            new_rows: list[tuple[tuple, float, int]] = []
             journaled = self.journal is not None
             effects: list[dict] = []  # journal record (concrete, replayable)
-            for code, key, exp in plan:
+            for code, key, exp, cav in plan:
                 pos = idx.get(key, self._alive)
                 if pos is not None:
                     self._alive[pos[0]][pos[1]] = False
@@ -487,19 +523,21 @@ class Store:
                     if journaled:
                         effects.append({"op": "delete", "rel": asdict(rel)})
                     continue
-                new_rows.append((key, exp))
-                rel = self._extern_rel(key, exp)
+                new_rows.append((key, exp, cav))
+                rel = self._extern_rel(key, exp, cav)
                 self._watch_log.append(WatchRecord(rev, OP_TOUCH, rel))
                 if journaled:
                     effects.append({"op": "touch", "rel": asdict(rel)})
             if new_rows:
-                keys = np.array([k for k, _ in new_rows], dtype=np.int32)
-                exp_col = np.array([e for _, e in new_rows],
+                keys = np.array([k for k, _, _ in new_rows], dtype=np.int32)
+                exp_col = np.array([e for _, e, _ in new_rows],
                                    dtype=np.float64)
+                cav_col = np.array([c for _, _, c in new_rows],
+                                   dtype=np.int32)
                 cols = Columns(
                     keys[:, 0].copy(), keys[:, 1].copy(), keys[:, 2].copy(),
                     keys[:, 3].copy(), keys[:, 4].copy(), keys[:, 5].copy(),
-                    exp_col,
+                    exp_col, cav_col,
                 )
                 self._append_rows(cols)
                 if not self._has_finite_exp and np.isfinite(exp_col).any():
@@ -561,7 +599,36 @@ class Store:
             exp = (np.asarray(exp_col, dtype=np.float64) if exp_col is not None
                    else np.full(n, NO_EXPIRATION))
             exp = np.where(np.isnan(exp), NO_EXPIRATION, exp)
-            self._append_rows(Columns(rt, rid, rl, st, sid, srl, exp))
+            cav_name_col = rels_cols.get("caveat")
+            if cav_name_col is not None:
+                from ..models.tuples import canonical_context
+
+                names = np.asarray(cav_name_col, dtype=str)
+                ctx_col = rels_cols.get("caveat_context")
+                ctxs = (np.asarray(ctx_col, dtype=str)
+                        if ctx_col is not None
+                        else np.full(n, "", dtype=str))
+                # dedup (name, ctx) pairs vectorized before interning:
+                # a 30%-caveated 10M-row load carries a handful of
+                # distinct contexts, not 3M. ':' cannot appear in a
+                # caveat NAME (identifier charset), so the first ':'
+                # splits unambiguously (NUL would truncate numpy
+                # fixed-width unicode arrays)
+                combo = np.char.add(np.char.add(names, ":"), ctxs)
+                uniq, inv = np.unique(combo, return_inverse=True)
+                codes = np.empty(len(uniq), dtype=np.int32)
+                for i, u in enumerate(uniq.tolist()):
+                    nm, _, cx = u.partition(":")
+                    if not nm:
+                        codes[i] = 0
+                        continue
+                    codes[i] = self._intern_cav(Relationship(
+                        "", "", "", "", "", None, None, nm,
+                        canonical_context(cx)))
+                cav = codes[inv]
+            else:
+                cav = np.zeros(n, dtype=np.int32)
+            self._append_rows(Columns(rt, rid, rl, st, sid, srl, exp, cav))
             if not self._has_finite_exp and np.isfinite(exp).any():
                 self._has_finite_exp = True
             self.revision = (_revision if _revision is not None
@@ -591,7 +658,8 @@ class Store:
                 for ri in np.flatnonzero(mask).tolist():
                     key = (int(cols.rt[ri]), int(cols.rid[ri]), int(cols.rl[ri]),
                            int(cols.st[ri]), int(cols.sid[ri]), int(cols.srl[ri]))
-                    out.append(self._extern_rel(key, cols.exp[ri]))
+                    out.append(self._extern_rel(key, cols.exp[ri],
+                                                int(cols.cav[ri])))
             return out
 
     def exists(self, f: RelationshipFilter, _now: Optional[float] = None) -> bool:
@@ -665,7 +733,7 @@ class Store:
                     f"apply_effects revision {revision} is not past "
                     f"current revision {self.revision}")
             idx = self._ensure_index()
-            final: dict[tuple, Optional[float]] = {}
+            final: dict[tuple, Optional[tuple]] = {}
             journaled: list[dict] = []
             for eff in effects:
                 rel = eff["rel"]
@@ -675,25 +743,28 @@ class Store:
                 if eff["op"] == "delete":
                     final[key] = None
                 else:
-                    final[key] = (float(rel.expiration)
-                                  if rel.expiration is not None
-                                  else float(NO_EXPIRATION))
+                    final[key] = ((float(rel.expiration)
+                                   if rel.expiration is not None
+                                   else float(NO_EXPIRATION)),
+                                  self._intern_cav(rel))
                 journaled.append({"op": eff["op"], "rel": asdict(rel)})
-            new_rows: list[tuple[tuple, float]] = []
-            for key, exp in final.items():
+            new_rows: list[tuple[tuple, float, int]] = []
+            for key, ent in final.items():
                 pos = idx.get(key, self._alive)
                 if pos is not None:
                     self._alive[pos[0]][pos[1]] = False
-                if exp is not None:
-                    new_rows.append((key, exp))
+                if ent is not None:
+                    new_rows.append((key, ent[0], ent[1]))
             if new_rows:
-                keys = np.array([k for k, _ in new_rows], dtype=np.int32)
-                exp_col = np.array([e for _, e in new_rows],
+                keys = np.array([k for k, _, _ in new_rows], dtype=np.int32)
+                exp_col = np.array([e for _, e, _ in new_rows],
                                    dtype=np.float64)
+                cav_col = np.array([c for _, _, c in new_rows],
+                                   dtype=np.int32)
                 self._append_rows(Columns(
                     keys[:, 0].copy(), keys[:, 1].copy(), keys[:, 2].copy(),
                     keys[:, 3].copy(), keys[:, 4].copy(), keys[:, 5].copy(),
-                    exp_col,
+                    exp_col, cav_col,
                 ))
                 if not self._has_finite_exp and np.isfinite(exp_col).any():
                     self._has_finite_exp = True
@@ -813,6 +884,8 @@ class Store:
                 "relations": self.relations.strings(),
                 "objects": {str(tid): it.strings()
                             for tid, it in self.objects.items()},
+                "caveat_instances": [list(p)
+                                     for p in self.caveat_instances],
             }
         return cols, meta
 
@@ -841,6 +914,7 @@ class Store:
                 np.savez_compressed(
                     f, rt=cols.rt, rid=cols.rid, rl=cols.rl, st=cols.st,
                     sid=cols.sid, srl=cols.srl, exp=cols.exp,
+                    cav=cols.cav,
                     meta=np.frombuffer(json.dumps(meta).encode(),
                                        dtype=np.uint8),
                 )
@@ -872,7 +946,7 @@ class Store:
         bio = io.BytesIO()
         np.savez_compressed(
             bio, rt=cols.rt, rid=cols.rid, rl=cols.rl, st=cols.st,
-            sid=cols.sid, srl=cols.srl, exp=cols.exp,
+            sid=cols.sid, srl=cols.srl, exp=cols.exp, cav=cols.cav,
             meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
         )
         return bio.getvalue()
@@ -895,6 +969,9 @@ class Store:
             z["rl"].astype(np.int32), z["st"].astype(np.int32),
             z["sid"].astype(np.int32), z["srl"].astype(np.int32),
             z["exp"].astype(np.float64),
+            # snapshots predating caveat support carry no cav column:
+            # every restored tuple is unconditional
+            (z["cav"].astype(np.int32) if "cav" in z.files else None),
         )
         return meta, cols
 
@@ -931,6 +1008,10 @@ class Store:
                 for s in strings:
                     it.intern(s)
                 self.objects[int(tid)] = it
+            insts = meta.get("caveat_instances") or [["", ""]]
+            self.caveat_instances = [tuple(p) for p in insts]
+            self._caveat_key = {tuple(p): i
+                                for i, p in enumerate(insts)}
             self._chunks = [cols]
             self._alive = [np.ones(len(cols), dtype=bool)]
             self._index = StoreIndex()
@@ -973,6 +1054,7 @@ class Store:
                 types=self.types,
                 relations=self.relations,
                 objects=self.objects,
+                caveat_instances=self.caveat_instances,
             )
 
     def __len__(self) -> int:

@@ -99,24 +99,18 @@ def parse_bootstrap(text: str) -> Bootstrap:
     if missing:
         schema_text = "\n".join([schema_text] + [WORKFLOW_DEFS[n] for n in missing])
     schema = parse_schema(schema_text)
-    # Caveated tuples degrade gracefully: the condition cannot be
-    # evaluated here, so the tuple is EXCLUDED rather than granted
-    # unconditionally — conditional results are skipped, the same
-    # fail-closed direction as the reference skipping CONDITIONAL
-    # LookupResources results (pkg/authz/lookups.go:83-90);
-    # parse_relationship already logged the warning. Only DECLARED
-    # caveats get that tolerance — an unknown bracket trait is far more
-    # likely a typo (e.g. [expiry:...] for [expiration:...]), and
-    # silently dropping the grant would be a quiet access revocation.
-    kept = []
+    # Caveated tuples LOAD with their contexts — conditional grants are
+    # enforced on-device by the caveat VM (caveats/), resolving at check
+    # time against tuple ∪ request context and failing closed on missing
+    # context. Only DECLARED caveats are accepted — an unknown bracket
+    # trait is far more likely a typo (e.g. [expiry:...] for
+    # [expiration:...]), and silently dropping the grant would be a
+    # quiet access revocation.
     for rel in rels:
-        if rel.caveat:
-            if rel.caveat not in schema.caveats:
-                raise ValueError(
-                    f"relationship {rel} carries unknown trait "
-                    f"[{rel.caveat}...]: no such caveat is declared in "
-                    "the schema — refusing to guess (a misspelled "
-                    "expiration would silently drop the grant)")
-            continue
-        kept.append(rel)
-    return Bootstrap(schema, schema_text, kept)
+        if rel.caveat and rel.caveat not in schema.caveats:
+            raise ValueError(
+                f"relationship {rel} carries unknown trait "
+                f"[{rel.caveat}...]: no such caveat is declared in "
+                "the schema — refusing to guess (a misspelled "
+                "expiration would silently drop the grant)")
+    return Bootstrap(schema, schema_text, rels)

@@ -117,8 +117,8 @@ class AllowedSubject:
     relation: Optional[str] = None  # userset subjects: group#member
     wildcard: bool = False  # user:*
     expiration: bool = False  # `with expiration` trait
-    caveat: Optional[str] = None  # `with <caveat>` trait (ignored; see
-    #                               skip_caveat — validated as declared)
+    caveat: Optional[str] = None  # `with <caveat>` trait (validated as
+    #                               declared; enforced by caveats/)
 
     def __str__(self) -> str:
         s = self.type
@@ -157,12 +157,14 @@ class Definition:
 class Schema:
     definitions: dict[str, Definition] = field(default_factory=dict)
     use_expiration: bool = False
-    # DECLARED caveat names (parsed then ignored — see skip_caveat):
-    # kept so tuple traits can be told apart from typos — a tuple
-    # carrying a declared caveat degrades warn-and-skip, an UNDECLARED
-    # bracket trait (e.g. a misspelled expiration) fails loudly instead
-    # of silently dropping the grant
+    # DECLARED caveat names (parse_caveat): distinguishes tuple traits
+    # from typos — an UNDECLARED bracket trait (e.g. a misspelled
+    # expiration) fails loudly instead of silently dropping the grant
     caveats: set = field(default_factory=set)
+    # name -> caveats.ast.CaveatDef: the typed parameter list + body AST
+    # the caveat compiler lowers into the vectorized expression VM
+    # (caveats/compile.py); conditional grants are ENFORCED on-device
+    caveat_defs: dict = field(default_factory=dict)
 
     def definition(self, name: str) -> Definition:
         try:
@@ -181,7 +183,7 @@ _TOKEN_RE = re.compile(
   | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
   | (?P<num>\d+(?:\.\d+)?)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:/[A-Za-z_][A-Za-z0-9_]*)*)
-  | (?P<op>->|[=!<>]=|&&|\|\||[{}():|+&#*,=<>!.\[\]-])
+  | (?P<op>->|[=!<>]=|&&|\|\||[{}():|+&#*,=<>!./\[\]-])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -215,6 +217,7 @@ def _tokenize(text: str) -> Iterator[_Tok]:
 
 class _Parser:
     def __init__(self, text: str):
+        self.text = text
         self.toks = list(_tokenize(text))
         self.i = 0
 
@@ -266,7 +269,11 @@ class _Parser:
                     raise SchemaError(f"duplicate definition {d.name!r}")
                 schema.definitions[d.name] = d
             elif self.cur.value == "caveat":
-                schema.caveats.add(self.skip_caveat())
+                defn = self.parse_caveat()
+                if defn.name in schema.caveat_defs:
+                    raise SchemaError(f"duplicate caveat {defn.name!r}")
+                schema.caveats.add(defn.name)
+                schema.caveat_defs[defn.name] = defn
             else:
                 raise SchemaError(
                     f"schema line {self.cur.line}: expected 'definition', got {self.cur.value!r}"
@@ -274,32 +281,82 @@ class _Parser:
         _validate(schema)
         return schema
 
-    def skip_caveat(self) -> str:
-        # `caveat name(args) { expr }` — parsed and discarded, WITH a
-        # warning (warn-and-ignore degradation): caveats beyond
-        # `expiration` are not enforced by this engine, so relationships
-        # carrying them are excluded at load time (models/bootstrap.py)
-        # and lookups/checks never see conditional grants — fail closed,
-        # mirroring the reference skipping CONDITIONAL LookupResources
-        # results (pkg/authz/lookups.go:83-90). Returns the declared
-        # name (Schema.caveats) so tuple traits can be validated.
+    def parse_caveat(self):
+        """``caveat name(param type, ...) { expr }`` -> a typed
+        :class:`~...caveats.ast.CaveatDef`. The parameter list follows
+        SpiceDB (``day string``; a ``day: string`` colon is tolerated);
+        the body is handed to the caveat expression parser
+        (caveats/ast.py) and type-checked by compiling it against a
+        scratch interner, so a malformed caveat fails the SCHEMA parse
+        instead of the first query that touches it."""
+        from ..caveats.ast import (
+            CaveatDef,
+            CaveatError,
+            CaveatParam,
+            CaveatType,
+            SCALAR_TYPES,
+            parse_caveat_body,
+        )
+        from ..caveats.compile import typecheck
+
         self.expect("caveat")
         name = self.expect_ident()
-        log.warning(
-            "schema: caveat %r parsed but IGNORED (caveats are not "
-            "enforced; relationships conditioned on it will be excluded "
-            "— conditional grants fail closed)", name)
-        depth = 0
+        self.expect("(")
+        params: list = []
+
+        def parse_type() -> CaveatType:
+            t = self.cur
+            if t.kind != "ident":
+                raise SchemaError(
+                    f"schema line {t.line}: expected a caveat parameter "
+                    f"type, got {t.value!r}")
+            self.advance()
+            if t.value == "list":
+                self.expect("<")
+                elem = self.cur
+                if elem.kind != "ident" or elem.value not in SCALAR_TYPES:
+                    raise SchemaError(
+                        f"schema line {elem.line}: unsupported list "
+                        f"element type {elem.value!r}")
+                self.advance()
+                self.expect(">")
+                return CaveatType("list", elem.value)
+            if t.value not in SCALAR_TYPES:
+                raise SchemaError(
+                    f"schema line {t.line}: unsupported caveat "
+                    f"parameter type {t.value!r}")
+            return CaveatType(t.value)
+
+        if self.cur.value != ")":
+            while True:
+                pname = self.expect_ident()
+                if self.cur.value == ":":  # tolerated `name: type` form
+                    self.advance()
+                params.append(CaveatParam(pname, parse_type()))
+                if self.cur.value != ",":
+                    break
+                self.advance()
+        self.expect(")")
+        open_tok = self.expect("{")
+        depth = 1
         while True:
             t = self.advance()
             if t.kind == "eof":
                 raise SchemaError("unterminated caveat block")
-            if t.value in "({":
+            if t.value == "{":
                 depth += 1
-            elif t.value in ")}":
+            elif t.value == "}":
                 depth -= 1
-                if depth == 0 and t.value == "}":
-                    return name
+                if depth == 0:
+                    close_tok = t
+                    break
+        body = self.text[open_tok.pos + 1:close_tok.pos]
+        try:
+            defn = CaveatDef(name, tuple(params), parse_caveat_body(body))
+            typecheck(defn)
+        except CaveatError as e:
+            raise SchemaError(f"caveat {name!r}: {e}") from None
+        return defn
 
     def parse_definition(self) -> Definition:
         self.expect("definition")
@@ -356,18 +413,12 @@ class _Parser:
                     expiration = True
                 else:
                     # a caveated subject type (`user with ip_allowlist`):
-                    # tolerated (warn-and-ignore) rather than a parse
-                    # failure — the relation stays usable, and tuples
-                    # actually CARRYING the caveat are excluded at load
-                    # time (conditional grants fail closed). _validate
-                    # still requires the name to be DECLARED, so a
-                    # misspelled `expiration` cannot slip through as a
-                    # phantom caveat.
+                    # tuples carrying the caveat are conditional grants,
+                    # enforced on-device by the caveat VM (caveats/).
+                    # _validate still requires the name to be DECLARED,
+                    # so a misspelled `expiration` cannot slip through
+                    # as a phantom caveat.
                     caveat = trait
-                    log.warning(
-                        "schema: subject %r allows caveat %r, which is "
-                        "not enforced (caveated tuples are excluded)",
-                        typ, trait)
                 # SpiceDB chains traits with `and`:
                 # `user with some_caveat and expiration`
                 if self.cur.value != "and":

@@ -10,6 +10,7 @@ format used in bootstrap ``relationships`` blocks
 
 from __future__ import annotations
 
+import json
 import logging
 import re
 from dataclasses import dataclass, replace
@@ -21,6 +22,33 @@ log = logging.getLogger("sdbkp.tuples")
 
 class TupleError(ValueError):
     pass
+
+
+def canonical_context(ctx) -> Optional[str]:
+    """Canonical JSON for a caveat context: sorted keys, no whitespace —
+    ONE string form per logical context, so (caveat, context) pairs
+    intern/deduplicate by string equality and ``parse ∘ format`` is the
+    identity on formatted strings. ``None``/empty -> ``None``."""
+    if ctx is None:
+        return None
+    if isinstance(ctx, str):
+        t = ctx.strip()
+        if not t:
+            return None
+        try:
+            ctx = json.loads(t)
+        except ValueError as e:
+            raise TupleError(f"invalid caveat context {ctx!r}: {e}") \
+                from None
+    if not isinstance(ctx, dict):
+        raise TupleError(
+            f"caveat context must be a JSON object, got {ctx!r}")
+    if not ctx:
+        return None
+    try:
+        return json.dumps(ctx, sort_keys=True, separators=(",", ":"))
+    except (TypeError, ValueError) as e:
+        raise TupleError(f"unserializable caveat context: {e}") from None
 
 
 # Template splitting (lenient): segments may contain '/', '.', '-', '{{ }}'
@@ -58,8 +86,8 @@ _REL_RE = re.compile(
 # a caveat CONTEXT may carry JSON with nested ']' (e.g.
 # `[ip_allowlist:{"ips":["10.0.0.0/8"]}]`), which the strict bracket
 # grammar above cannot span: this fallback's non-greedy DOTALL context
-# backtracks to the real closing bracket, so such tuples still hit the
-# documented warn-and-skip degradation instead of crashing the bootstrap
+# backtracks to the real closing bracket; canonical_context then
+# validates the JSON, so malformed contexts fail loudly at parse time
 _REL_CAVEAT_LENIENT_RE = re.compile(
     "^" + _REL_CORE +
     rf"\[(?!expiration[:\]])(?P<caveat>[A-Za-z_][A-Za-z0-9_/]*)"
@@ -80,11 +108,15 @@ class Relationship:
     subject_id: str
     subject_relation: Optional[str] = None  # userset subject, e.g. group#member
     expiration: Optional[float] = None  # unix seconds; None = never expires
-    # caveat NAME when the tuple is conditional (`[caveat_name]`); parsed
-    # tolerantly but never enforced: the engine REFUSES to store caveated
-    # tuples (a conditional grant served unconditionally would fail open)
-    # and the bootstrap loader skips them with a warning
+    # caveat NAME when the grant is conditional (`[caveat_name]` /
+    # `[caveat_name:{...}]`): the tuple participates in checks only when
+    # the caveat's expression holds under tuple ∪ request context,
+    # evaluated on-device by the caveat VM (caveats/)
     caveat: Optional[str] = None
+    # the tuple's stored context as CANONICAL JSON (canonical_context:
+    # sorted keys, compact separators) — a string, not a dict, so the
+    # frozen dataclass stays hashable and parse↔format is lossless
+    caveat_context: Optional[str] = None
 
     def key(self) -> tuple:
         """Identity key — expiration is an attribute, not identity (TOUCH
@@ -101,6 +133,13 @@ class Relationship:
     def without_expiration(self) -> "Relationship":
         return replace(self, expiration=None)
 
+    def context_dict(self) -> Optional[dict]:
+        """The stored caveat context as a dict (None when uncaveated or
+        context-free)."""
+        if not self.caveat_context:
+            return None
+        return json.loads(self.caveat_context)
+
     def __str__(self) -> str:
         s = (
             f"{self.resource_type}:{self.resource_id}#{self.relation}"
@@ -109,7 +148,10 @@ class Relationship:
         if self.subject_relation:
             s += f"#{self.subject_relation}"
         if self.caveat:
-            s += f"[{self.caveat}]"
+            # context serializes back losslessly: canonical JSON inside
+            # the bracket, exactly what parse_relationship re-reads
+            s += (f"[{self.caveat}:{self.caveat_context}]"
+                  if self.caveat_context else f"[{self.caveat}]")
         if self.expiration is not None:
             ts = datetime.fromtimestamp(self.expiration, tz=timezone.utc)
             s += f"[expiration:{ts.strftime('%Y-%m-%dT%H:%M:%SZ')}]"
@@ -142,11 +184,18 @@ def parse_relationship(text: str) -> Relationship:
         sub_rel = None
     exp = parse_expiration(g["expiration"]) if g["expiration"] else None
     caveat = g.get("caveat") or None
-    if caveat:
-        log.warning(
-            "relationship %r carries caveat %r, which is not enforced "
-            "(conditional grants are excluded at load — fail closed)",
-            text.strip(), caveat)
+    # context canonicalizes at parse time (sorted keys, compact), so
+    # parse -> format round-trips losslessly and identical logical
+    # contexts intern to one store instance
+    try:
+        ctx = canonical_context(g.get("caveat_ctx")) if caveat else None
+    except TupleError as e:
+        # a bracket trait with a non-JSON payload is either a malformed
+        # context or — more likely — an unknown trait misspelling a
+        # structured one (`[expiry:2030-...]` for `[expiration:...]`)
+        raise TupleError(
+            f"unknown trait or malformed caveat context "
+            f"[{caveat}:...] in {text.strip()!r}: {e}") from None
     return Relationship(
         g["resource_type"],
         g["resource_id"],
@@ -156,6 +205,7 @@ def parse_relationship(text: str) -> Relationship:
         sub_rel,
         exp,
         caveat,
+        ctx,
     )
 
 

@@ -98,6 +98,11 @@ def _fallback(reason: str) -> None:
     ``overflow`` (overlay/dead-ledger capacity or per-batch record cap),
     ``stratification-inversion`` (a first-ever dependency direction),
     ``closured-expiry`` (expiration attached to a closured block pair),
+    ``closured-caveat`` (a conditional grant attached to a closured
+    block pair — derived closure cells would serve it unconditionally),
+    ``caveat`` (a caveat/context pair not expressible against the
+    frozen instance tables: first-ever caveat, full row bucket, or an
+    unencodable context),
     ``history-trimmed`` / ``unlogged`` (store-side, engine.py),
     ``layout`` (tuple not expressible against the frozen slot layout),
     ``unstratified`` (hand-built graph without overlay state)."""
@@ -352,8 +357,9 @@ def _stratify(offs: np.ndarray, src_rid: np.ndarray, dst_rid: np.ndarray,
 class RunMeta:
     """What the traced fixpoint reads from the graph: slot count,
     permission programs, dense-block offsets, stratification (residual
-    level bounds + per-level edge-dst masks). Captured by jit closures in
-    place of the full CompiledGraph (see _jit_run_for)."""
+    level bounds + per-level edge-dst masks), and the caveat VM's
+    static shapes. Captured by jit closures in place of the full
+    CompiledGraph (see _jit_run_for)."""
 
     M: int
     programs: tuple
@@ -363,6 +369,11 @@ class RunMeta:
     # per level 1..L: tuple of (offset, size) slot ranges finalized at
     # that level (merged via per-range slice writes — no dense masks)
     level_ranges: tuple
+    # caveat VM static meta (caveats/vm.py CavMeta per caveat) and the
+    # total validity-row count (1 = no caveats: the VM is skipped and
+    # edge activation is the expiration mask alone)
+    caveats: tuple = ()
+    cav_rows: int = 1
 
 
 @dataclass
@@ -402,6 +413,7 @@ class CompiledGraph:
     delta_src: Optional[np.ndarray] = None  # int32 [cap], trash-padded
     delta_dst: Optional[np.ndarray] = None
     delta_exp: Optional[np.ndarray] = None  # float32 rel to base_time
+    delta_cav: Optional[np.ndarray] = None  # int32 [cap] caveat rows
     n_delta: int = 0
     dead_pairs: Optional[np.ndarray] = None  # int64 [K, 2] (src, dst) view
     n_dead: int = 0
@@ -419,6 +431,13 @@ class CompiledGraph:
     res_src: Optional[np.ndarray] = None
     res_dst: Optional[np.ndarray] = None
     res_exp: Optional[np.ndarray] = None
+    # per-residual-edge caveat validity row (0 = unconditional); the
+    # edge participates in a hop iff its expiration passes AND its row
+    # in the per-dispatch cav_ok vector reads 1 (caveats/vm.py)
+    res_cav: Optional[np.ndarray] = None
+    # compiled caveat table (caveats/vm.py CompiledCaveats): instance
+    # context columns + op tapes, shared across incremental descendants
+    caveats: Optional[object] = None
     # stratification: residual slice bounds per level (len n_levels+2)
     # and the level of every slot range (range_offs-aligned)
     res_level_bounds: Optional[tuple] = None
@@ -537,6 +556,9 @@ class CompiledGraph:
             # cannot differ in any baked slice coordinate
             None if self.range_offs is None
             else tuple(self.range_offs.tolist()),
+            # caveat VM shapes: tape lengths, register/context/list
+            # layouts, instance-row buckets — all baked into the trace
+            None if self.caveats is None else self.caveats.signature(),
         )
 
     def _delta_pad(self) -> int:
@@ -576,6 +598,7 @@ class CompiledGraph:
                 wins += [(b.dst_off, b.n_dst) for b in self.blocks
                          if b.closured and b.level == k]
                 level_ranges.append(tuple(wins))
+        cav = self.caveats
         return RunMeta(
             M=self.M,
             programs=tuple(self.programs),
@@ -583,6 +606,8 @@ class CompiledGraph:
             res_level_bounds=tuple(bounds),
             n_levels=self.n_levels,
             level_ranges=tuple(level_ranges),
+            caveats=cav.metas if cav is not None else (),
+            cav_rows=cav.n_rows if cav is not None else 1,
         )
 
     def _dev(self):
@@ -604,6 +629,7 @@ class CompiledGraph:
 
     def _dev_build(self):
         d = {}
+        res_cav = self.res_cav
         if self.res_src is not None:
             res_src, res_dst, res_exp = \
                 self.res_src, self.res_dst, self.res_exp
@@ -622,11 +648,19 @@ class CompiledGraph:
             res_src[:n_res] = self.src[self.res_idx]
             res_dst[:n_res] = self.dst[self.res_idx]
             res_exp[:n_res] = self.exp_rel[self.res_idx]
+        if res_cav is None or len(res_cav) != len(res_src):
+            res_cav = np.zeros(len(res_src), dtype=np.int32)
         d["src"] = jnp.asarray(res_src)
         d["dst"] = jnp.asarray(res_dst)
         d["exp"] = jnp.asarray(res_exp)
-        d["dsrc"], d["ddst"], d["dexp"] = (
+        d["cav"] = jnp.asarray(res_cav)
+        d["dsrc"], d["ddst"], d["dexp"], d["dcav"] = (
             jnp.asarray(a) for a in self._delta_host())
+        # caveat VM instance tables (tapes + per-tuple context columns);
+        # () when the graph carries no conditional grants
+        d["cav_static"] = (self.caveats.device_static()
+                          if self.caveats is not None
+                          and self.caveats.metas else ())
 
         # dense blocks from host meta, minus any cells killed by
         # incremental updates since the last full compile (host meta is
@@ -677,16 +711,20 @@ class CompiledGraph:
              & (s >= bm.src_off) & (s < bm.src_off + bm.n_src))
         return t[m] - bm.dst_off, s[m] - bm.src_off
 
-    def _delta_host(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def _delta_host(self) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                   np.ndarray]:
         """Host delta overlay segment (fixed capacity, append order —
         NOT dst-sorted); empty = all trash. Shared across incremental
         descendants; callers snapshotting it hold ``_host_guard``."""
         if self.delta_src is not None:
-            return self.delta_src, self.delta_dst, self.delta_exp
+            cav = self.delta_cav if self.delta_cav is not None \
+                else np.zeros(len(self.delta_src), dtype=np.int32)
+            return self.delta_src, self.delta_dst, self.delta_exp, cav
         pad = self._delta_pad()
         return (np.full(pad, self.M, dtype=np.int32),
                 np.full(pad, self.M, dtype=np.int32),
-                np.full(pad, -np.inf, dtype=np.float32))
+                np.full(pad, -np.inf, dtype=np.float32),
+                np.zeros(pad, dtype=np.int32))
 
     def query_async(
         self,
@@ -699,6 +737,10 @@ class CompiledGraph:
         q_contiguous: Optional[bool] = None,
         q_contig_grid: Optional[tuple] = None,  # (lo, L, R): R rows x
         # one shared [lo, lo+L) window (the fused-batch shape)
+        context: Optional[dict] = None,  # request caveat context
+        cav_req: Optional[tuple] = None,  # pre-encoded request arrays
+        # (CompiledCaveats.encode_request) — chunked bulk callers encode
+        # ONCE for the whole logical call instead of per chunk
     ) -> "QueryFuture":
         """Dispatch the fixpoint without blocking.
 
@@ -783,7 +825,18 @@ class CompiledGraph:
                     if len(q_keys) >= 32:
                         d.pop(q_keys[0], None)
                     d[("q", q_cache_key)] = (qs_dev, qb_dev)
-        now_rel = np.float32((time.time() if now is None else now) - self.base_time)
+        now_abs = time.time() if now is None else now
+        now_rel = np.float32(now_abs - self.base_time)
+        # request caveat context -> tiny per-caveat arrays riding the
+        # dispatch (scalars + known flags per declared parameter); the
+        # VM merges them under the tuple contexts ON DEVICE, so the
+        # caveat mask lands in the same dispatch as the fixpoint
+        cav = self.caveats
+        if cav is not None and cav.metas:
+            if cav_req is None:
+                cav_req, _ = cav.encode_request(context, now_abs)
+        else:
+            cav_req = ()
         # named span in jax.profiler traces (bench --profile-dir / any
         # caller-managed jax.profiler.trace): lets a device timeline
         # attribute time to the reachability dispatch specifically
@@ -791,9 +844,10 @@ class CompiledGraph:
             # seeds ride the jit call as a host array: jax folds the
             # transfer into the dispatch instead of a separate device_put
             # round trip (visible through remotely-attached chips)
-            out, converged, iters = d["run"](
+            out, converged, iters, cav_missing = d["run"](
                 d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
-                d["dsrc"], d["ddst"], d["dexp"],
+                d["cav"], d["dsrc"], d["ddst"], d["dexp"], d["dcav"],
+                d["cav_static"], cav_req,
                 seeds, qs_dev, qb_dev,
                 now_rel, max_iters=max_iters, **run_kwargs,
             )
@@ -805,9 +859,11 @@ class CompiledGraph:
             # synchronous device roundtrip per query (a full tunnel RTT on
             # remotely-attached chips)
             iters.copy_to_host_async()
+            cav_missing.copy_to_host_async()
         except AttributeError:  # non-jax array backends in tests
             pass
-        return QueryFuture(out, converged, iters, Q, max_iters)
+        return QueryFuture(out, converged, iters, Q, max_iters,
+                           cav_missing)
 
     def query(
         self,
@@ -873,13 +929,17 @@ class QueryFuture:
     """A dispatched reachability query. ``result()`` blocks and validates
     convergence. ``iterations()`` (valid after result/convergence check)
     reports how many fixpoint hops the query ran — the analog of SpiceDB's
-    dispatch depth, exported to the metrics registry by the engine."""
+    dispatch depth, exported to the metrics registry by the engine.
+    ``caveats_missing()`` is the number of caveat instances that resolved
+    to the missing-context tri-state this dispatch (denied fail-closed;
+    feeds ``engine_caveat_denied_missing_context_total``)."""
 
     _out: object
     _converged: object
     _iters: object
     _q: int
     _max_iters: int
+    _cav_missing: object = None
 
     def result(self) -> np.ndarray:
         if not bool(self._converged):
@@ -891,6 +951,9 @@ class QueryFuture:
 
     def iterations(self) -> int:
         return int(self._iters)
+
+    def caveats_missing(self) -> int:
+        return 0 if self._cav_missing is None else int(self._cav_missing)
 
 
 def _apply_program(cg: CompiledGraph, V, programs=None):
@@ -1004,8 +1067,9 @@ def _seed_base(cg: CompiledGraph, seeds):
     return _apply_program(cg, base.reshape(B, rows, LANE))
 
 
-def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel,
-         dsrc, ddst, dexp, seeds, q_slots, q_batch, now_rel, *,
+def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel, cav,
+         dsrc, ddst, dexp, dcav, cav_static, cav_req,
+         seeds, q_slots, q_batch, now_rel, *,
          max_iters: int, q_contig_len: int = 0, q_contig_rows: int = 1):
     """The jitted stratified fixpoint. V layout: [B, rows, LANE] uint8 —
     the slot space rides the lane axis so a B=1 query streams exactly M
@@ -1016,12 +1080,29 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel,
     the while_loop; each acyclic level k=1..n_levels is then applied
     exactly once — its ranges' in-edges all live at level k and their
     sources are already final. In kube-shaped graphs this keeps the
-    dominant per-pod blocks out of the loop entirely."""
+    dominant per-pod blocks out of the loop entirely.
+
+    Conditional grants: when the graph carries caveat instances
+    (cg.cav_rows > 1), the caveat VM evaluates every instance's
+    tri-state ONCE up front (contexts don't change within a dispatch)
+    and edge activation becomes ``expiration ∧ cav_ok[edge_row]`` for
+    base-residual and overlay edges alike — caveated edges never enter
+    dense blocks (compile_graph routes them residual, like expiring
+    edges), so the mask composes with the existing validity plumbing."""
     B = seeds.shape[0]
     rows = cg.M // LANE + 1  # + trash row (slots M .. M+LANE-1)
     Mp = rows * LANE
     valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E_res]
     dvalid = (dexp > now_rel).astype(jnp.uint8)  # [D_pad]
+    if cg.cav_rows > 1:
+        from ..caveats.vm import eval_caveats
+
+        cav_ok, cav_missing = eval_caveats(
+            cg.caveats, cav_static, cav_req, cg.cav_rows)
+        valid = valid & cav_ok[cav]
+        dvalid = dvalid & cav_ok[dcav]
+    else:
+        cav_missing = jnp.int32(0)
     base = _seed_base(cg, seeds)
     baseflat = base.reshape(B, Mp)
     bounds = cg.res_level_bounds
@@ -1084,7 +1165,7 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel,
         ).reshape(q_contig_rows * q_contig_len).astype(jnp.bool_)
     else:
         out = V.reshape(B, Mp)[q_batch, q_slots].astype(jnp.bool_)
-    return out, jnp.logical_not(still_changing), iters
+    return out, jnp.logical_not(still_changing), iters, cav_missing
 
 
 # ---------------------------------------------------------------------------
@@ -1216,8 +1297,23 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
     srcs: list[np.ndarray] = []
     dsts: list[np.ndarray] = []
     exps: list[np.ndarray] = []
+    cavs: list[np.ndarray] = []
     base_time = time.time()
     exp_rel_all = (cols.exp - base_time).astype(np.float32)
+
+    # caveat instance table: one VM row per distinct (caveat, context)
+    # pair among live tuples; every edge derived from a caveated tuple
+    # (direct / userset / arrow alike) carries its instance row so the
+    # traced fixpoint can gate it on the per-dispatch tri-state
+    from ..caveats.vm import build_caveat_table
+
+    cav_ids = cols.cav.astype(np.int64)
+    used_cavs = np.unique(cav_ids[cav_ids > 0])
+    caveat_table = build_caveat_table(
+        getattr(schema, "caveat_defs", None) or {},
+        getattr(snapshot, "caveat_instances", None) or [("", "")],
+        used_cavs)
+    cav_row_all = caveat_table.inst_row[cav_ids]
 
     rt = cols.rt.astype(np.int64)
     st = cols.st.astype(np.int64)
@@ -1232,6 +1328,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
     srcs.append(self_off[st[m]] + cols.sid[m])
     dsts.append(dst_all[m])
     exps.append(exp_rel_all[m])
+    cavs.append(cav_row_all[m])
 
     # userset tuples: src is the subject's (type, relation|permission) slot
     us_off = relperm_off[st, srl]
@@ -1239,6 +1336,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
     srcs.append(us_off[m] + cols.sid[m])
     dsts.append(dst_all[m])
     exps.append(exp_rel_all[m])
+    cavs.append(cav_row_all[m])
 
     # arrow term edges
     arrow_maps: list = []
@@ -1272,24 +1370,28 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
             srcs.append(tgt_off[st[m]] + cols.sid[m])
             dsts.append(term_off + cols.rid[m])
             exps.append(exp_rel_all[m])
+            cavs.append(cav_row_all[m])
 
     src = np.concatenate(srcs) if srcs else np.empty(0, dtype=np.int64)
     dst = np.concatenate(dsts) if dsts else np.empty(0, dtype=np.int64)
     exp = np.concatenate(exps) if exps else np.empty(0, dtype=np.float32)
+    cav = np.concatenate(cavs) if cavs else np.empty(0, dtype=np.int64)
 
     order = native.sort_perm(dst)
     if order is None:
         order = np.argsort(dst, kind="stable")
-    src, dst, exp = src[order], dst[order], exp[order]
+    src, dst, exp, cav = src[order], dst[order], exp[order], cav[order]
 
     n_edges = len(src)
     E_pad = _next_bucket(max(n_edges, 1))
     src_p = np.full(E_pad, M, dtype=np.int32)
     dst_p = np.full(E_pad, M, dtype=np.int32)
     exp_p = np.full(E_pad, -np.inf, dtype=np.float32)
+    cav_p = np.zeros(E_pad, dtype=np.int32)
     src_p[:n_edges] = src
     dst_p[:n_edges] = dst
     exp_p[:n_edges] = exp
+    cav_p[:n_edges] = cav
 
     # ---- elementwise programs ----
     programs: list[_PermProgram] = []
@@ -1351,14 +1453,17 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
     closure_coo: dict[int, tuple] = {}  # self range id -> closured COO
     if n_edges:
         never_expires = exp == np.inf
+        # caveated edges ride the residual path like expiring edges:
+        # their activation is a per-dispatch condition, and a dense
+        # (let alone closured) block cell cannot carry one
+        special = (~never_expires) | (cav != 0)
         key = dst_rid * len(offs) + src_rid
-        # expiring edges always ride the residual path (query-time clock)
-        key = np.where(never_expires, key, -1)
+        key = np.where(~special, key, -1)
         uniq, inv, counts = np.unique(key, return_inverse=True,
                                       return_counts=True)
         expiring_pairs = (set(np.unique(
-            dst_rid[~never_expires] * len(offs) + src_rid[~never_expires]
-        ).tolist()) if not never_expires.all() else set())
+            dst_rid[special] * len(offs) + src_rid[special]
+        ).tolist()) if special.any() else set())
         for ui, (k, cnt) in enumerate(zip(uniq.tolist(), counts.tolist())):
             sel = np.flatnonzero(inv == ui)
             if k < 0:
@@ -1448,6 +1553,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
     res_src = np.full(res_level_bounds[-1], M, dtype=np.int32)
     res_dst = np.full(res_level_bounds[-1], M, dtype=np.int32)
     res_exp = np.full(res_level_bounds[-1], -np.inf, dtype=np.float32)
+    res_cav = np.zeros(res_level_bounds[-1], dtype=np.int32)
     pos = 0
     for k in range(n_levels + 1):
         n_k = int(counts_per_level[k])
@@ -1456,6 +1562,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
         res_src[lo:lo + n_k] = src_p[sel]
         res_dst[lo:lo + n_k] = dst_p[sel]
         res_exp[lo:lo + n_k] = exp_p[sel]
+        res_cav[lo:lo + n_k] = cav_p[sel]
         pos += n_k
 
     # fixed-capacity delta overlay: preallocated trash-padded segments the
@@ -1484,6 +1591,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
         delta_src=np.full(cap, M, dtype=np.int32),
         delta_dst=np.full(cap, M, dtype=np.int32),
         delta_exp=np.full(cap, -np.inf, dtype=np.float32),
+        delta_cav=np.zeros(cap, dtype=np.int32),
         n_delta=0,
         dead_pairs=None,
         n_dead=0,
@@ -1496,6 +1604,8 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
         res_src=res_src,
         res_dst=res_dst,
         res_exp=res_exp,
+        res_cav=res_cav,
+        caveats=caveat_table,
         res_level_bounds=res_level_bounds,
         n_levels=n_levels,
         range_levels=range_levels,
@@ -1661,7 +1771,8 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     pkg/authz/check.go:42-44) at O(write) instead of O(graph) per write.
     """
     if cg.res_src is None or cg.self_off is None or cg.delta_pos is None \
-            or cg.delta_src is None or cg.dead_buf is None:
+            or cg.delta_src is None or cg.dead_buf is None \
+            or cg.delta_cav is None:
         _fallback("unstratified")
         return None
     if len(records) > MAX_DELTA_RECORDS:
@@ -1674,33 +1785,59 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     # ---- plan (NO mutation): a fallback must leave the shared overlay
     # exactly as it was — the caller recompiles from a fresh snapshot and
     # in-flight queries keep serving the untouched current view ----------
-    appends: dict[tuple[int, int], float] = {}  # pair -> exp (new slot)
-    updates: dict[int, float] = {}  # existing overlay slot -> new exp
+    appends: dict = {}  # pair -> (exp, cav row) for a new overlay slot
+    updates: dict = {}  # overlay slot -> (new exp, cav row | None=keep)
     res_kill: list[int] = []
     block_cells: dict[int, dict[tuple[int, int], int]] = {}
     new_dead: list[tuple[int, int]] = []
     dead_seen: set = set()
     # closured blocks whose BASE edges lost pairs: re-closed wholesale
     reclose: dict[int, set] = {}  # block idx -> local (dst, src) pairs
+    # new (caveat, context) instance rows reserved this batch — applied
+    # to the shared tables only at commit (caveats/vm.py plan_append)
+    planned_inst: dict = {}
 
     for is_delete, relationship in records:
         edges = _edges_for_tuple(cg, store, relationship)
         if edges is None:
             _fallback("layout")
             return None
+        cav_row = 0
+        if not is_delete and relationship.caveat:
+            # conditional grant: resolve (caveat, context) to a VM
+            # instance row — an existing one, or a reserved spare row in
+            # the caveat's padded bucket. No tape for the caveat (first
+            # caveated tuple ever) or no spare row: the instance tables
+            # must re-shape, which is a full recompile.
+            table = cg.caveats
+            ctx = relationship.caveat_context or ""
+            row = (table.lookup_row(relationship.caveat, ctx)
+                   if table is not None else None)
+            if row is None and table is not None:
+                row = table.plan_append(relationship.caveat, ctx,
+                                        planned_inst)
+            if row is None:
+                _fallback("caveat")
+                return None
+            cav_row = row
         if not is_delete:
             for src, dst in edges:
-                if relationship.expiration is not None:
+                if relationship.expiration is not None \
+                        or relationship.caveat:
                     b_ = _pair_block(cg, src, dst)
                     if b_ is not None and cg.blocks[b_].closured:
-                        # a touch attaching an expiration de-qualifies
-                        # the pair from closure entirely (expiring edges
-                        # must ride the residual path). Classified
+                        # a touch attaching an expiration (or a caveat)
+                        # de-qualifies the pair from closure entirely
+                        # (conditional/expiring edges must ride the
+                        # residual path — a derived closure cell would
+                        # serve the grant unconditionally). Classified
                         # BEFORE the level-order check: a closured
                         # self-block lifts its range out of the iterated
                         # core, so the generic check would fire first
                         # and miscount this as an inversion.
-                        _fallback("closured-expiry")
+                        _fallback("closured-expiry"
+                                  if relationship.expiration is not None
+                                  else "closured-caveat")
                         return None
                 if not _level_order_ok(cg, src, dst):
                     # the new edge would invert the frozen stratification
@@ -1735,7 +1872,7 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
                     # slot is the rest of the delete
                     slot = delta_pos.get(pair)
                     if slot is not None:
-                        updates[slot] = float("-inf")
+                        updates[slot] = (float("-inf"), None)
                     appends.pop(pair, None)
                     continue
             # invalidate everywhere the BASE edge may live (once per pair
@@ -1754,18 +1891,20 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
             slot = delta_pos.get(pair)
             if is_delete:
                 if slot is not None:
-                    updates[slot] = float("-inf")
+                    updates[slot] = (float("-inf"), None)
                 appends.pop(pair, None)
                 continue
             # adds (including re-touches of block-covered pairs) always
             # land in the overlay — one ledger for both the single-chip
-            # and sharded consumers; base copies are only ever cleared
+            # and sharded consumers; base copies are only ever cleared.
+            # The caveat row rides the slot alongside the expiration:
+            # a touch may attach, replace, or strip the condition.
             exp_rel = (np.inf if relationship.expiration is None
                        else relationship.expiration - cg.base_time)
             if slot is not None:
-                updates[slot] = float(exp_rel)
+                updates[slot] = (float(exp_rel), cav_row)
             else:
-                appends[pair] = float(exp_rel)
+                appends[pair] = (float(exp_rel), cav_row)
 
     n_app = len(appends)
     if cg.n_delta + n_app > cg.delta_cap \
@@ -1796,20 +1935,27 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
     n0 = cg.n_delta
     nd0 = cg.n_dead
     with cg.host_lock:
-        for i, ((s, t), ex) in enumerate(app_items):
+        for i, ((s, t), (ex, cv)) in enumerate(app_items):
             slot = n0 + i
             cg.delta_src[slot] = s
             cg.delta_dst[slot] = t
             cg.delta_exp[slot] = ex
+            cg.delta_cav[slot] = cv
             delta_pos[(s, t)] = slot
-        for slot, ex in updates.items():
+        for slot, (ex, cv) in updates.items():
             cg.delta_exp[slot] = ex
+            if cv is not None:
+                cg.delta_cav[slot] = cv
         if res_kill:
             cg.res_exp[np.asarray(res_kill, dtype=np.int64)] = -np.inf
         for j, (s, t) in enumerate(new_dead):
             cg.dead_buf[nd0 + j, 0] = s
             cg.dead_buf[nd0 + j, 1] = t
         dead_set.update(new_dead)
+        # reserved caveat-instance rows land in the shared host tables
+        # (same commit discipline as the overlay slots)
+        inst_dev = (cg.caveats.apply_appends(planned_inst)
+                    if planned_inst else [])
     n_delta2 = n0 + len(app_items)
     n_dead2 = nd0 + len(new_dead)
     metrics.gauge("engine_delta_occupancy").set(n_delta2)
@@ -1835,9 +1981,37 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
                 [n0 + i for i in range(len(app_items))]
                 + list(updates.keys()), dtype=np.int64)
             uv = np.asarray(
-                [ex for _, ex in app_items] + list(updates.values()),
+                [ex for _, (ex, _) in app_items]
+                + [ex for ex, _ in updates.values()],
                 dtype=np.float32)
             d["dexp"] = d["dexp"].at[ui].set(uv)
+        cav_slots = [n0 + i for i in range(len(app_items))] \
+            + [slot for slot, (_, cv) in updates.items()
+               if cv is not None]
+        cav_vals = [cv for _, (_, cv) in app_items] \
+            + [cv for _, cv in updates.values() if cv is not None]
+        if cav_slots:
+            d["dcav"] = d["dcav"].at[
+                np.asarray(cav_slots, dtype=np.int64)].set(
+                np.asarray(cav_vals, dtype=np.int32))
+        if inst_dev and d.get("cav_static"):
+            # new instance rows: O(row) functional column writes on the
+            # resident context tables, published into this view only
+            cs = list(d["cav_static"])
+            for ci, local, cols_ in inst_dev:
+                sce, scv, sck, lle, llv, lhe, lhv, lk = cols_
+                ent = dict(cs[ci])
+                ent["ce"] = ent["ce"].at[:, local].set(sce)
+                ent["cv"] = ent["cv"].at[:, local].set(scv)
+                ent["ck"] = ent["ck"].at[:, local].set(sck)
+                ent["loe"] = ent["loe"].at[:, :, local].set(lle)
+                ent["lov"] = ent["lov"].at[:, :, local].set(llv)
+                ent["hie"] = ent["hie"].at[:, :, local].set(lhe)
+                ent["hiv"] = ent["hiv"].at[:, :, local].set(lhv)
+                ent["lk"] = ent["lk"].at[:, local].set(lk)
+                ent["real"] = ent["real"].at[local].set(True)
+                cs[ci] = ent
+            d["cav_static"] = tuple(cs)
         if res_kill:
             d["exp"] = old["exp"].at[np.asarray(
                 res_kill, dtype=np.int64)].set(-np.inf)

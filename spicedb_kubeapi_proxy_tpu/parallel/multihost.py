@@ -600,16 +600,18 @@ class MirroredEngine:
 
     # -- mirrored queries ----------------------------------------------------
 
-    def check_bulk(self, items, now=None):
-        return self.check_bulk_async(items, now=now).result()
+    def check_bulk(self, items, now=None, context=None):
+        return self.check_bulk_async(items, now=now,
+                                     context=context).result()
 
-    def check_bulk_async(self, items, now=None):
+    def check_bulk_async(self, items, now=None, context=None):
         import time as _time
 
         if not self._mirror_queries:
             # failover (primary/replica) mode: no SPMD lockstep to feed —
             # queries serve leader-locally (cache/batching stay live)
-            return self.engine.check_bulk_async(items, now=now)
+            return self.engine.check_bulk_async(items, now=now,
+                                                context=context)
         if now is None:
             now = _time.time()  # concrete BEFORE publishing
         # normalize ONCE and execute the normalized items locally too —
@@ -621,39 +623,43 @@ class MirroredEngine:
             # the firehose path: items ride a flat binary payload built
             # LAZILY — _publish only materializes it when subscribers
             # exist (the encode is the dominant publish cost)
-            self._publish("check_bulk", {"now": now},
+            self._publish("check_bulk", {"now": now, "ctx": context},
                           blob=lambda: encode_check_items(items))
             # dispatch inside the lock (ordering), result read outside
-            return self.engine.check_bulk_async(items, now=now)
+            return self.engine.check_bulk_async(items, now=now,
+                                                context=context)
 
-    def check(self, item, now=None):
-        return self.check_bulk([item], now=now)[0]
+    def check(self, item, now=None, context=None):
+        return self.check_bulk([item], now=now, context=context)[0]
 
     def lookup_resources(self, resource_type, permission, subject_type,
-                         subject_id, subject_relation=None, now=None):
+                         subject_id, subject_relation=None, now=None,
+                         context=None):
         from ..engine.engine import mask_to_ids
 
         mask, interner = self.lookup_resources_mask(
             resource_type, permission, subject_type, subject_id,
-            subject_relation, now=now)
+            subject_relation, now=now, context=context)
         return mask_to_ids(mask, interner)
 
     def lookup_resources_mask(self, resource_type, permission,
                               subject_type, subject_id,
-                              subject_relation=None, now=None):
+                              subject_relation=None, now=None,
+                              context=None):
         return self.lookup_resources_mask_async(
             resource_type, permission, subject_type, subject_id,
-            subject_relation, now=now).result()
+            subject_relation, now=now, context=context).result()
 
     def lookup_resources_mask_async(self, resource_type, permission,
                                     subject_type, subject_id,
-                                    subject_relation=None, now=None):
+                                    subject_relation=None, now=None,
+                                    context=None):
         import time as _time
 
         if not self._mirror_queries:
             return self.engine.lookup_resources_mask_async(
                 resource_type, permission, subject_type, subject_id,
-                subject_relation, now=now)
+                subject_relation, now=now, context=context)
         if now is None:
             now = _time.time()
         with self._lock:
@@ -661,6 +667,7 @@ class MirroredEngine:
                 "resource_type": resource_type, "permission": permission,
                 "subject_type": subject_type, "subject_id": subject_id,
                 "subject_relation": subject_relation, "now": now,
+                "ctx": context,
             })
             return self.engine.lookup_resources_mask_async(
                 resource_type, permission, subject_type, subject_id,
@@ -797,12 +804,14 @@ def _apply_one(engine, frame: dict, m: str,
     elif m == "check_bulk":
         items = decode_check_items(blob) if blob is not None \
             else [CheckItem(*it) for it in frame["items"]]
-        engine.check_bulk(items, now=frame["now"])
+        engine.check_bulk(items, now=frame["now"],
+                          context=frame.get("ctx") or None)
     elif m == "lookup_mask":
         engine.lookup_resources_mask(
             frame["resource_type"], frame["permission"],
             frame["subject_type"], frame["subject_id"],
-            frame.get("subject_relation"), now=frame["now"])
+            frame.get("subject_relation"), now=frame["now"],
+            context=frame.get("ctx") or None)
     else:
         raise MultiHostError(f"unknown mirror method {m!r}")
 

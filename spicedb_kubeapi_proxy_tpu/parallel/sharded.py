@@ -210,6 +210,14 @@ class ShardedGraph:
 
     def __init__(self, cg: CompiledGraph, mesh: Mesh,
                  max_iters: int = DEFAULT_MAX_ITERS):
+        if cg.caveats is not None and getattr(cg.caveats, "metas", ()):
+            # the sharded fixpoint has no caveat VM: serving caveated
+            # edges unconditionally would FAIL OPEN. Engine._backend
+            # routes caveated graphs through the single-device path;
+            # refusing here keeps any other caller honest.
+            raise ValueError(
+                "ShardedGraph does not evaluate caveats; caveated "
+                "graphs must use the single-device path")
         self.cg = cg
         self.mesh = mesh
         self.max_iters = max_iters
@@ -236,10 +244,14 @@ class ShardedGraph:
                 jax.device_put(self._block_matrix(bm), self._block_sh)
                 for bm in kept
             )
-            self._dsrc, self._ddst, self._dexp = self._delta_device(cg)
+            (self._dsrc, self._ddst, self._dexp,
+             self._h_dexp) = self._delta_device(cg)
         # dead pairs already folded into this build (updated() applies
-        # only the new tail)
+        # only the new tail); _applied_delta / _h_dexp let updated()
+        # patch only the overlay slots that actually changed instead of
+        # re-uploading the whole segment per write
         self._applied_dead = _pair_keys(cg.dead_pairs)
+        self._applied_delta = cg.n_delta
         # device query-grid cache for layout-pure queries (shared across
         # updated() generations: the slot layout is incremental-invariant)
         self._qgrid: dict = {}
@@ -364,8 +376,10 @@ class ShardedGraph:
         return A
 
     def _delta_device(self, cg: CompiledGraph):
-        """Upload the delta segment, padded so the graph axis divides."""
-        d_src, d_dst, d_exp = cg._delta_host()
+        """Upload the delta segment, padded so the graph axis divides.
+        Returns the three device arrays plus the padded host expiration
+        copy — updated()'s change-detection mirror."""
+        d_src, d_dst, d_exp, _ = cg._delta_host()
         pad = len(d_src)
         if pad % self.ng:
             pad2 = ((pad + self.ng - 1) // self.ng) * self.ng
@@ -377,7 +391,8 @@ class ShardedGraph:
                 [d_exp, np.full(pad2 - pad, -np.inf, dtype=np.float32)])
         return (jax.device_put(d_src, self._edge_sh),
                 jax.device_put(d_dst, self._edge_sh),
-                jax.device_put(d_exp, self._edge_sh))
+                jax.device_put(d_exp, self._edge_sh),
+                np.array(d_exp, dtype=np.float32))
 
     # -- incremental updates -------------------------------------------------
 
@@ -476,7 +491,36 @@ class ShardedGraph:
                 new._blocks = tuple(blocks)
         new._applied_dead = keys
         with cg._host_guard():
-            new._dsrc, new._ddst, new._dexp = new._delta_device(cg)
+            # overlay: patch ONLY the slots that changed since this
+            # sharded view last synced, with functional updates on the
+            # device-RESIDENT per-shard copies — an O(write) scatter
+            # instead of re-uploading the whole capacity-sized segment
+            # on every write (the pre-patch behavior, which made each
+            # mesh write pay O(capacity) host->device traffic).
+            d_src, d_dst, d_exp, _ = cg._delta_host()
+            n = len(d_exp)
+            mirror = self._h_dexp
+            # appended slots (src/dst/exp assigned once, at append)...
+            app = np.arange(self._applied_delta,
+                            min(cg.n_delta, n), dtype=np.int64)
+            # ...plus expiration re-touches of EXISTING slots
+            # (touch/delete reuse their pair's slot in place)
+            changed = np.flatnonzero(mirror[:n] != d_exp)
+            changed = np.union1d(changed, app)
+            if len(changed):
+                new._h_dexp = mirror.copy()
+                new._h_dexp[changed] = d_exp[changed]
+                if len(app):
+                    new._dsrc = jax.device_put(
+                        self._dsrc.at[app].set(d_src[app]),
+                        self._edge_sh)
+                    new._ddst = jax.device_put(
+                        self._ddst.at[app].set(d_dst[app]),
+                        self._edge_sh)
+                new._dexp = jax.device_put(
+                    self._dexp.at[changed].set(d_exp[changed]),
+                    self._edge_sh)
+            new._applied_delta = cg.n_delta
         return new
 
     # -- dispatch -----------------------------------------------------------
@@ -538,6 +582,10 @@ class ShardedGraph:
         q_contiguous: Optional[bool] = None,  # accepted for surface parity
         q_contig_grid: Optional[tuple] = None,  # (lo, L, R) promise: R rows
         # x one shared [lo, lo+L) window — skips the rank re-map entirely
+        context: Optional[dict] = None,  # surface parity; caveated
+        # graphs never reach this backend (constructor guard), so a
+        # request context has nothing to gate here
+        cav_req: Optional[tuple] = None,  # surface parity (unused)
     ) -> ShardedQueryFuture:
         """Engine-compatible flat form (CompiledGraph.query_async surface):
         the flat (q_slots, q_batch) queries are packed into a [B, Qmax]

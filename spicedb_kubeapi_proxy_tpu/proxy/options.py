@@ -194,6 +194,13 @@ class Options:
     # only — a tcp:// engine host owns its own overlay (same flags there).
     delta_capacity: int = 4096
     compact_threshold: float = 0.75
+    # request caveat context (caveats/, docs/operations.md "Caveats &
+    # conditional grants"): forward caller attributes (client IP from
+    # the trusted header below — last XFF hop — user, verb/resource) to the engine so
+    # conditional grants resolve per request; off = request-dependent
+    # caveats fail closed (tuple-context-only caveats still evaluate)
+    caveat_context: bool = True
+    caveat_ip_header: str = "x-forwarded-for"
     # >0 probes the device backend in a SUBPROCESS with this timeout
     # before building an in-process engine: the remotely-attached TPU
     # plugin HANGS (not errors) when its tunnel is down, which would
@@ -450,6 +457,10 @@ class Options:
                                     self.compact_threshold)
         except ValueError as e:
             raise OptionsError(str(e)) from None
+        if not (self.caveat_ip_header or "").strip():
+            raise OptionsError("caveat-ip-header must not be empty "
+                               "(set --caveat-context=false to disable "
+                               "request context instead)")
         if bool(self.tls_cert_file) != bool(self.tls_key_file):
             raise OptionsError(
                 "tls-cert-file and tls-key-file must be set together")
@@ -706,6 +717,8 @@ class Options:
             breakers=dep_breakers,
             admission=admission,
             audit=audit,
+            caveat_context_enabled=self.caveat_context,
+            caveat_ip_header=self.caveat_ip_header,
         )
         ssl_context = None
         if self.tls_cert_file:
@@ -776,6 +789,7 @@ class Options:
         "checkpoint_wal_records", "checkpoint_keep",
         "authz_cache", "authz_cache_size", "authz_cache_mask_bytes",
         "delta_capacity", "compact_threshold",
+        "caveat_context", "caveat_ip_header",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
@@ -951,6 +965,20 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         default=256 << 20,
                         help="resident lookup-mask byte budget; the "
                              "cold end evicts past it")
+    parser.add_argument("--caveat-context", type=parse_bool_flag,
+                        nargs="?", const=True, default=True,
+                        help="forward request caveat context (client IP "
+                             "from --caveat-ip-header, user, verb, "
+                             "resource) to the engine so conditional "
+                             "grants resolve per request; =false makes "
+                             "request-dependent caveats fail closed "
+                             "(default: true)")
+    parser.add_argument("--caveat-ip-header", default="x-forwarded-for",
+                        help="trusted header carrying the client IP for "
+                             "IP-allowlist caveats (LAST hop of a "
+                             "comma-separated chain — the one the "
+                             "trusted LB appended; default: "
+                             "x-forwarded-for)")
     parser.add_argument("--delta-capacity", type=int, default=4096,
                         help="device-resident delta-overlay slots per "
                              "compiled graph (fixed — part of the jit "
@@ -1162,6 +1190,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         authz_cache_mask_bytes=args.authz_cache_mask_bytes,
         delta_capacity=args.delta_capacity,
         compact_threshold=args.compact_threshold,
+        caveat_context=args.caveat_context,
+        caveat_ip_header=args.caveat_ip_header,
         engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,

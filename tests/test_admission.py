@@ -429,7 +429,7 @@ def test_admission_vs_not_leader_distinguishable_in_metrics():
     from spicedb_kubeapi_proxy_tpu.engine.remote import NotLeaderError
 
     class NotLeaderEngine:
-        def check_bulk(self, items, now=None):
+        def check_bulk(self, items, now=None, context=None):
             raise NotLeaderError()
 
     async def go():
@@ -632,7 +632,7 @@ def test_watchhub_groups_fuse_into_batched_dispatches():
 # -- caveat graceful degradation (satellite) ---------------------------------
 
 
-def test_caveats_parse_tolerantly_and_fail_closed():
+def test_caveats_load_and_enforce_conditionally():
     from spicedb_kubeapi_proxy_tpu.engine.engine import SchemaViolation
     from spicedb_kubeapi_proxy_tpu.models.bootstrap import parse_bootstrap
     from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
@@ -648,53 +648,69 @@ schema: |-
 relationships: |-
   doc:readme#viewer@user:alice
   doc:readme#viewer@user:bob[on_tuesday]
-  doc:readme#viewer@user:eve[on_tuesday:{"tz": "utc"}]
 """)
-    # caveat declaration + caveated subject types parse (warn-and-ignore)
     assert "doc" in b.schema.definitions
-    # caveated tuples are EXCLUDED at load: a conditional grant is never
-    # served unconditionally (the reference skips CONDITIONAL lookup
-    # results, pkg/authz/lookups.go:83-90 — here they never enter)
-    assert [str(r) for r in b.relationships] == [
-        "doc:readme#viewer@user:alice"]
+    assert "on_tuesday" in b.schema.caveat_defs
+    # caveated tuples LOAD (no more exclusion) and are enforced by the
+    # device-side caveat VM: grant with satisfying context, deny with a
+    # non-satisfying one, fail-closed deny on missing context
+    assert len(b.relationships) == 2
     e = Engine(schema=b.schema)
     for r in b.relationships:
         e.write_relationships([WriteOp("touch", r)])
     assert e.check(CheckItem("doc", "readme", "view", "user", "alice"))
-    assert not e.check(CheckItem("doc", "readme", "view", "user", "bob"))
+    bob = CheckItem("doc", "readme", "view", "user", "bob")
+    assert e.check(bob, context={"day": "tuesday"})
+    assert not e.check(bob, context={"day": "monday"})
+    assert not e.check(bob)  # missing context: fail closed
     assert e.lookup_resources("doc", "view", "user", "bob") == []
-    # the write path refuses conditional grants outright
+    assert e.lookup_resources("doc", "view", "user", "bob",
+                              context={"day": "tuesday"}) == ["readme"]
+    # the write path accepts DECLARED caveats but still refuses
+    # undeclared ones and contexts that don't type-check
+    e.write_relationships([WriteOp("touch", Relationship(
+        "doc", "x", "viewer", "user", "eve", None, None, "on_tuesday"))])
     with pytest.raises(SchemaViolation):
         e.write_relationships([WriteOp("touch", Relationship(
             "doc", "x", "viewer", "user", "eve", None, None,
-            "on_tuesday"))])
+            "no_such_caveat"))])
+    with pytest.raises(SchemaViolation):
+        # "tz" is not a parameter of on_tuesday(day string)
+        e.write_relationships([WriteOp("touch", Relationship(
+            "doc", "y", "viewer", "user", "eve", None, None,
+            "on_tuesday", '{"tz":"utc"}'))])
 
 
-def test_caveat_context_with_nested_brackets_degrades_not_crashes():
+def test_caveat_context_with_nested_brackets_parses_and_loads():
     from spicedb_kubeapi_proxy_tpu.models.bootstrap import parse_bootstrap
 
-    # JSON-array context carries ']' inside the bracket: must still hit
-    # the warn-and-skip path, never a TupleError that aborts the boot
+    # JSON-array context carries ']' inside the bracket: the lenient
+    # context grammar must span it, and the context round-trips
     r = parse_relationship(
         'doc:1#viewer@user:a[ip_allowlist:{"ips":["10.0.0.0/8"]}]')
     assert r.caveat == "ip_allowlist"
+    assert r.context_dict() == {"ips": ["10.0.0.0/8"]}
     r2 = parse_relationship(
         'doc:1#viewer@user:a[c:{"x":[1]}]'
         '[expiration:2030-01-01T00:00:00Z]')
     assert r2.caveat == "c" and r2.expiration is not None
     b = parse_bootstrap("""
 schema: |-
-  caveat ip_allowlist(ips: string) { true }
+  caveat ip_allowlist(ip ipaddress, ips list<ipaddress>) { ip in ips }
   definition user {}
   definition doc {
-    relation viewer: user
+    relation viewer: user | user with ip_allowlist
     permission view = viewer
   }
 relationships: |-
   doc:1#viewer@user:ok
   doc:1#viewer@user:cond[ip_allowlist:{"ips":["10.0.0.0/8"]}]
 """)
-    assert [str(r) for r in b.relationships] == ["doc:1#viewer@user:ok"]
+    # conditional grants now LOAD with their contexts (enforced by the
+    # caveat VM at check time) instead of being excluded
+    assert [str(r) for r in b.relationships] == [
+        "doc:1#viewer@user:ok",
+        'doc:1#viewer@user:cond[ip_allowlist:{"ips":["10.0.0.0/8"]}]']
     # an UNDECLARED bracket trait is far more likely a typo (e.g.
     # [expiry:...] for [expiration:...]): refuse loudly rather than
     # silently dropping the grant as a phantom caveat

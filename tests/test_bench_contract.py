@@ -65,7 +65,28 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     # samples and real percentiles
     assert stages["device"]["n"] > 0
     assert stages["device"]["p50_ms"] is not None
+    _assert_caveat_schema(out["caveats"])
     _assert_macro_schema(out["macro"])
+
+
+def _assert_caveat_schema(cav: dict) -> None:
+    """The ISSUE 9 caveat-mix contract: caveated share, cold check p50
+    with/without request context vs the uncaveated baseline, warm
+    (decision-cached) p50s, the caveated/uncaveated ratio, and the
+    fail-closed missing-context denial count."""
+    assert cav["n_tuples"] >= 1
+    assert 0.0 < cav["caveated_share"] < 1.0
+    for k in ("check_p50_uncaveated_ms", "check_p50_caveated_ctx_ms",
+              "check_p50_caveated_noctx_ms", "warm_p50_caveated_ctx_ms",
+              "warm_p50_uncaveated_ms"):
+        v = cav[k]
+        assert isinstance(v, (int, float)) and v == v and v >= 0 \
+            and abs(v) != float("inf")
+    assert cav["caveated_over_uncaveated"] > 0
+    # fail-closed accounting: a whole caveated batch without context
+    # MUST register missing-context denials (the old behavior silently
+    # excluded the tuples instead)
+    assert cav["missing_context_denials"] >= 1
 
 
 def _assert_macro_schema(macro: dict) -> None:

@@ -1024,6 +1024,18 @@ def _measure(args, result: dict) -> None:
     except Exception as ex:  # noqa: BLE001 - aux measurement only
         log(f"caveat section failed (non-fatal): {ex}")
 
+    # -- scale-out shard scaling (ROADMAP item 4 / ISSUE 11): the same
+    # tuples behind 1 vs 2 vs 4 engine groups on loopback — single-shard
+    # check p50 (counter-verified no-scatter), scatter-lookup p50, mixed
+    # goodput. Runs at EVERY scale including --tiny (contract-pinned).
+    try:
+        _shard_phase(result, quick, args.tiny)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"shard section failed (non-fatal): {ex}")
+
     # -- open-loop trace-shaped macrobench (ROADMAP item 5) --
     # Runs at EVERY scale including --tiny: the macro result schema is
     # contract-test-pinned, and the sweep is the harness later
@@ -1725,6 +1737,227 @@ relationships: ""
         f"(ratio {ratio:.2f}x), warm ctx {warm_ctx:.3f}ms")
 
 
+_SHARD_SCHEMA = """
+use expiration
+
+definition user {}
+
+definition group {
+  relation member: user
+}
+
+definition namespace {
+  relation viewer: user | group#member
+  permission view = viewer
+}
+
+definition pod {
+  relation namespace: namespace
+  relation viewer: user
+  permission view = viewer + namespace->view
+}
+"""
+
+
+def _shard_phase(result: dict, quick: bool, tiny: bool) -> None:
+    """Scale-out scaling curve (ROADMAP item 4 / ISSUE 11): the SAME
+    tuple set served by 1 vs 2 vs 4 engine groups over loopback TCP
+    (one EngineServer per group, the scatter-gather planner in front).
+    Reported per group count: single-shard check p50 (must route with
+    NO scatter — per-shard op counters prove it), scatter-gathered
+    lookup p50, and closed-loop mixed goodput. In-process asyncio
+    servers: the phase measures planner + wire overhead and the scaling
+    shape, not process boot."""
+    import asyncio
+    import threading as _threading
+
+    from spicedb_kubeapi_proxy_tpu.engine import Engine
+    from spicedb_kubeapi_proxy_tpu.engine.engine import CheckItem
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        EngineServer,
+        RemoteEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.models import parse_schema
+    from spicedb_kubeapi_proxy_tpu.scaleout import (
+        ShardMap,
+        ShardedEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    if tiny:
+        n_ns, pods_per_ns, n_users = 12, 2, 8
+        n_checks, n_lookups, good_s = 24, 6, 0.8
+    elif quick:
+        n_ns, pods_per_ns, n_users = 48, 4, 24
+        n_checks, n_lookups, good_s = 80, 16, 1.5
+    else:
+        n_ns, pods_per_ns, n_users = 200, 8, 64
+        n_checks, n_lookups, good_s = 200, 40, 3.0
+
+    rng = np.random.default_rng(7)
+    # one canonical tuple set, partitioned per map below
+    ns_viewer = [(f"ns{i}", f"u{int(rng.integers(n_users))}")
+                 for i in range(n_ns)]
+    pod_rows = []
+    for i in range(n_ns):
+        for p in range(pods_per_ns):
+            pod_rows.append((f"ns{i}/p{p}",
+                             f"ns{i}",
+                             f"u{int(rng.integers(n_users))}"))
+    total_rels = len(ns_viewer) + 2 * len(pod_rows)
+
+    def cols_for(smap, gi):
+        cols = {k: [] for k in ("resource_type", "resource_id",
+                                "relation", "subject_type",
+                                "subject_id", "subject_relation")}
+
+        def add(rt, rid, rl, st, sid):
+            cols["resource_type"].append(rt)
+            cols["resource_id"].append(rid)
+            cols["relation"].append(rl)
+            cols["subject_type"].append(st)
+            cols["subject_id"].append(sid)
+            cols["subject_relation"].append("")
+
+        for ns, u in ns_viewer:  # global: replicated to every group
+            add("namespace", ns, "viewer", "user", u)
+        for pid, ns, u in pod_rows:
+            if smap.shard_of("pod", pid) == gi:
+                add("pod", pid, "namespace", "namespace", ns)
+                add("pod", pid, "viewer", "user", u)
+        return {k: np.asarray(v) for k, v in cols.items()}
+
+    loop = asyncio.new_event_loop()
+    loop_thread = _threading.Thread(target=loop.run_forever,
+                                    daemon=True)
+    loop_thread.start()
+
+    def run_in_loop(coro, timeout=60.0):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(
+            timeout)
+
+    def scatter_count():
+        tot = 0
+        for gi in range(4):
+            for op in ("check_bulk",):
+                tot += metrics.counter(
+                    "scaleout_ops_total", group=str(gi), op=op,
+                    mode="scatter").value
+        return tot
+
+    groups_out = {}
+    single_only = True
+
+    def run_points():
+        nonlocal single_only
+        for k in (1, 2, 4):
+            smap = ShardMap(version=1, groups=tuple(
+                (("127.0.0.1", 0),) for _ in range(k)))
+            servers, clients = [], []
+            planner = None
+            try:
+                for gi in range(k):
+                    eng = Engine(schema=parse_schema(_SHARD_SCHEMA))
+                    eng.bulk_load(cols_for(smap, gi))
+                    srv = EngineServer(eng)
+                    port = run_in_loop(srv.start())
+                    servers.append(srv)
+                    clients.append(RemoteEngine("127.0.0.1", port))
+                planner = ShardedEngine(smap, clients, journal=None)
+                # warm every jit shape (per group) outside the timed loops
+                planner.check(CheckItem("pod", "ns0/p0", "view",
+                                        "user", "u0"))
+                planner.lookup_resources("pod", "view", "user", "u0")
+
+                sc0 = scatter_count()
+                lat = []
+                for i in range(n_checks):
+                    pid, ns, u = pod_rows[i % len(pod_rows)]
+                    t0 = time.perf_counter()
+                    planner.check(CheckItem("pod", pid, "view", "user", u))
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                check_p50 = float(np.percentile(lat, 50))
+                no_scatter = scatter_count() == sc0
+                single_only = single_only and no_scatter
+
+                lat = []
+                for i in range(n_lookups):
+                    t0 = time.perf_counter()
+                    planner.lookup_resources("pod", "view", "user",
+                                             f"u{i % n_users}")
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                lookup_p50 = float(np.percentile(lat, 50))
+
+                # closed-loop mixed goodput: 8 threads, ~85% single-shard
+                # checks / 15% scatter lookups
+                done = [0] * 8
+                stop = _threading.Event()
+
+                def worker(wi):
+                    j = wi
+                    while not stop.is_set():
+                        if j % 7 == 0:
+                            planner.lookup_resources(
+                                "pod", "view", "user", f"u{j % n_users}")
+                        else:
+                            pid, ns, u = pod_rows[j % len(pod_rows)]
+                            planner.check(CheckItem("pod", pid, "view",
+                                                    "user", u))
+                        done[wi] += 1
+                        j += 8
+
+                threads = [_threading.Thread(target=worker, args=(wi,),
+                                             daemon=True)
+                           for wi in range(8)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                stop.wait(good_s)
+                stop.set()
+                for t in threads:
+                    t.join(10)
+                span = time.perf_counter() - t0
+                goodput = sum(done) / max(span, 1e-9)
+                groups_out[str(k)] = {
+                    "check_p50_ms": round(check_p50, 3),
+                    "scatter_lookup_p50_ms": round(lookup_p50, 3),
+                    "goodput_ops_s": round(goodput, 1),
+                    "single_shard_no_scatter": bool(no_scatter),
+                }
+                log(f"shard {k}g: check p50 {check_p50:.2f}ms "
+                    f"(no_scatter={no_scatter}), scatter lookup p50 "
+                    f"{lookup_p50:.2f}ms, goodput {goodput:.0f} op/s")
+            finally:
+                # close the planner (scatter pool + client sockets) and
+                # stop the servers even when a measurement throws — a
+                # leaked loop thread would keep spinning under every later
+                # phase's latency numbers
+                if planner is not None:
+                    try:
+                        planner.close()
+                    except Exception:  # noqa: BLE001 - teardown best effort
+                        pass
+                for srv in servers:
+                    try:
+                        run_in_loop(srv.stop(), timeout=15.0)
+                    except Exception:  # noqa: BLE001 - teardown best effort
+                        pass
+    try:
+        run_points()
+    finally:
+        # the loop thread must die even when a point raises — a leaked
+        # daemon loop would keep spinning under every later phase's
+        # latency numbers
+        loop.call_soon_threadsafe(loop.stop)
+        loop_thread.join(10)
+    result["shard"] = {
+        "n_ns": n_ns,
+        "n_rels": total_rels,
+        "single_shard_no_scatter": bool(single_only),
+        "groups": groups_out,
+    }
+
+
 def _macro_phase(result: dict, quick: bool, tiny: bool,
                  result_key: str = "macro",
                  n_ns_override: Optional[int] = None) -> None:
@@ -1846,11 +2079,11 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
 
     # -- op table (the mixed workload) ---------------------------------------
     def op_check(a):
-        e.check_bulk([CheckItem("namespace", f"ns{a.key % n_ns}", "view",
+        e.check_bulk([CheckItem("namespace", f"ns{a.ns_key % n_ns}", "view",
                                 "user", f"u{a.key % n_users}")])
 
     def op_bulk(a):
-        e.check_bulk([CheckItem("namespace", f"ns{(a.key + j) % n_ns}",
+        e.check_bulk([CheckItem("namespace", f"ns{(a.ns_key + j) % n_ns}",
                                 "view", "user", f"u{a.key % n_users}")
                       for j in range(32)])
 
@@ -1868,7 +2101,7 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
         assert status == 200
 
     def op_lookup_subjects(a):
-        e.lookup_subjects("namespace", f"ns{a.key % n_ns}", "view",
+        e.lookup_subjects("namespace", f"ns{a.ns_key % n_ns}", "view",
                           "user")
 
     def op_wildcard(a):
@@ -1881,7 +2114,7 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
 
     def op_write(a):
         e.write_relationships([WriteOp("touch", Relationship(
-            "namespace", f"ns{a.key % n_ns}", "viewer",
+            "namespace", f"ns{a.ns_key % n_ns}", "viewer",
             "user", f"u{(a.key * 7) % n_users}"))])
 
     # the watch harness is ROTATED per sweep point (make_config below):
@@ -1897,7 +2130,7 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
 
     for op in (op_check, op_bulk, op_list, op_table, op_lookup_subjects,
                op_wildcard, op_write):
-        op(type("A", (), {"key": 0})())  # warm every jit shape
+        op(type("A", (), {"key": 0, "ns_key": 0})())  # warm every jit shape
     ops_raw = {
         OP_CHECK: op_check, OP_BULK_CHECK: op_bulk,
         OP_LIST_PREFILTER: op_list, OP_TABLE: op_table,

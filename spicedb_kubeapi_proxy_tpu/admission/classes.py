@@ -32,6 +32,16 @@ class CostClass:
     def __str__(self) -> str:
         return self.name
 
+    def scaled(self, units: int) -> "CostClass":
+        """This class charged ``units`` times — the scale-out rule: a
+        scatter op is charged once per touched shard (a 4-group
+        LookupResources occupies 4x one group's lookup budget), while
+        the NAME (and so the shed/metric label) stays the class's own.
+        ``units <= 1`` returns self unchanged."""
+        if units <= 1:
+            return self
+        return CostClass(self.name, self.weight * units, self.priority)
+
 
 CHECK = CostClass("check", 1.0, 2)
 BULK_CHECK = CostClass("bulk-check", 2.0, 2)

@@ -311,6 +311,16 @@ async def _authorize_inner(req: ProxyRequest,
     with tracer.span("admission_wait") as sp:
         cls = classify_request(info.verb, rules)
         sp.set("class", cls.name)
+        # scale-out (scaleout/planner.py): a scatter op touches every
+        # shard group, so it is charged once per touched shard — the
+        # planner reports the fanout, single-engine deployments have no
+        # admission_fanout and stay at 1x
+        fanout_of = getattr(deps.engine, "admission_fanout", None)
+        if fanout_of is not None:
+            fanout = fanout_of(cls)
+            if fanout > 1:
+                sp.set("shards", fanout)
+                cls = cls.scaled(fanout)
         ticket = await deps.admission.acquire_async(
             user.name or "system:anonymous", cls)
     try:

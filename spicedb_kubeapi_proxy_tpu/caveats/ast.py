@@ -374,6 +374,64 @@ def parse_cidr_range(v) -> tuple[float, float]:
         raise CaveatError(f"invalid IPv4/CIDR {v!r}: {e}") from None
 
 
+# -- the 128-bit mapped address space (IPv6 support, ROADMAP PR-9
+# -- follow-on): every address — both families — lives in ONE ordered
+# -- integer space, the IPv6 space with IPv4 mapped at ::ffff:a.b.c.d.
+# -- The VM cannot hold 2^128 on its split planes, so a mapped value is
+# -- carried as FOUR 32-bit words (each exact on the planes) and
+# -- comparisons lower to word-wise lexicographic checks (compile.py).
+
+_V4_MAPPED_BASE = 0xFFFF00000000  # ::ffff:0:0 as an integer
+
+
+def parse_ip_mapped(v) -> int:
+    """Any IP address (either family) -> its 128-bit mapped integer.
+    IPv4 addresses land in the ``::ffff:a.b.c.d`` block so the two
+    families order consistently and a bare IPv4 equals its mapped form.
+    """
+    t = str(v).strip()
+    try:
+        a = ipaddress.ip_address(t)
+    except ValueError as e:
+        raise CaveatError(f"invalid IP address {v!r}: {e}") from None
+    if isinstance(a, ipaddress.IPv4Address):
+        return _V4_MAPPED_BASE + int(a)
+    return int(a)
+
+
+def parse_cidr_range_mapped(v) -> tuple[int, int]:
+    """Any address or CIDR (either family) -> inclusive [lo, hi] in the
+    128-bit mapped space. An IPv4 CIDR covers exactly its mapped block,
+    so a v6 request address can never fall inside a v4 allowlist."""
+    t = str(v).strip()
+    try:
+        if "/" in t:
+            net = ipaddress.ip_network(t, strict=False)
+            lo, hi = (int(net.network_address),
+                      int(net.broadcast_address))
+            if isinstance(net, ipaddress.IPv4Network):
+                lo, hi = _V4_MAPPED_BASE + lo, _V4_MAPPED_BASE + hi
+            return lo, hi
+        x = parse_ip_mapped(t)
+        return x, x
+    except CaveatError:
+        raise
+    except ValueError as e:
+        raise CaveatError(f"invalid IP/CIDR {v!r}: {e}") from None
+
+
+def ip_words(x: int) -> tuple[int, int, int, int]:
+    """A mapped 128-bit address as four big-endian 32-bit words — each
+    word exact on the VM's two f32 planes, lexicographic word order ==
+    numeric order of the whole address."""
+    return ((x >> 96) & 0xFFFFFFFF, (x >> 64) & 0xFFFFFFFF,
+            (x >> 32) & 0xFFFFFFFF, x & 0xFFFFFFFF)
+
+
+def is_v4_mapped(x: int) -> bool:
+    return _V4_MAPPED_BASE <= x < _V4_MAPPED_BASE + (1 << 32)
+
+
 class StringInterner:
     """Host-side string<->code table for caveat string values. Request
     strings never seen in any tuple context or literal get DISTINCT
@@ -459,20 +517,57 @@ def encode_scalar(value, typ: str, interner: StringInterner,
     if typ == "duration":
         return parse_duration(value)
     if typ == "ipaddress":
-        return parse_ip(value)
+        # the 128-bit MAPPED integer (both families; exact — Python
+        # int, compared exactly against int/float by the oracle). The
+        # VM never takes this path: ipaddress scalars lower to four
+        # 32-bit word columns there (vm.py).
+        return parse_ip_mapped(value)
     raise CaveatError(f"unsupported scalar type {typ!r}")
+
+
+class UnencodableListError(CaveatError):
+    """A WELL-TYPED list context the VM's per-instance range tables
+    cannot hold (an IPv6 element in ``list<ipaddress>`` — the split
+    planes cap at 2^40). The whole list resolves UNKNOWN — missing
+    context, fail closed under BOTH polarities. Dropping the element
+    instead would narrow the list to a KNOWN answer, which a negated
+    membership (``!(ip in blocked)``) would flip into a grant."""
 
 
 def encode_list(value, elem: str, interner: StringInterner,
                 strict: bool = True) -> list[tuple[float, float]]:
     """A context list -> per-element inclusive [lo, hi] ranges (CIDR
-    elements span a range; every other element is a point)."""
+    elements span a range; every other element is a point).
+
+    ``ipaddress`` elements encode in the LEGACY uint32 space — the form
+    the VM's per-instance list tables hold (the split planes are exact
+    to 2^40; a 128-bit mapped value is not). A list containing any
+    IPv6 element is therefore UNENCODABLE: it raises
+    :class:`UnencodableListError` (counted,
+    ``engine_caveat_ipv6_unencodable_total``) and the parameter stays
+    UNKNOWN — fail closed whichever way the expression uses it. Scalar
+    IPv6 values and LITERAL IPv6 CIDR lists stay exact via the 4-word
+    lowering (compile.py)."""
     if not isinstance(value, (list, tuple)):
         raise CaveatError(f"expected list, got {value!r}")
     out: list[tuple[float, float]] = []
     for item in value:
         if elem == "ipaddress":
-            out.append(parse_cidr_range(item))
+            try:
+                out.append(parse_cidr_range(item))
+            except CaveatError:
+                # valid IPv6? -> the whole list is unencodable (see
+                # class docstring). Anything else is malformed: keep
+                # the original strict/lenient behavior.
+                parse_cidr_range_mapped(item)  # raises if malformed
+                from ..utils.metrics import metrics
+
+                metrics.counter(
+                    "engine_caveat_ipv6_unencodable_total").inc()
+                raise UnencodableListError(
+                    f"IPv6 element {item!r} in a list<ipaddress> "
+                    "context (use literal lists for IPv6 CIDRs)"
+                ) from None
         else:
             x = encode_scalar(item, elem, interner, strict)
             out.append((x, x))
@@ -508,7 +603,11 @@ def interpret(expr: CavExpr, ctx: dict, params: dict,
         if t is None:
             raise CaveatError(f"unknown caveat parameter {name!r}")
         if t.is_list:
-            return encode_list(ctx[name], t.elem, interner, strict=False)
+            try:
+                return encode_list(ctx[name], t.elem, interner,
+                                   strict=False)
+            except UnencodableListError:
+                return UNKNOWN  # mirrors the VM's unknown list column
         return encode_scalar(ctx[name], t.name, interner, strict=False)
 
     def ev(e: CavExpr):
@@ -568,13 +667,17 @@ def interpret(expr: CavExpr, ctx: dict, params: dict,
                 return "double"
 
             left = ev(e.left)
-            if isinstance(e.right, Lit) and e.right.type == "list":
-                lt = scalar_type(e.left)
+            lt = scalar_type(e.left)
+            literal_list = isinstance(e.right, Lit) \
+                and e.right.type == "list"
+            if literal_list:
                 right = []
                 for item in e.right.value:
                     if isinstance(item, str):
                         if lt == "ipaddress":
-                            right.append(parse_cidr_range(item))
+                            # full mapped 128-bit range: literal CIDR
+                            # allowlists stay exact for BOTH families
+                            right.append(parse_cidr_range_mapped(item))
                         else:
                             x = float(interner.lookup(item))
                             right.append((x, x))
@@ -583,9 +686,22 @@ def interpret(expr: CavExpr, ctx: dict, params: dict,
             else:
                 right = ev(e.right)
             if left is UNKNOWN or right is UNKNOWN:
+                # an UNKNOWN list stays unknown even for a v6 operand:
+                # the encoded tables provably hold no v6 elements, but
+                # an unencodable (v6-bearing) list might have — a
+                # known miss here would fail OPEN under negation
                 return UNKNOWN
             if not isinstance(right, list):
                 raise CaveatError("'in' needs a list right-hand side")
+            if lt == "ipaddress" and not literal_list:
+                # param lists hold the legacy uint32 (v4) ranges: a
+                # KNOWN list misses any non-v4-mapped operand (it
+                # cannot contain v6 elements), a v4-mapped one compares
+                # in the uint32 space — the VM's sentinel lowering
+                if not is_v4_mapped(int(_num(left))):
+                    return False
+                x = int(_num(left)) - _V4_MAPPED_BASE
+                return any(lo <= x <= hi for lo, hi in right)
             x = _num(left)
             return any(lo <= x <= hi for lo, hi in right)
         left, right = ev(e.left), ev(e.right)
@@ -630,9 +746,13 @@ def _truthy(v) -> bool:
     return v != 0.0
 
 
-def _num(v) -> float:
+def _num(v):
     if isinstance(v, bool):
         return 1.0 if v else 0.0
     if isinstance(v, list):
         raise CaveatError("a list may only appear on the right of 'in'")
+    if isinstance(v, int):
+        # mapped 128-bit addresses: Python ints compare exactly against
+        # ints AND floats — float() would truncate past 2^53
+        return v
     return float(v)

@@ -34,7 +34,9 @@ from .ast import (
     StringInterner,
     Un,
     Var,
+    ip_words,
     parse_cidr_range,
+    parse_cidr_range_mapped,
 )
 
 # -- opcodes (shared with vm.py; order is the lax.switch branch table) ------
@@ -87,16 +89,21 @@ class CaveatProgram:
     n_regs: int
     out_reg: int
     lists: tuple  # tuple[ListSpec, ...]
-    # scalar-param name -> ctx column; list-param name -> list id
+    # scalar-param name -> BASE ctx column; list-param name -> list id
     scalar_col: dict = field(default_factory=dict)
     list_id: dict = field(default_factory=dict)
+    # scalar-param name -> how many consecutive columns it occupies:
+    # 1 for everything except ipaddress, which rides FOUR 32-bit word
+    # columns (the 128-bit mapped space cannot live on the 2^40-exact
+    # split planes; word-wise lexicographic checks can — IPv6 support)
+    scalar_width: dict = field(default_factory=dict)
     uses_now: bool = False  # references the auto-injected `now` param
     time_arith: bool = False  # arithmetic over timestamps: verdict flip
     #                           times are not enumerable from contexts
 
     @property
     def n_scalars(self) -> int:
-        return len(self.scalar_col)
+        return sum(self.scalar_width.get(n, 1) for n in self.scalar_col)
 
     def signature(self) -> tuple:
         """Static shape key: everything the traced VM bakes in."""
@@ -186,12 +193,17 @@ def compile_caveat(defn: CaveatDef,
     expr = _fold(defn.expr, defn)
 
     scalar_col: dict = {}
+    scalar_width: dict = {}
     list_ids: dict = {}
     lists: list[ListSpec] = []
+    next_col = 0
     for p in defn.params:
         if p.type.is_list:
             continue
-        scalar_col[p.name] = len(scalar_col)
+        scalar_col[p.name] = next_col
+        w = 4 if p.type.name == "ipaddress" else 1
+        scalar_width[p.name] = w
+        next_col += w
     param_index = {p.name: i for i, p in enumerate(defn.params)}
 
     ops: list[tuple[int, int, int, int]] = []
@@ -264,6 +276,55 @@ def compile_caveat(defn: CaveatDef,
                 raise CaveatError(
                     f"caveat {defn.name!r}: strings support only "
                     "==/!= (interned codes are unordered)")
+        if ("ipaddress" in (a, b)) and a != b:
+            # wide (4-word) values order only against each other; a
+            # cross-type compare against a plain number would compare
+            # one word against the whole address — reject loudly
+            raise CaveatError(
+                f"caveat {defn.name!r}: {op!r} between ipaddress and "
+                f"{b if a == 'ipaddress' else a}")
+
+    # -- wide (4-word) ipaddress lowering: a mapped 128-bit address is
+    # -- four 32-bit word registers; compares expand lexicographically
+    # -- over existing opcodes (Kleene unknowns flow through AND/OR)
+
+    def lower_ip(e: CavExpr) -> tuple:
+        if isinstance(e, Var):
+            p = defn.param(e.name)
+            if p is not None and not p.type.is_list \
+                    and p.type.name == "ipaddress":
+                base = scalar_col[e.name]
+                return tuple(emit(OP_LOAD, a=base + k)
+                             for k in range(4))
+        raise CaveatError(
+            f"caveat {defn.name!r}: expected an ipaddress parameter")
+
+    def const_words(x: int) -> tuple:
+        return tuple(emit(OP_CONST, im=float(w)) for w in ip_words(x))
+
+    def wide_and(regs: list) -> int:
+        acc = regs[0]
+        for r in regs[1:]:
+            acc = emit(OP_AND, a=acc, b=r)
+        return acc
+
+    def wide_cmp(aw: tuple, bw: tuple, op: str) -> int:
+        eqs = [emit(OP_EQ, a=aw[k], b=bw[k]) for k in range(4)]
+        if op == "==":
+            return wide_and(eqs)
+        if op == "!=":
+            return emit(OP_NOT, a=wide_and(eqs))
+        strict = OP_LT if op in ("<", "<=") else OP_GT
+        acc = emit(_CMP_OPS[op], a=aw[3], b=bw[3])
+        for k in (2, 1, 0):
+            s = emit(strict, a=aw[k], b=bw[k])
+            acc = emit(OP_OR, a=s, b=emit(OP_AND, a=eqs[k], b=acc))
+        return acc
+
+    def wide_range_hit(aw: tuple, lo: int, hi: int) -> int:
+        ge = wide_cmp(aw, const_words(lo), ">=")
+        le = wide_cmp(aw, const_words(hi), "<=")
+        return emit(OP_AND, a=ge, b=le)
 
     def lower(e: CavExpr) -> int:
         nonlocal uses_now, time_arith
@@ -286,6 +347,14 @@ def compile_caveat(defn: CaveatDef,
                 raise CaveatError(
                     f"caveat {defn.name!r}: list parameter {e.name!r} "
                     "may only appear on the right of 'in'")
+            if p.type.name == "ipaddress":
+                # wide values have no single-register form: they exist
+                # only inside compares and 'in' (handled above by the
+                # Bin branches) — a bare/boolean use is meaningless
+                raise CaveatError(
+                    f"caveat {defn.name!r}: ipaddress parameter "
+                    f"{e.name!r} may only be compared or tested "
+                    "with 'in'")
             if e.name == "now" and p.type.name == "timestamp":
                 uses_now = True
             return emit(OP_LOAD, a=scalar_col[e.name])
@@ -302,8 +371,60 @@ def compile_caveat(defn: CaveatDef,
                 raise CaveatError(
                     f"caveat {defn.name!r}: the left of 'in' must be "
                     "a scalar")
+            if lt == "ipaddress":
+                aw = lower_ip(e.left)
+                if isinstance(e.right, Lit) and e.right.type == "list":
+                    # literal CIDR allowlist: inline word-wise range
+                    # checks in the full mapped space — exact for BOTH
+                    # families (never the uint32 list table)
+                    hits = []
+                    for item in e.right.value:
+                        if not isinstance(item, str):
+                            raise CaveatError(
+                                f"caveat {defn.name!r}: ipaddress list "
+                                f"elements must be address/CIDR "
+                                f"strings, got {item!r}")
+                        lo, hi = parse_cidr_range_mapped(item)
+                        hits.append(wide_range_hit(aw, lo, hi))
+                    if not hits:
+                        return emit(OP_CONST, im=0.0)
+                    acc = hits[0]
+                    for h in hits[1:]:
+                        acc = emit(OP_OR, a=acc, b=h)
+                    return acc
+                lid = list_of(e.right, lt)
+                spec = lists[lid]
+                if spec.elem != "ipaddress":
+                    raise CaveatError(
+                        f"caveat {defn.name!r}: ipaddress 'in' "
+                        f"list<{spec.elem}> mismatch")
+                # per-instance lists hold the legacy uint32 (v4) ranges
+                # (the split planes cap at 2^40; a list with any v6
+                # element stays UNKNOWN — encode_list). A non-v4-mapped
+                # operand selects an OUT-OF-RANGE sentinel (2^33 +
+                # low word, above every uint32 range) instead of its
+                # low word, so OP_IN itself answers: a KNOWN list
+                # yields a genuine miss (it provably holds no v6
+                # elements), an UNKNOWN list stays UNKNOWN — an outer
+                # `is4 && hit` would Kleene-collapse that to a KNOWN
+                # False, which `!(ip in blocked)` flips into a grant
+                z = emit(OP_CONST, im=0.0)
+                ff = emit(OP_CONST, im=65535.0)
+                is4 = wide_and([
+                    emit(OP_EQ, a=aw[0], b=z),
+                    emit(OP_EQ, a=aw[1], b=z),
+                    emit(OP_EQ, a=aw[2], b=ff)])
+                not4 = emit(OP_NOT, a=is4)
+                big = emit(OP_CONST, im=float(1 << 33))
+                off = emit(OP_MUL, a=not4, b=big)  # 0 or 2^33: exact
+                sel = emit(OP_ADD, a=aw[3], b=off)
+                return emit(OP_IN, a=sel, b=lid)
             lid = list_of(e.right, lt)
             spec = lists[lid]
+            if spec.elem == "ipaddress":
+                raise CaveatError(
+                    f"caveat {defn.name!r}: {lt} 'in' "
+                    "list<ipaddress> mismatch")
             if spec.elem != lt and not (
                     spec.elem in _NUMERIC and lt in _NUMERIC):
                 raise CaveatError(
@@ -313,6 +434,9 @@ def compile_caveat(defn: CaveatDef,
         lt, rt = _typeof(e.left, defn), _typeof(e.right, defn)
         if e.op in _CMP_OPS:
             check_comparable(lt, rt, e.op)
+            if lt == rt == "ipaddress":
+                return wide_cmp(lower_ip(e.left), lower_ip(e.right),
+                                e.op)
             return emit(_CMP_OPS[e.op], a=lower(e.left),
                         b=lower(e.right))
         if e.op in ARITH_OPS:
@@ -320,6 +444,11 @@ def compile_caveat(defn: CaveatDef,
             if lt == "string" or rt == "string":
                 raise CaveatError(
                     f"caveat {defn.name!r}: arithmetic over strings")
+            if "ipaddress" in (lt, rt):
+                raise CaveatError(
+                    f"caveat {defn.name!r}: arithmetic over IP "
+                    "addresses is meaningless (wide values only "
+                    "compare)")
             if "timestamp" in (lt, rt):
                 # verdict flip instants are no longer enumerable from
                 # the stored contexts; the engine must not cache
@@ -345,6 +474,7 @@ def compile_caveat(defn: CaveatDef,
         out_reg=out,
         lists=tuple(lists),
         scalar_col=scalar_col,
+        scalar_width=scalar_width,
         list_id={k[1]: v for k, v in list_ids.items()
                  if k[0] == "param"},
         uses_now=uses_now,

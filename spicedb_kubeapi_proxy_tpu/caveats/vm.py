@@ -46,8 +46,11 @@ import jax.numpy as jnp
 from .ast import (
     CaveatError,
     StringInterner,
+    UnencodableListError,
     encode_list,
     encode_scalar,
+    ip_words,
+    parse_ip_mapped,
 )
 from .compile import (
     CaveatProgram,
@@ -192,8 +195,11 @@ def _encode_instance_cols(meta: CavMeta, prog: CaveatProgram,
             lid = prog.list_id.get(p.name)
             if lid is None:
                 continue  # declared but unused in the expression
-            ranges = encode_list(ctx[p.name], p.type.elem, interner,
-                                 strict=True)
+            try:
+                ranges = encode_list(ctx[p.name], p.type.elem, interner,
+                                     strict=True)
+            except UnencodableListError:
+                continue  # list stays UNKNOWN: fail closed either way
             if len(ranges) > meta.K:
                 raise CaveatError(
                     f"caveat {meta.name!r}: list {p.name!r} exceeds "
@@ -205,6 +211,14 @@ def _encode_instance_cols(meta: CavMeta, prog: CaveatProgram,
         else:
             col = prog.scalar_col.get(p.name)
             if col is None:
+                continue
+            if p.type.name == "ipaddress":
+                # wide value: four 32-bit words across consecutive
+                # columns — exact for BOTH families (IPv6 support)
+                for k, w in enumerate(
+                        ip_words(parse_ip_mapped(ctx[p.name]))):
+                    sce[col + k], scv[col + k] = split_planes(float(w))
+                    sck[col + k] = True
                 continue
             x = encode_scalar(ctx[p.name], p.type.name, interner,
                               strict=True)
@@ -337,6 +351,19 @@ class CompiledCaveats:
                     continue
                 col = prog.scalar_col.get(p.name)
                 if col is None:
+                    continue
+                if p.type.name == "ipaddress":
+                    if p.name not in context:
+                        continue
+                    try:
+                        words = ip_words(
+                            parse_ip_mapped(context[p.name]))
+                    except CaveatError:
+                        continue  # malformed -> UNKNOWN (fails closed)
+                    for k, w in enumerate(words):
+                        rce[col + k], rcv[col + k] = split_planes(
+                            float(w))
+                        rck[col + k] = True
                     continue
                 if p.name in context:
                     try:

@@ -288,6 +288,7 @@ class Engine:
         from ..caveats.ast import (
             CaveatError,
             StringInterner,
+            UnencodableListError,
             encode_list,
             encode_scalar,
         )
@@ -314,6 +315,11 @@ class Engine:
                     encode_list(v, p.type.elem, scratch)
                 else:
                     encode_scalar(v, p.type.name, scratch)
+            except UnencodableListError:
+                # well-typed but beyond the VM's list tables (an IPv6
+                # element): the write is accepted — the parameter
+                # resolves UNKNOWN at evaluation (fail closed, counted)
+                pass
             except CaveatError as e:
                 raise SchemaViolation(
                     f"caveat {rel.caveat!r} context {k!r}: {e}") from None

@@ -64,6 +64,14 @@ class Arrival:
     key: int  # op-local variety selector (which resource/subject)
     phase: str  # "baseline" or the burst phase's name
     burst: bool
+    # shard-aware namespace selector: each tenant owns a SMALL cluster
+    # of namespaces (``ns_per_tenant`` of them), so the Zipf tenant skew
+    # translates into namespace — and therefore SHARD — skew: the
+    # macrobench's hot tenant hammers a hot shard instead of uniformly
+    # spreading its storm across the keyspace. Derived from the tenant
+    # rank and ``key`` (no extra RNG draws: identical seeds still
+    # produce identical schedules).
+    ns_key: int = 0
 
 
 @dataclass(frozen=True)
@@ -87,6 +95,11 @@ class ScheduleConfig:
     mix: dict = field(default_factory=lambda: dict(DEFAULT_MIX))
     bursts: tuple = ()
     key_space: int = 1 << 16  # op-local key variety
+    # namespaces per tenant: the tenant -> namespace mapping honored by
+    # the Zipf skew (Arrival.ns_key). Small on purpose — a hot tenant
+    # should concentrate on a few namespaces (one or two shards), which
+    # is the hot-shard shape per-shard admission exists to survive
+    ns_per_tenant: int = 4
 
 
 def trace_shaped_config(duration: float, rate: float, tenants: int = 8,
@@ -175,10 +188,12 @@ def build_schedule(cfg: ScheduleConfig) -> list[Arrival]:
         op_idx = rng.choice(len(ops), size=n, p=p)
         tn_idx = rng.choice(cfg.tenants, size=n, p=tenant_p)
         keys = rng.integers(0, cfg.key_space, size=n)
+        npt = max(1, cfg.ns_per_tenant)
         out.extend(
             Arrival(float(ts[i]), ops[int(op_idx[i])],
                     tenant_names[int(tn_idx[i])], int(keys[i]),
-                    phase, burst)
+                    phase, burst,
+                    int(tn_idx[i]) * npt + int(keys[i]) % npt)
             for i in range(n))
     out.sort(key=lambda a: a.t)
     return out

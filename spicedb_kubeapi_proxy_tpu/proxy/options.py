@@ -201,6 +201,28 @@ class Options:
     # caveats fail closed (tuple-context-only caveats still evaluate)
     caveat_context: bool = True
     caveat_ip_header: str = "x-forwarded-for"
+    # -- scale-out sharding (scaleout/) --------------------------------------
+    # explicit versioned shard map: inline JSON or a path to a JSON file
+    # ({"version": 1, "groups": [["h:p", "h:p"], ["h:p"]]}). When set,
+    # the proxy builds a scatter-gather planner over the named engine
+    # groups (each group an endpoint list = its own failover set) and
+    # --engine-endpoint must stay at its in-process default (the planner
+    # IS the engine client). Tuples partition by (namespace, resource-
+    # type) consistent hashing; global (cluster-scoped) tuples replicate
+    # to every group. docs/operations.md "Scale-out sharding".
+    shard_map: Optional[str] = None
+    # durable cross-shard split-write journal (dtx-style); None lands it
+    # beside the workflow DB. A mid-split crash replays to completion on
+    # the next boot.
+    shard_journal_path: Optional[str] = None
+    # vector-keyed client-side decision cache: entries key by the full
+    # per-shard revision vector, never serve after ANY component
+    # advances, and are TTL-bounded (the planner cannot see the
+    # engine-side expiration/caveat verdict-flip watermarks). Off by
+    # default — it only helps when every write flows through THIS
+    # proxy replica (the per-group host-side caches stay exact
+    # regardless).
+    shard_cache: bool = False
     # >0 probes the device backend in a SUBPROCESS with this timeout
     # before building an in-process engine: the remotely-attached TPU
     # plugin HANGS (not errors) when its tunnel is down, which would
@@ -311,6 +333,29 @@ class Options:
 
     def validate(self) -> None:
         remote = self._parse_remote()
+        if self.shard_map:
+            if remote is not None:
+                raise OptionsError(
+                    "shard-map and a tcp:// engine-endpoint are mutually "
+                    "exclusive: the shard map names every group's "
+                    "endpoints itself")
+            for bad, why in (
+                    (self.bootstrap_files or self.bootstrap_content,
+                     "bootstrap"),
+                    (self.snapshot_path, "snapshot-path"),
+                    (self.data_dir, "data-dir"),
+                    (self.lookup_batch_window > 0, "lookup-batch-window"),
+                    (self.engine_mesh, "engine-mesh")):
+                if bad:
+                    raise OptionsError(
+                        f"{why} applies to in-process engines; with "
+                        "--shard-map each engine group owns its own")
+            from ..scaleout import ShardMapError, load_shard_map
+
+            try:
+                load_shard_map(self.shard_map)
+            except ShardMapError as e:
+                raise OptionsError(str(e)) from None
         if remote is None and self.engine_endpoint not in (EMBEDDED_ENDPOINT,
                                                            TPU_ENDPOINT):
             raise OptionsError(
@@ -354,13 +399,14 @@ class Options:
             raise OptionsError(
                 "engine-mesh applies to in-process engines; configure the "
                 "mesh on the tcp:// engine host instead")
-        if remote is None and (
+        if remote is None and not self.shard_map and (
                 self.engine_insecure or self.engine_ca_file or
                 self.engine_skip_verify_ca or self.engine_client_cert_file
                 or self.engine_server_name):
             raise OptionsError(
                 "engine-insecure/ca-file/skip-verify-ca/client-cert/"
-                "server-name apply only to tcp:// engine endpoints")
+                "server-name apply only to tcp:// engine endpoints "
+                "(or shard-map groups)")
         if self.engine_insecure and (
                 self.engine_ca_file or self.engine_skip_verify_ca or
                 self.engine_client_cert_file or self.engine_server_name):
@@ -527,7 +573,7 @@ class Options:
             + ([self.rule_content] if self.rule_content else []))
         matcher = MapMatcher.from_yaml(rule_text)
         remote = self._parse_remote()
-        if remote is not None:
+        if remote is not None or self.shard_map:
             from ..engine.remote import FailoverEngine, RemoteEngine
 
             ssl_context = None
@@ -552,7 +598,50 @@ class Options:
                 retries=self.engine_retries,
                 breaker_failure_threshold=self.breaker_failure_threshold,
                 breaker_reset_seconds=self.breaker_reset_seconds)
-            if len(remote) == 1:
+            if self.shard_map:
+                # scale-out (scaleout/): one client per engine GROUP
+                # (multi-endpoint groups get client-side leader
+                # failover), a scatter-gather planner in front, and a
+                # durable split-write journal beside the workflow DB
+                from ..scaleout import (
+                    ShardedEngine,
+                    ShardMapError,
+                    ShardVectorCache,
+                    SplitJournal,
+                    load_shard_map,
+                )
+
+                try:
+                    # validate() parsed this already, but the file can
+                    # change between the two reads — the second load
+                    # must fail as cleanly as the first
+                    smap = load_shard_map(self.shard_map)
+                except ShardMapError as e:
+                    raise OptionsError(str(e)) from None
+                groups = []
+                for eps in smap.groups:
+                    if len(eps) == 1:
+                        groups.append(RemoteEngine(
+                            *eps[0], token=self.engine_token,
+                            **client_kw))
+                    else:
+                        groups.append(FailoverEngine(
+                            list(eps), token=self.engine_token,
+                            **client_kw))
+                journal_path = self.shard_journal_path
+                if journal_path is None:
+                    import os as _osj
+
+                    base = self.workflow_database_path \
+                        or DEFAULT_WORKFLOW_DB
+                    journal_path = _osj.path.join(
+                        _osj.path.dirname(_osj.path.abspath(base)),
+                        "scaleout-journal.sqlite")
+                engine = ShardedEngine(
+                    smap, groups, journal=SplitJournal(journal_path),
+                    cache=(ShardVectorCache() if self.shard_cache
+                           else None))
+            elif len(remote) == 1:
                 engine = RemoteEngine(*remote[0],
                                       token=self.engine_token,
                                       **client_kw)
@@ -663,10 +752,15 @@ class Options:
                 ttl=self.discovery_cache_ttl,
                 cache_dir=self.discovery_cache_dir)
         # breakers surface on /readyz with per-dependency reasons; an
-        # injected upstream/engine without one simply isn't tracked
+        # injected upstream/engine without one simply isn't tracked.
+        # A sharded planner contributes one breaker PER GROUP (its own
+        # clients'), so /readyz names the degraded group
+        engine_breakers = [getattr(engine, "breaker", None)]
+        for g in getattr(engine, "groups", ()):
+            engine_breakers.append(getattr(g, "breaker", None))
         dep_breakers = tuple(
-            b for b in (getattr(upstream, "breaker", None),
-                        getattr(engine, "breaker", None)) if b is not None)
+            b for b in ([getattr(upstream, "breaker", None)]
+                        + engine_breakers) if b is not None)
         admission = None
         if self.admission:
             from ..admission import AdmissionController
@@ -790,6 +884,7 @@ class Options:
         "authz_cache", "authz_cache_size", "authz_cache_mask_bytes",
         "delta_capacity", "compact_threshold",
         "caveat_context", "caveat_ip_header",
+        "shard_map", "shard_journal_path", "shard_cache",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
@@ -996,6 +1091,35 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "read on a synchronous recompile (0 "
                              "disables compaction and restores the "
                              "synchronous fallback)")
+    parser.add_argument("--shard-map",
+                        help="scale-out: explicit versioned shard map "
+                             "(JSON file path or inline JSON: "
+                             '{"version":1,"groups":[["h:p","h:p"],'
+                             '["h:p"]]}). Each group is its own engine '
+                             "failover set; tuples partition by "
+                             "(namespace, resource-type) consistent "
+                             "hashing, cluster-scoped tuples replicate "
+                             "to every group. Mutually exclusive with a "
+                             "tcp:// --engine-endpoint (see "
+                             "docs/operations.md 'Scale-out sharding')")
+    parser.add_argument("--shard-journal-path",
+                        help="durable cross-shard split-write journal "
+                             "(sqlite); default: scaleout-journal.sqlite "
+                             "beside the workflow DB. A mid-split crash "
+                             "replays to completion at the next boot")
+    parser.add_argument("--shard-cache", type=parse_bool_flag,
+                        nargs="?", const=True, default=False,
+                        metavar="BOOL",
+                        help="vector-keyed client-side decision cache: "
+                             "entries key by the full per-shard revision "
+                             "vector (never serving after ANY component "
+                             "shard advances) plus a short TTL, and — "
+                             "lacking the hosts' compiled-caveat "
+                             "knowledge — by the FULL request caveat "
+                             "context, so hit rates need stable caller "
+                             "attributes (default off; per-group "
+                             "host-side caches stay exact and context-"
+                             "digested regardless)")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
     parser.add_argument("--enable-debug-config", action="store_true",
@@ -1192,6 +1316,9 @@ def options_from_args(args: argparse.Namespace) -> Options:
         compact_threshold=args.compact_threshold,
         caveat_context=args.caveat_context,
         caveat_ip_header=args.caveat_ip_header,
+        shard_map=args.shard_map,
+        shard_journal_path=args.shard_journal_path,
+        shard_cache=args.shard_cache,
         engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,

@@ -179,6 +179,25 @@ class Server:
                 else:
                     reasons.append(("replication", detail))
             info_lines = [] if repl_line is None else [repl_line]
+            # sharded deployments (scaleout/): shard count, per-group
+            # role/lag, map version — INFORMATIONAL like admission (a
+            # degraded group degrades a slice of the keyspace; pulling
+            # the whole replica would turn a partial outage into a full
+            # one), but visible here BEFORE that group starts shedding
+            shard_fn = getattr(self.deps.engine, "sharding_status", None)
+            if shard_fn is not None:
+                try:
+                    st = await asyncio.to_thread(shard_fn)
+                    per_group = " ".join(
+                        f"g{g['group']}={g['role']}/"
+                        f"{'?' if g['lag'] is None else g['lag']}"
+                        for g in st["groups"])
+                    info_lines.append(
+                        f"sharding: groups={len(st['groups'])} "
+                        f"map_version={st['version']} {per_group} "
+                        f"pending_splits={st['pending_splits']}")
+                except Exception:  # noqa: BLE001 - readyz must answer
+                    info_lines.append("sharding: status unavailable")
             # admission shed/queue state is INFORMATIONAL: shedding is
             # the overload design working, not unreadiness — pulling a
             # shedding replica from rotation would dump its share of the

@@ -1,0 +1,38 @@
+"""Scale-out: hash-partitioned engine shards + scatter-gather planner.
+
+ROADMAP item 4 — the "millions of users" story: replication (PR 4)
+scales reads of ONE graph, the delta overlay (PR 8) scales one graph's
+write path, but every engine group still held every tuple. This
+subsystem partitions the relationship space itself:
+
+- ``shardmap.py`` — the explicit, versioned :class:`ShardMap`:
+  consistent-hash partitioning of tuples by ``(namespace,
+  resource-type)`` onto N engine groups (each group its own failover
+  set), global (cluster-scoped) tuples replicated to every group so
+  query closures stay shard-local; plus :class:`RevisionVector`, the
+  one-revision-per-shard consistency token.
+- ``planner.py`` — :class:`ShardedEngine`, the proxy-side planner:
+  single-shard checks/writes route directly, LookupResources /
+  list-prefilters / LookupSubjects / watch streams scatter to every
+  group and gather client-side at a revision vector; partial sheds
+  fail closed with Retry-After = max over shards.
+- ``journal.py`` — the dtx-style :class:`SplitJournal`: cross-shard
+  writes are journaled durably before the first shard applies, so a
+  mid-split crash replays to completion instead of leaving a silent
+  half-write.
+"""
+
+from .journal import SplitJournal  # noqa: F401
+from .planner import (  # noqa: F401
+    ShardedEngine,
+    ShardedWatchStream,
+    ShardVectorCache,
+)
+from .shardmap import (  # noqa: F401
+    RevisionVector,
+    ShardMap,
+    ShardMapError,
+    load_shard_map,
+    parse_shard_map,
+    split_resource,
+)

@@ -1,0 +1,121 @@
+"""Durable intent journal for cross-shard split writes.
+
+A write whose tuples span shards loses single-store atomicity: the
+planner applies one per-shard sub-write at a time through each group's
+ordinary WAL/ack path. This journal is the dtx-style safety net around
+that split — the same event-sourced idea as ``dtx/runner.py``'s workflow
+log, specialized to the one deterministic workflow a split write is:
+
+1. ``begin()`` records the FULL per-shard plan (ops + preconditions +
+   map version) durably BEFORE the first shard is touched;
+2. ``mark_applied()`` records each shard's completion as its group acks;
+3. ``finish()`` deletes the entry once every shard has applied.
+
+A crash mid-split leaves the entry with a partial ``applied`` set; the
+next planner over the same journal replays the REMAINING shards to
+completion (``pending()``), with creates degraded to touches so the
+replay is idempotent against a shard that applied but crashed before
+``mark_applied`` landed. Fail-closed direction: a split is either
+completed or still visibly pending — never silently half-applied.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+
+from ..utils.metrics import metrics
+
+
+class SplitJournal:
+    """SQLite-backed (same durability story as the dtx workflow DB —
+    and defaulting to the same directory). Thread-safe: the planner's
+    scatter pool shares one connection under a lock."""
+
+    def __init__(self, db_path: str):
+        self.db_path = db_path
+        d = os.path.dirname(os.path.abspath(db_path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS split_writes ("
+            " id TEXT PRIMARY KEY,"
+            " created REAL NOT NULL,"
+            " map_version INTEGER NOT NULL,"
+            " plan TEXT NOT NULL,"       # JSON {shard: [op dicts...]}
+            " preconditions TEXT NOT NULL,"
+            " applied TEXT NOT NULL)")   # JSON [shard, ...]
+        self._db.commit()
+
+    # -- write path ----------------------------------------------------------
+
+    def begin(self, plan: dict, preconditions: list,
+              map_version: int) -> str:
+        """Durably record the split BEFORE any shard applies; returns
+        the entry id. ``plan`` maps shard index -> serialized op list."""
+        sid = uuid.uuid4().hex
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO split_writes VALUES (?,?,?,?,?,?)",
+                (sid, time.time(), map_version,
+                 json.dumps({str(k): v for k, v in plan.items()}),
+                 json.dumps(preconditions), json.dumps([])))
+            self._db.commit()
+        metrics.counter("scaleout_split_writes_total").inc()
+        return sid
+
+    def mark_applied(self, sid: str, shard: int) -> None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT applied FROM split_writes WHERE id=?",
+                (sid,)).fetchone()
+            if row is None:
+                return
+            applied = set(json.loads(row[0]))
+            applied.add(int(shard))
+            self._db.execute(
+                "UPDATE split_writes SET applied=? WHERE id=?",
+                (json.dumps(sorted(applied)), sid))
+            self._db.commit()
+
+    def finish(self, sid: str) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM split_writes WHERE id=?",
+                             (sid,))
+            self._db.commit()
+
+    # -- recovery ------------------------------------------------------------
+
+    def pending(self) -> list[dict]:
+        """Every unfinished split, oldest first: ``{id, map_version,
+        plan: {shard int: [op dicts]}, preconditions, applied: set}``."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT id, map_version, plan, preconditions, applied "
+                "FROM split_writes ORDER BY created").fetchall()
+        out = []
+        for sid, ver, plan, pcs, applied in rows:
+            out.append({
+                "id": sid,
+                "map_version": int(ver),
+                "plan": {int(k): v
+                         for k, v in json.loads(plan).items()},
+                "preconditions": json.loads(pcs),
+                "applied": set(json.loads(applied)),
+            })
+        return out
+
+    def pending_count(self) -> int:
+        with self._lock:
+            (n,) = self._db.execute(
+                "SELECT COUNT(*) FROM split_writes").fetchone()
+        return int(n)
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

@@ -1,0 +1,953 @@
+"""The proxy-side scatter-gather planner over hash-partitioned shards.
+
+``ShardedEngine`` exposes the exact engine surface the authz middleware
+consumes (check_bulk, lookup_resources[_mask], lookup_subjects, write/
+delete/read relationships, watch streams, store.exists) and plans each
+operation against the :class:`~.shardmap.ShardMap`:
+
+- **single-shard ops** — a check, write, or anchored read whose closure
+  lives on one group — route DIRECTLY to the owning group (no scatter;
+  ``scaleout_ops_total{mode="single"}`` counts them per group);
+- **scatter ops** — LookupResources / list-prefilter masks /
+  LookupSubjects / watch streams — fan out to every group
+  (``shard_fanout`` span) and gather CLIENT-SIDE (``shard_merge``
+  span): namespaced slices are disjoint so the union is exact, global
+  objects are replicated so duplicates dedupe;
+- **cross-shard writes** — tuples spanning groups (including every
+  global-tuple write, which replicates) — split per shard, journaled
+  durably BEFORE the first shard applies (:mod:`.journal`), and
+  replayed to completion after a mid-split crash;
+- **per-shard admission** — each scatter leg passes its own group's
+  engine-host admission; ONE overloaded group sheds only its slice, and
+  the partial-shed scatter fails CLOSED with ``Retry-After`` = the max
+  over the shedding shards (never a half answer).
+
+Consistency is a **revision vector** (one component per group): gathers
+merge at the vector of the per-shard revisions they observed, the
+optional client-side decision cache keys entries by the vector and
+refuses to serve once ANY component advances, and watch resumption
+tokens are vectors, never scalars.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import asdict
+from typing import Optional
+
+import numpy as np
+
+from ..admission import (
+    AdmissionRejected,
+    LOOKUP_PREFILTER,
+    WATCH_RECOMPUTE,
+)
+from ..engine.engine import CheckItem, SchemaViolation, WatchEvent
+from ..engine.remote import NotLeaderError, RemoteInterner
+from ..engine.store import PreconditionFailed, StoreError
+from ..utils.resilience import BreakerOpen
+from ..engine.store import RelationshipFilter, WriteOp
+from ..models.tuples import Relationship
+from ..obs.trace import tracer
+from ..utils.metrics import metrics
+from .journal import SplitJournal
+from .shardmap import RevisionVector, ShardMap
+
+import logging
+
+log = logging.getLogger("sdbkp.scaleout")
+
+# classes whose proxy-side admission cost scales with the scatter width
+_SCATTER_CLASSES = frozenset({LOOKUP_PREFILTER.name,
+                              WATCH_RECOMPUTE.name})
+
+# failures that PROVE a write never applied: the engine answered with a
+# rejection (precondition/schema/store), the role gate refused it
+# pre-dispatch (not_leader), admission shed it before any side effect,
+# or the breaker never let an attempt reach the wire. Everything else —
+# transport deaths, exhausted deadlines, protocol errors — is AMBIGUOUS
+# (bytes may have reached a store that applied them), and a split-write
+# journal entry must then stay pending rather than close half-applied.
+_PROVABLY_NOT_APPLIED = (PreconditionFailed, SchemaViolation,
+                         StoreError, NotLeaderError, AdmissionRejected,
+                         BreakerOpen)
+
+
+def _op_counter(group: int, op: str, mode: str):
+    return metrics.counter("scaleout_ops_total", group=str(group),
+                           op=op, mode=mode)
+
+
+def _rel_to_dict(r: Relationship) -> dict:
+    return asdict(r)
+
+
+def _rel_from_dict(d: dict) -> Relationship:
+    return Relationship(**d)
+
+
+class ShardVectorCache:
+    """Decision cache keyed by ``(query key, revision vector)``: an
+    entry filled at vector V serves ONLY while the planner's tracked
+    vector still equals V — the moment any component shard advances,
+    every V-keyed entry is unreachable (the satellite pin: an old-vector
+    entry never serves after any component advances). Bounded LRU.
+
+    Entries are additionally TIME-BOUNDED (``ttl`` seconds): the
+    planner cannot see the engine-side expiration/caveat verdict-flip
+    watermarks, so a time-window grant could otherwise serve from here
+    past its revocation instant while no write advances the vector.
+    The TTL caps that staleness class; the per-group host-side caches
+    stay exact regardless."""
+
+    def __init__(self, max_entries: int = 8192, ttl: float = 5.0,
+                 clock=time.monotonic):
+        from collections import OrderedDict
+
+        self.max_entries = max_entries
+        self.ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._map: "OrderedDict" = OrderedDict()
+
+    def get(self, key, vector: RevisionVector):
+        with self._lock:
+            got = self._map.get((key, vector))
+            if got is not None and \
+                    self._clock() - got[1] > self.ttl:
+                del self._map[(key, vector)]
+                got = None
+            if got is None:
+                metrics.counter("scaleout_cache_misses_total").inc()
+                return None
+            self._map.move_to_end((key, vector))
+            metrics.counter("scaleout_cache_hits_total").inc()
+            return got[0]
+
+    def put(self, key, vector: RevisionVector, value) -> None:
+        with self._lock:
+            self._map[(key, vector)] = (value, self._clock())
+            self._map.move_to_end((key, vector))
+            while len(self._map) > self.max_entries:
+                self._map.popitem(last=False)
+
+    def retire_below(self, vector: RevisionVector) -> None:
+        """Drop entries whose vector is dominated by (and not equal to)
+        ``vector`` — they can never serve again."""
+        with self._lock:
+            dead = [k for k in self._map
+                    if k[1] != vector and vector.dominates(k[1])]
+            for k in dead:
+                del self._map[k]
+
+
+class _ShardedStoreShim:
+    """The sliver of Store the proxy touches (idempotency/lock existence
+    probes), routed through the planner."""
+
+    def __init__(self, planner: "ShardedEngine"):
+        self._p = planner
+
+    def exists(self, f: RelationshipFilter) -> bool:
+        return self._p.exists(f)
+
+
+class ShardedWatchStream:
+    """Merged server-push watch subscription over every group: one
+    reader thread per group feeds a shared queue; ``next_batch()``
+    returns each group's batches as they land, with every event's
+    revision REWRITTEN to the planner's running revision vector (join of
+    everything seen so far with that shard's component advanced) — so
+    consumers that track "the latest revision seen" hold a resumption
+    token that is exact per shard and monotone across the merge."""
+
+    _POLL = 0.25
+
+    def __init__(self, planner: "ShardedEngine",
+                 from_vector: RevisionVector):
+        self._p = planner
+        self._q: _queue.Queue = _queue.Queue(maxsize=1024)
+        self._closed = threading.Event()
+        self._streams: list = []
+        self._streams_lock = threading.Lock()
+        self._threads: list = []
+        self._vec_lock = threading.Lock()
+        self.revision = from_vector
+        for gi, client in enumerate(planner.groups):
+            t = threading.Thread(
+                target=self._pump, args=(gi, client,
+                                         int(from_vector[gi])),
+                name=f"shard-watch-g{gi}", daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _register_stream(self, s) -> bool:
+        """Track an opened per-group stream; closes it immediately if
+        close() already ran (a pump mid-connect must not leak the
+        socket and park its thread until the heartbeat timeout)."""
+        with self._streams_lock:
+            if not self._closed.is_set():
+                self._streams.append(s)
+                return True
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 - teardown best effort
+            pass
+        return False
+
+    def _put(self, item) -> bool:
+        """Bounded-queue put that re-checks ``close()``: a pump thread
+        whose consumer stopped draining must unpark when the stream
+        closes, not sit in ``Queue.put`` forever."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.5)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _pump(self, gi: int, client, from_rev: int) -> None:
+        try:
+            if hasattr(client, "watch_push_stream"):
+                s = client.watch_push_stream(from_rev)
+                if not self._register_stream(s):
+                    return
+                while not self._closed.is_set():
+                    events = s.next_batch()
+                    if events and not self._put((gi, events, None)):
+                        return
+            else:
+                # in-process engines: blocking wait_events loop
+                rev = from_rev
+                while not self._closed.is_set():
+                    events = client.wait_events(rev, self._POLL)
+                    if events:
+                        rev = max(e.revision for e in events)
+                        if not self._put((gi, events, None)):
+                            return
+        except Exception as e:  # noqa: BLE001 - surfaced to next_batch
+            if not self._closed.is_set():
+                self._put((gi, None, e))
+
+    def next_batch(self) -> list:
+        """Blocks for the next batch from ANY group; ``[]`` means the
+        wait timed out (liveness heartbeat semantics)."""
+        try:
+            gi, events, err = self._q.get(timeout=self._p.PUSH_WAIT)
+        except _queue.Empty:
+            return []
+        if err is not None:
+            raise err
+        with self._vec_lock:
+            out = []
+            for e in events:
+                self.revision = self.revision.bump(gi, e.revision)
+                out.append(WatchEvent(self.revision, e.operation,
+                                      e.relationship))
+            self._p._observe_revision(gi, max(
+                e.revision for e in events))
+        return out
+
+    def close(self) -> None:
+        with self._streams_lock:
+            self._closed.set()
+            streams = list(self._streams)
+        for s in streams:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+
+
+class ShardedEngine:
+    """See module docstring. ``groups`` are engine clients with the
+    remote-engine surface (RemoteEngine / FailoverEngine — or in-process
+    Engines in tests: the planner only calls the shared surface)."""
+
+    PUSH_WAIT = 15.0
+
+    def __init__(self, shard_map: ShardMap, groups: list,
+                 journal: Optional[SplitJournal] = None,
+                 cache: Optional[ShardVectorCache] = None,
+                 recover: bool = True):
+        if len(groups) != shard_map.n_groups:
+            raise ValueError(
+                f"shard map names {shard_map.n_groups} groups, got "
+                f"{len(groups)} clients")
+        self.map = shard_map
+        self.groups = list(groups)
+        self.journal = journal
+        self.cache = cache
+        self.store = _ShardedStoreShim(self)
+        self.dependency = "engine-shards"
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(groups)),
+            thread_name_prefix="shard-scatter")
+        self._vec_lock = threading.Lock()
+        self._vector = shard_map.zero_vector()
+        metrics.gauge("scaleout_groups").set(shard_map.n_groups)
+        metrics.gauge("scaleout_map_version").set(shard_map.version)
+        if recover and journal is not None:
+            try:
+                self.recover_splits()
+            except Exception as e:  # noqa: BLE001 - boot must not gate
+                # an unreachable shard must not turn a one-slice outage
+                # into a full-proxy outage: the entries stay PENDING
+                # (visible as /readyz pending_splits and the counter),
+                # and replay retries on the next recover_splits call —
+                # lazily before the next split write, or the next boot
+                log.warning("deferred split-write recovery (%d pending "
+                            "entries): %s",
+                            self.journal.pending_count(), e)
+                metrics.counter(
+                    "scaleout_split_replay_deferred_total").inc()
+
+    # -- revision vector -----------------------------------------------------
+
+    def _observe_revision(self, shard: int, revision) -> None:
+        """Advance the tracked vector; retires cache entries that can
+        never serve again."""
+        try:
+            revision = int(revision)
+        except (TypeError, ValueError):
+            return
+        with self._vec_lock:
+            self._vector = self._vector.bump(shard, revision)
+        # no eager cache sweep: dominated entries are already
+        # unreachable (get() matches the exact vector) and the TTL
+        # ages them out — an O(entries) retire_below per revision
+        # advance would put a full-scan under the cache lock on every
+        # write and every watch batch
+
+    @property
+    def vector(self) -> RevisionVector:
+        with self._vec_lock:
+            return self._vector
+
+    def revision_vector(self, refresh: bool = True) -> RevisionVector:
+        """The per-shard revision vector; ``refresh`` scatters a
+        revision probe so the answer reflects every group NOW."""
+        if refresh:
+            revs = self._scatter("revision",
+                                 lambda gi, c: c.revision)
+            for gi, r in revs.items():
+                self._observe_revision(gi, r)
+        return self.vector
+
+    @property
+    def revision(self) -> RevisionVector:
+        """The engine-surface revision property: a VECTOR (consumers
+        that only order tokens — the watch hub — work unchanged; the
+        decision audit's ``isinstance(int)`` guard skips it). Serves
+        the TRACKED vector once any traffic has flowed — the dtx
+        activity reads this after every dual-write, and an
+        unconditional refresh would add n_groups round trips per kube
+        write for a token _observe_revision already holds. Only a
+        never-observed (all-zero) vector pays the scatter, so a fresh
+        planner's first watch still starts from the current state
+        instead of replaying every shard's history."""
+        return self.revision_vector(refresh=not any(self.vector))
+
+    # -- scatter machinery ---------------------------------------------------
+
+    def n_shards(self) -> int:
+        return self.map.n_groups
+
+    def admission_fanout(self, cls) -> int:
+        """How many shards one op of ``cls`` will touch — the proxy-side
+        admission multiplier (a scatter is charged once per touched
+        shard)."""
+        if cls is not None and cls.name in _SCATTER_CLASSES:
+            return self.map.n_groups
+        return 1
+
+    def _scatter(self, op: str, fn,
+                 shards: Optional[list] = None) -> dict:
+        """Run ``fn(group_index, client)`` on the named shards (default:
+        all) concurrently; returns {shard: result}. One shard shedding
+        (AdmissionRejected) fails the WHOLE scatter closed with
+        Retry-After = max over the shedding shards; any other error
+        propagates after the fan-in."""
+        targets = list(range(len(self.groups))) if shards is None \
+            else sorted(set(shards))
+        with tracer.span("shard_fanout", op=op, shards=len(targets)):
+            if len(targets) == 1:
+                gi = targets[0]
+                _op_counter(gi, op, "scatter").inc()
+                return {gi: fn(gi, self.groups[gi])}
+            futs = {gi: self._pool.submit(fn, gi, self.groups[gi])
+                    for gi in targets}
+            results: dict = {}
+            sheds: dict = {}
+            first_err = None
+            for gi, f in futs.items():
+                _op_counter(gi, op, "scatter").inc()
+                try:
+                    results[gi] = f.result()
+                except AdmissionRejected as e:
+                    sheds[gi] = e
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    if first_err is None:
+                        first_err = e
+        if sheds:
+            # partial shed fails CLOSED: a gather missing one shard's
+            # slice would silently hide that shard's resources (fail
+            # open for list-prefilter denials). Retry-After is the max
+            # over shards so a polite client outwaits the slowest one.
+            metrics.counter("scaleout_partial_shed_total").inc()
+            worst = max(sheds.values(), key=lambda e: e.retry_after)
+            raise AdmissionRejected(
+                worst.op_class,
+                f"{len(sheds)}/{len(targets)} shards shed the scatter",
+                retry_after=worst.retry_after,
+                dependency="shard-admission")
+        if first_err is not None:
+            raise first_err
+        return results
+
+    def _single(self, gi: int, op: str, fn):
+        _op_counter(gi, op, "single").inc()
+        return fn(self.groups[gi])
+
+    # -- checks --------------------------------------------------------------
+
+    def _check_key(self, items: list, context: Optional[dict]):
+        # context values include LISTS (the middleware's `groups`):
+        # canonical JSON makes the key hashable and deterministic;
+        # anything non-serializable simply bypasses the cache
+        try:
+            ctx = json.dumps(context, sort_keys=True,
+                             separators=(",", ":")) if context else ""
+        except (TypeError, ValueError):
+            return None
+        items_k = tuple(
+            (it.resource_type, it.resource_id, it.permission,
+             it.subject_type, it.subject_id, it.subject_relation)
+            for it in items)
+        return ("check", items_k, ctx)
+
+    def try_cached_check(self, items: list,
+                         context: Optional[dict] = None
+                         ) -> Optional[list]:
+        """Vector-keyed probe: the full verdict list only when a cached
+        entry exists at EXACTLY the planner's current tracked vector."""
+        if self.cache is None or not items:
+            return None
+        key = self._check_key(items, context)
+        if key is None:
+            return None
+        return self.cache.get(key, self.vector)
+
+    def check_bulk(self, items: list, now: Optional[float] = None,
+                   context: Optional[dict] = None) -> list:
+        """Plan the bulk: items grouped by their resource's owning
+        shard; a single-shard bulk routes directly (NO scatter), a
+        mixed bulk scatters only to the owning shards and reassembles
+        in item order."""
+        if not items:
+            return []
+        by_shard: dict[int, list] = {}
+        for idx, it in enumerate(items):
+            gi = self.map.anchor_shard(it.resource_type, it.resource_id)
+            by_shard.setdefault(gi, []).append(idx)
+        cache_key = None
+        if self.cache is not None and now is None:
+            cache_key = self._check_key(items, context)
+        vec_before = self.vector
+        if len(by_shard) == 1:
+            gi = next(iter(by_shard))
+            out = self._single(
+                gi, "check_bulk",
+                lambda c: c.check_bulk(items, now=now, context=context))
+        else:
+            results = self._scatter(
+                "check_bulk",
+                lambda gi, c, _b=by_shard: c.check_bulk(
+                    [items[i] for i in _b[gi]], now=now, context=context),
+                shards=list(by_shard))
+            out = [False] * len(items)
+            with tracer.span("shard_merge", op="check_bulk"):
+                for gi, idxs in by_shard.items():
+                    for pos, verdict in zip(idxs, results[gi]):
+                        out[pos] = bool(verdict)
+        if cache_key is not None:
+            # keyed at the vector observed BEFORE dispatch: any write
+            # landing during the dispatch advances the tracked vector
+            # and makes this entry unreachable (conservative, never
+            # stale-serving)
+            self.cache.put(cache_key, vec_before, list(out))
+        return out
+
+    def check(self, item: CheckItem, now: Optional[float] = None,
+              context: Optional[dict] = None) -> bool:
+        return self.check_bulk([item], now=now, context=context)[0]
+
+    # -- lookups (scatter-gather) --------------------------------------------
+
+    def lookup_resources(self, resource_type: str, permission: str,
+                         subject_type: str, subject_id: str,
+                         subject_relation: Optional[str] = None,
+                         now: Optional[float] = None,
+                         context: Optional[dict] = None) -> list:
+        results = self._scatter(
+            "lookup_resources",
+            lambda gi, c: c.lookup_resources(
+                resource_type, permission, subject_type, subject_id,
+                subject_relation, now=now, context=context))
+        with tracer.span("shard_merge", op="lookup_resources"):
+            seen = set()
+            out = []
+            for gi in sorted(results):
+                for rid in results[gi]:
+                    if rid not in seen:
+                        seen.add(rid)
+                        out.append(rid)
+        metrics.histogram("scaleout_scatter_fanout").observe(
+            len(results))
+        return out
+
+    def lookup_resources_mask(self, resource_type: str, permission: str,
+                              subject_type: str, subject_id: str,
+                              subject_relation: Optional[str] = None,
+                              now: Optional[float] = None,
+                              context: Optional[dict] = None):
+        """Gathered mask: per-shard masks merge client-side into ONE
+        (mask, id view) pair over the sorted union of allowed ids — the
+        canonical gather form, independent of per-shard interner layout
+        (so two deployments sharding the same tuples differently produce
+        byte-identical masks)."""
+        ids = self.lookup_resources(
+            resource_type, permission, subject_type, subject_id,
+            subject_relation, now=now, context=context)
+        ids = sorted(ids)
+        return (np.ones(len(ids), dtype=bool), RemoteInterner(ids))
+
+    def lookup_subjects(self, resource_type: str, resource_id: str,
+                        permission: str, subject_type: str,
+                        subject_relation: Optional[str] = None,
+                        now: Optional[float] = None,
+                        context: Optional[dict] = None) -> list:
+        """Anchored at ONE resource. A NAMESPACED anchor is exact on
+        its owning shard alone: the resource's closure is shard-local
+        (namespaced slice + replicated globals), and a subject whose
+        tuples live only on OTHER shards has no path into that closure
+        — so one direct call, not an n_groups scatter. GLOBAL anchors
+        scatter and union: each shard's candidate subject universe
+        covers its own namespaced slice, and a permitted subject must
+        hold global tuples (visible to every shard), so the union is
+        exact and mostly deduplicates."""
+        owner = self.map.shard_of(resource_type, resource_id)
+        if owner is not None:
+            return self._single(
+                owner, "lookup_subjects",
+                lambda c: c.lookup_subjects(
+                    resource_type, resource_id, permission,
+                    subject_type, subject_relation, now=now,
+                    context=context))
+        results = self._scatter(
+            "lookup_subjects",
+            lambda gi, c: c.lookup_subjects(
+                resource_type, resource_id, permission, subject_type,
+                subject_relation, now=now, context=context))
+        with tracer.span("shard_merge", op="lookup_subjects"):
+            out = sorted({sid for got in results.values()
+                          for sid in got})
+        return out
+
+    # -- relationship reads --------------------------------------------------
+
+    def _filter_shards(self, f: RelationshipFilter) -> Optional[list]:
+        """Owning shards of a filter, or None for "all" (scatter)."""
+        if f.resource_type and f.resource_id:
+            gi = self.map.shard_of(f.resource_type, f.resource_id)
+            if gi is not None:
+                return [gi]
+            # global object: replicated — ONE deterministic group
+            return [self.map.anchor_shard(f.resource_type,
+                                          f.resource_id)]
+        return None
+
+    def read_relationships(self, f: RelationshipFilter) -> list:
+        shards = self._filter_shards(f)
+        if shards is not None and len(shards) == 1:
+            return self._single(shards[0], "read_relationships",
+                                lambda c: list(c.read_relationships(f)))
+        results = self._scatter(
+            "read_relationships",
+            lambda gi, c: list(c.read_relationships(f)), shards=shards)
+        with tracer.span("shard_merge", op="read_relationships"):
+            seen = set()
+            out = []
+            for gi in sorted(results):
+                for rel in results[gi]:
+                    k = rel.key()
+                    if k not in seen:
+                        seen.add(k)
+                        out.append(rel)
+        return out
+
+    def exists(self, f: RelationshipFilter) -> bool:
+        shards = self._filter_shards(f)
+        if shards is not None and len(shards) == 1:
+            return self._single(shards[0], "exists",
+                                lambda c: c.store.exists(f))
+        results = self._scatter("exists",
+                                lambda gi, c: c.store.exists(f),
+                                shards=shards)
+        return any(results.values())
+
+    # -- writes --------------------------------------------------------------
+
+    def _plan_write(self, ops: list) -> dict[int, list]:
+        """shard -> [WriteOp...]: namespaced tuples go to their owner,
+        global tuples replicate to EVERY group."""
+        plan: dict[int, list] = {}
+        for op in ops:
+            gi = self.map.shard_of(op.rel.resource_type,
+                                   op.rel.resource_id)
+            if gi is None:
+                for g in range(self.map.n_groups):
+                    plan.setdefault(g, []).append(op)
+            else:
+                plan.setdefault(gi, []).append(op)
+        return plan
+
+    def _route_preconditions(self, pcs: list, plan_shards) -> dict:
+        """shard -> [Precondition...], with EVERY decision point at or
+        before the FIRST shard's apply: once the first shard has
+        applied, the only failures left are transport/availability —
+        which recovery may replay to completion. A precondition that
+        could reject on a LATER shard would make the journal replay a
+        write its caller was told failed.
+
+        - anchored GLOBAL (replicated — the dtx lock tuple): binds
+          atomically on the FIRST split shard only; replicas agree, so
+          shard 0's verdict is THE verdict, and concurrent lock races
+          serialize on that one store's atomic check-and-write;
+        - namespaced with its owner = the first shard: binds there
+          atomically;
+        - everything else (unanchored, owner later in the split, owner
+          outside the split): one routed existence probe decides it up
+          front — NOT atomic with the split (loss table)."""
+        out: dict[int, list] = {gi: [] for gi in plan_shards}
+        first = min(plan_shards)
+        for pc in pcs:
+            f = pc.filter
+            anchored = bool(f.resource_type and f.resource_id)
+            gi = self.map.shard_of(f.resource_type, f.resource_id) \
+                if anchored else None
+            if gi is None and anchored:
+                out[first].append(pc)
+            elif gi is not None and gi == first:
+                out[gi].append(pc)
+            else:
+                holds = self.exists(f)
+                if holds != pc.must_exist:
+                    raise PreconditionFailed(
+                        "cross-shard precondition on "
+                        f"{f.resource_type or '*'}:"
+                        f"{f.resource_id or '*'} failed")
+        return out
+
+    def write_relationships(self, ops: list,
+                            preconditions: list = ()):
+        plan = self._plan_write(ops)
+        if not plan:
+            return self.vector
+        if len(plan) == 1:
+            gi = next(iter(plan))
+            # preconditions route like the split path: ones this shard
+            # can decide (its own slice, or a replicated global) bind
+            # atomically; a namespaced pc owned ELSEWHERE is probed
+            # through the planner — the target shard's store simply
+            # doesn't hold it (a must_exist would always fail, a
+            # must_not_exist would always pass: fail open)
+            pcs = self._route_preconditions(list(preconditions),
+                                            [gi]).get(gi, [])
+            rev = self._single(
+                gi, "write_relationships",
+                lambda c: c.write_relationships(plan[gi], pcs))
+            self._observe_revision(gi, rev)
+            return self.vector
+        return self._split_write(plan, list(preconditions))
+
+    def _split_write(self, plan: dict, preconditions: list):
+        """Cross-shard split: journal the full plan durably, apply
+        shard-by-shard in index order through each group's ordinary
+        WAL/ack path, mark progress, delete the entry when complete. A
+        crash between any two steps leaves a pending journal entry the
+        next planner replays (creates degraded to touches: idempotent
+        against a shard that applied before the crash)."""
+        if self.journal is not None and self.journal.pending_count():
+            # deferred recovery (an unreachable shard at boot): retry
+            # BEFORE journaling new work so replays keep write order
+            try:
+                self.recover_splits()
+            except Exception as e:  # noqa: BLE001 - still best-effort
+                log.warning("split-write recovery still deferred: %s",
+                            e)
+        pcs_by_shard = self._route_preconditions(preconditions,
+                                                 list(plan))
+        sid = None
+        if self.journal is not None:
+            sid = self.journal.begin(
+                {gi: [{"op": o.op, "rel": _rel_to_dict(o.rel)}
+                      for o in plan[gi]] for gi in plan},
+                [{"filter": asdict(p.filter),
+                  "must_exist": p.must_exist}
+                 for p in preconditions],
+                self.map.version)
+        with tracer.span("shard_fanout", op="split_write",
+                         shards=len(plan)):
+            first = True
+            for gi in sorted(plan):
+                try:
+                    rev = self._single(
+                        gi, "write_relationships",
+                        lambda c, _gi=gi: c.write_relationships(
+                            plan[_gi], pcs_by_shard.get(_gi, [])))
+                except _PROVABLY_NOT_APPLIED:
+                    if first and sid is not None:
+                        # provably nothing applied anywhere — close
+                        # the entry so recovery doesn't resurrect a
+                        # write whose rejection the caller already
+                        # saw. Later shards can only fail via the
+                        # transport (every decision point is at the
+                        # first shard — _route_preconditions), so a
+                        # pending entry is always safe to complete.
+                        self.journal.finish(sid)
+                    raise
+                # any OTHER failure is AMBIGUOUS (transport death,
+                # exhausted deadline — FailoverEngine's own rule: 'an
+                # exhausted deadline may have dispatched'): the write
+                # MAY have applied even on the first shard, so the
+                # entry STAYS pending and recovery touch-replays
+                # everything — the caller's error means at-LEAST-once,
+                # never silently half-applied
+                first = False
+                self._observe_revision(gi, rev)
+                if sid is not None:
+                    self.journal.mark_applied(sid, gi)
+        if sid is not None:
+            self.journal.finish(sid)
+        return self.vector
+
+    def delete_relationships(self, f: RelationshipFilter,
+                             preconditions: list = ()) -> int:
+        from .shardmap import split_resource
+
+        owner = None
+        namespaced = False
+        if f.resource_type and f.resource_id:
+            _, namespaced = split_resource(f.resource_id)
+            if namespaced:
+                owner = self.map.shard_of(f.resource_type, f.resource_id)
+        if owner is not None:
+            # a namespaced anchor: the delete lives on ONE shard;
+            # preconditions it cannot decide locally probe through the
+            # planner (same routing rule as writes)
+            pcs = self._route_preconditions(list(preconditions),
+                                            [owner]).get(owner, [])
+            n = self._single(
+                owner, "delete_relationships",
+                lambda c: c.delete_relationships(f, pcs))
+            self._observe_revision(owner, self._group_revision(owner))
+            return n
+        # global anchor or unanchored filter: every group holds matching
+        # rows (replicas, or disjoint namespaced slices). Preconditions
+        # bind once — on group 0, the deterministic decision shard, and
+        # they are decided BEFORE any other leg deletes anything: group
+        # 0's leg runs alone first, so a failed precondition aborts the
+        # whole delete with every other shard untouched (concurrent
+        # legs would otherwise delete while the caller is told the op
+        # failed). Deletes are idempotent by construction, so a failed
+        # non-decision leg is safe to re-issue (no journal needed).
+        pcs0 = self._route_preconditions(list(preconditions),
+                                         [0]).get(0, [])
+        results = {0: self._single(
+            0, "delete_relationships",
+            lambda c: c.delete_relationships(f, pcs0))}
+        self._observe_revision(0, self._group_revision(0))
+        rest = [g for g in range(self.map.n_groups) if g != 0]
+        if rest:
+            results.update(self._scatter(
+                "delete_relationships",
+                lambda gi, c: c.delete_relationships(f, []),
+                shards=rest))
+        for gi in rest:
+            self._observe_revision(gi, self._group_revision(gi))
+        if f.resource_type and f.resource_id and not namespaced:
+            # replicated rows: every group deleted the SAME tuples —
+            # report one copy, not n_groups copies
+            return int(max(results.values()))
+        # disjoint namespaced slices (plus possibly replicated global
+        # rows, over-counted — documented in the loss table)
+        return int(sum(results.values()))
+
+    def _group_revision(self, gi: int):
+        try:
+            return self.groups[gi].revision
+        except Exception:  # noqa: BLE001 - tracking is best-effort
+            return None
+
+    # -- split-write recovery ------------------------------------------------
+
+    def recover_splits(self) -> int:
+        """Replay every pending split to completion; returns how many
+        entries were finished. Creates degrade to touches (idempotent
+        re-application); preconditions are NOT re-checked — the split
+        was already past its decision point when it journaled."""
+        if self.journal is None:
+            return 0
+        done = 0
+        for ent in self.journal.pending():
+            rerouted = (ent["map_version"] != self.map.version
+                        or any(gi >= self.map.n_groups
+                               for gi in ent["plan"]))
+            if rerouted:
+                # journaled under a DIFFERENT map (rebalance between
+                # the crash and this boot, possibly with fewer groups):
+                # the recorded shard indices no longer name today's
+                # owners — collect every unapplied shard's ops and
+                # re-plan them against the CURRENT map instead of
+                # dereferencing stale indices (which would crash boot)
+                log.warning(
+                    "split %s journaled under map version %d (current "
+                    "%d): re-routing the unapplied ops through the "
+                    "current map", ent["id"], ent["map_version"],
+                    self.map.version)
+                ops = [WriteOp("touch" if d["op"] == "create"
+                               else d["op"], _rel_from_dict(d["rel"]))
+                       for gi, raw in sorted(ent["plan"].items())
+                       if gi not in ent["applied"]
+                       for d in raw]
+                # dedupe (a global tuple appears once per old shard)
+                seen = set()
+                ops = [o for o in ops
+                       if not (o.rel.key() in seen
+                               or seen.add(o.rel.key()))]
+                for gi, part in sorted(self._plan_write(ops).items()):
+                    rev = self._single(
+                        gi, "write_relationships",
+                        lambda c, _o=part: c.write_relationships(_o,
+                                                                 []))
+                    self._observe_revision(gi, rev)
+            else:
+                for gi, raw_ops in sorted(ent["plan"].items()):
+                    if gi in ent["applied"]:
+                        continue
+                    ops = [WriteOp("touch" if d["op"] == "create"
+                                   else d["op"],
+                                   _rel_from_dict(d["rel"]))
+                           for d in raw_ops]
+                    rev = self._single(
+                        gi, "write_relationships",
+                        lambda c, _o=ops: c.write_relationships(_o, []))
+                    self._observe_revision(gi, rev)
+                    self.journal.mark_applied(ent["id"], gi)
+            self.journal.finish(ent["id"])
+            done += 1
+            metrics.counter("scaleout_split_replays_total").inc()
+        return done
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch_since(self, revision) -> list:
+        """Events after a VECTOR resumption token, merged shard-by-shard
+        with monotone vector stamps."""
+        vec = revision if isinstance(revision, RevisionVector) \
+            else RevisionVector.parse(revision) \
+            if not isinstance(revision, int) \
+            else RevisionVector(
+                (int(revision),) * self.map.n_groups)
+        results = self._scatter(
+            "watch_since",
+            lambda gi, c: c.watch_since(int(vec[gi])))
+        with tracer.span("shard_merge", op="watch_since"):
+            out = []
+            cur = vec
+            for gi in sorted(results):
+                for e in results[gi]:
+                    cur = cur.bump(gi, e.revision)
+                    out.append(WatchEvent(cur, e.operation,
+                                          e.relationship))
+        return out
+
+    def watch_push_stream(self, from_revision) -> ShardedWatchStream:
+        vec = from_revision if isinstance(from_revision, RevisionVector) \
+            else RevisionVector((int(from_revision),)
+                                * self.map.n_groups) \
+            if isinstance(from_revision, int) \
+            else RevisionVector.parse(from_revision)
+        return ShardedWatchStream(self, vec)
+
+    def watch_gate(self, resource_type: str, name: str):
+        """Schema-derived, identical on every group: ask the anchor
+        shard of the named object."""
+        gi = self.map.anchor_shard(resource_type, name or "")
+        return self._single(gi, "watch_gate",
+                            lambda c: c.watch_gate(resource_type, name))
+
+    # -- status / lifecycle --------------------------------------------------
+
+    STATUS_PROBE_TIMEOUT = 1.5
+
+    def sharding_status(self) -> dict:
+        """Per-group role/lag + map version for ``/readyz``'s
+        ``sharding:`` info line — a degraded group is visible BEFORE it
+        sheds. Probes fan out on the scatter pool with a SHORT bound:
+        sequential per-group connect timeouts would stall the readiness
+        probe past a kubelet's budget and unready the replica — the
+        exact outcome the informational line exists to avoid."""
+        def probe(c):
+            if hasattr(c, "replication_status"):
+                return c.replication_status() or {}
+            if hasattr(c, "failover_state"):
+                return c.failover_state() or {}
+            return {"role": "local", "lag": 0}
+
+        futs = [self._pool.submit(probe, c) for c in self.groups]
+        groups = []
+        for gi, f in enumerate(futs):
+            try:
+                st = f.result(timeout=self.STATUS_PROBE_TIMEOUT)
+            except Exception:  # noqa: BLE001 - status is best-effort
+                st = {"role": "unreachable", "lag": None}
+            groups.append({"group": gi, "role": st.get("role"),
+                           "term": st.get("term"),
+                           "lag": st.get("lag")})
+        return {
+            "version": self.map.version,
+            "groups": groups,
+            "vector": self.vector.encode(),
+            "pending_splits": (self.journal.pending_count()
+                               if self.journal is not None else 0),
+        }
+
+    def fetch_traces(self, limit: int = 64) -> list:
+        out: list = []
+        for c in self.groups:
+            try:
+                if hasattr(c, "fetch_traces"):
+                    out.extend(c.fetch_traces(limit))
+            except Exception:  # noqa: BLE001 - diagnostics best-effort
+                continue
+        return out
+
+    def close(self, close_journal: bool = True) -> None:
+        """``close_journal=False`` leaves a SHARED journal open (e.g. a
+        crashed planner's journal that a successor will replay)."""
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        for c in self.groups:
+            try:
+                if hasattr(c, "close"):
+                    c.close()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        if close_journal and self.journal is not None:
+            self.journal.close()

@@ -1,0 +1,250 @@
+"""The shard map: consistent-hash partitioning of the relationship space.
+
+Scale-out (ROADMAP item 4) partitions TUPLES, not replicas: each engine
+*group* (its own failover set of engine hosts, reusing the ``--peers``
+machinery) owns a slice of the relationship space, so capacity grows by
+adding shards instead of mirrors. The partition key is
+``(namespace, resource-type)``:
+
+- **namespaced** tuples — resource ids of the kube ``ns/name`` shape —
+  hash by the namespace portion plus the resource type onto exactly one
+  group (the blocked decomposition RedisGraph/GraphBLAS applies to
+  matrix tiles, applied here at the cluster level);
+- **global** tuples — bare resource ids with no ``/`` (namespaces
+  themselves, groups, dtx lock tuples, workflow markers) — REPLICATE to
+  every group. They are the reference data cross-namespace reachability
+  walks through (``pod -> namespace -> viewer``, ``viewer ->
+  group#member``); replicating them keeps every query's closure inside
+  one shard, which is what makes single-shard checks exact and
+  scatter-gather a plain union.
+
+The map is an EXPLICIT, versioned artifact the proxy loads from a flag
+or file — routing is deterministic and testable, never discovered. A
+rebalance is a new map version; the version rides ``/readyz`` and the
+split-write journal so a mixed-version fleet is visible.
+
+``RevisionVector`` is the consistency token of a sharded deployment: one
+revision per group, totally ordered along any one planner's history
+(components only advance). Decision-cache keys and watch resumption
+carry the vector, never a scalar.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from bisect import bisect_right
+from dataclasses import dataclass
+
+
+class ShardMapError(ValueError):
+    pass
+
+
+class RevisionVector(tuple):
+    """One store revision per shard group. A plain-tuple subclass so it
+    JSON-serializes (as a list), hashes (cache keys), and totally orders
+    lexicographically — which agrees with the causal partial order along
+    any monotone stream (components never go backward, so of two vectors
+    observed on one stream the later one is component-wise >=)."""
+
+    __slots__ = ()
+
+    @classmethod
+    def zero(cls, n: int) -> "RevisionVector":
+        return cls((0,) * n)
+
+    def bump(self, shard: int, revision: int) -> "RevisionVector":
+        """This vector with ``shard``'s component advanced to
+        ``revision`` (never regressed)."""
+        return RevisionVector(
+            max(int(revision), c) if i == shard else c
+            for i, c in enumerate(self))
+
+    def join(self, other) -> "RevisionVector":
+        """Component-wise max — the merge point of two observations."""
+        return RevisionVector(max(a, b) for a, b in zip(self, other))
+
+    def dominates(self, other) -> bool:
+        """True iff every component is >= ``other``'s."""
+        return all(a >= b for a, b in zip(self, other))
+
+    def encode(self) -> str:
+        return "v" + ".".join(str(int(c)) for c in self)
+
+    @classmethod
+    def parse(cls, s) -> "RevisionVector":
+        """Accepts an ``encode()`` string, a sequence, or a plain int
+        (a scalar resumption token: every component starts there)."""
+        if isinstance(s, RevisionVector):
+            return s
+        if isinstance(s, int):
+            raise ShardMapError(
+                "a scalar revision needs a shard count; use "
+                "RevisionVector.zero(n).bump(...) or pass a vector")
+        if isinstance(s, (list, tuple)):
+            return cls(int(c) for c in s)
+        t = str(s).strip()
+        if not t.startswith("v"):
+            raise ShardMapError(f"invalid revision vector {s!r}")
+        try:
+            return cls(int(c) for c in t[1:].split("."))
+        except ValueError:
+            raise ShardMapError(
+                f"invalid revision vector {s!r}") from None
+
+
+def split_resource(resource_id: str) -> tuple[str, bool]:
+    """``(namespace, namespaced?)`` of a resource id: the kube
+    ``ns/name`` convention carries the namespace before the first slash;
+    a bare id is a GLOBAL object (cluster-scoped — replicated to every
+    group)."""
+    if "/" in resource_id:
+        return resource_id.split("/", 1)[0], True
+    return "", False
+
+
+def _hash32(key: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2s(key.encode("utf-8"), digest_size=4).digest(),
+        "big")
+
+
+@dataclass(frozen=True)
+class ShardMap:
+    """Versioned, deterministic tuple-space partition.
+
+    ``groups`` is a tuple of endpoint lists — one list per engine group,
+    each list the group's failover set in peer-id order (the same grammar
+    as ``--engine-endpoint tcp://h1:p1,h2:p2``). ``virtual_nodes`` sets
+    the ring granularity: more points smooth the key distribution at the
+    cost of a bigger (still tiny) ring.
+    """
+
+    version: int
+    groups: tuple  # tuple[tuple[(host, port), ...], ...]
+    virtual_nodes: int = 64
+
+    def __post_init__(self):
+        if self.version < 1:
+            raise ShardMapError("shard map version must be >= 1")
+        if not self.groups:
+            raise ShardMapError("shard map needs >= 1 group")
+        if self.virtual_nodes < 1:
+            raise ShardMapError("virtual_nodes must be >= 1")
+        # the ring: virtual_nodes points per group, keyed by GROUP INDEX
+        # (not endpoints) so replacing a dead host inside a group never
+        # moves any data — only adding/removing whole groups does
+        points = []
+        for gi in range(len(self.groups)):
+            for r in range(self.virtual_nodes):
+                points.append((_hash32(f"group{gi}:vn{r}"), gi))
+        points.sort()
+        object.__setattr__(self, "_ring_keys",
+                           tuple(p[0] for p in points))
+        object.__setattr__(self, "_ring_groups",
+                           tuple(p[1] for p in points))
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    def shard_for(self, namespace: str, resource_type: str) -> int:
+        """The owning group of a ``(namespace, resource-type)`` key —
+        clockwise successor on the hash ring."""
+        h = _hash32(f"{namespace}\x00{resource_type}")
+        keys = self._ring_keys
+        i = bisect_right(keys, h)
+        if i == len(keys):
+            i = 0
+        return self._ring_groups[i]
+
+    def shard_of(self, resource_type: str, resource_id: str):
+        """Owning group index for one tuple/query anchor, or ``None``
+        when the object is GLOBAL (replicated to every group)."""
+        ns, namespaced = split_resource(resource_id)
+        if not namespaced:
+            return None
+        return self.shard_for(ns, resource_type)
+
+    def anchor_shard(self, resource_type: str, resource_id: str) -> int:
+        """A deterministic SINGLE group to answer a read anchored at one
+        object: the owning shard for namespaced objects; for global
+        objects (replicated everywhere) the hash of the bare id — so
+        repeated reads of one object land on one group (warm caches)
+        while distinct global objects spread the load."""
+        owner = self.shard_of(resource_type, resource_id)
+        if owner is not None:
+            return owner
+        return self.shard_for(resource_id, resource_type)
+
+    def zero_vector(self) -> RevisionVector:
+        return RevisionVector.zero(self.n_groups)
+
+    def describe(self) -> str:
+        return (f"version={self.version} groups={self.n_groups} "
+                + " ".join(
+                    f"g{i}={len(eps)}ep" for i, eps in
+                    enumerate(self.groups)))
+
+
+def parse_shard_map(text: str) -> ShardMap:
+    """Parse the JSON shard-map document::
+
+        {"version": 1,
+         "groups": [["127.0.0.1:7001", "127.0.0.1:7002"],
+                    ["127.0.0.1:7011"]],
+         "virtual_nodes": 64}
+    """
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        raise ShardMapError(f"shard map is not valid JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise ShardMapError("shard map must be a JSON object")
+    try:
+        version = int(doc["version"])
+        raw_groups = doc["groups"]
+    except (KeyError, TypeError, ValueError):
+        raise ShardMapError(
+            "shard map needs integer 'version' and list 'groups'"
+        ) from None
+    if not isinstance(raw_groups, list) or not raw_groups:
+        raise ShardMapError("shard map 'groups' must be a non-empty list")
+    from ..parallel.failover import FailoverError, parse_peers
+
+    groups = []
+    for gi, eps in enumerate(raw_groups):
+        if isinstance(eps, str):
+            eps = [eps]
+        if not isinstance(eps, list) or not eps:
+            raise ShardMapError(
+                f"shard map group {gi} must be a non-empty endpoint list")
+        try:
+            # one owner for the host:port grammar (failover --peers /
+            # --engine-endpoint already delegate here)
+            groups.append(tuple(parse_peers(",".join(
+                str(e) for e in eps))))
+        except FailoverError as e:
+            raise ShardMapError(
+                f"shard map group {gi}: {e}") from None
+    try:
+        vnodes = int(doc.get("virtual_nodes", 64))
+    except (TypeError, ValueError):
+        raise ShardMapError(
+            "shard map 'virtual_nodes' must be an integer") from None
+    return ShardMap(version=version, groups=tuple(groups),
+                    virtual_nodes=vnodes)
+
+
+def load_shard_map(spec: str) -> ShardMap:
+    """``--shard-map`` value: inline JSON (starts with ``{``) or a path
+    to a JSON file."""
+    spec = spec.strip()
+    if spec.startswith("{"):
+        return parse_shard_map(spec)
+    if not os.path.exists(spec):
+        raise ShardMapError(f"shard map file not found: {spec}")
+    with open(spec) as f:
+        return parse_shard_map(f.read())

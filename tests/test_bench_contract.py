@@ -66,7 +66,25 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     assert stages["device"]["n"] > 0
     assert stages["device"]["p50_ms"] is not None
     _assert_caveat_schema(out["caveats"])
+    _assert_shard_schema(out["shard"])
     _assert_macro_schema(out["macro"])
+
+
+def _assert_shard_schema(sh: dict) -> None:
+    """The ISSUE 11 scale-out contract: the 1 vs 2 vs 4 group scaling
+    curve is RECORDED (check p50, scatter-lookup p50, goodput per group
+    count), and single-shard checks provably never scattered (per-shard
+    op counters)."""
+    assert sh["n_ns"] >= 1 and sh["n_rels"] >= 1
+    assert sh["single_shard_no_scatter"] is True
+    assert set(sh["groups"]) == {"1", "2", "4"}
+    for k, g in sh["groups"].items():
+        for key in ("check_p50_ms", "scatter_lookup_p50_ms",
+                    "goodput_ops_s"):
+            v = g[key]
+            assert isinstance(v, (int, float)) and v == v and v > 0 \
+                and abs(v) != float("inf"), (k, key, v)
+        assert g["single_shard_no_scatter"] is True
 
 
 def _assert_caveat_schema(cav: dict) -> None:

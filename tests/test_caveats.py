@@ -924,3 +924,236 @@ def test_caveat_context_disabled_fails_closed():
             headers={"X-Forwarded-For": "10.1.1.1"}), deps)
         assert resp.status == 403  # context never forwarded: fail closed
     asyncio.run(go())
+
+
+# -- IPv6 in the ipaddress type (ISSUE 11 satellite) -------------------------
+
+IP6_BOOT = """\
+schema: |-
+  caveat office_net(ip ipaddress) {
+    ip in ['2001:db8::/64', '10.0.0.0/8', '192.168.1.7']
+  }
+  caveat same_addr(ip ipaddress, peer ipaddress) {
+    ip == peer
+  }
+  caveat below(ip ipaddress, peer ipaddress) {
+    ip < peer
+  }
+  caveat dyn_list(ip ipaddress, allowed list<ipaddress>) {
+    ip in allowed
+  }
+
+  definition user {}
+
+  definition doc {
+    relation viewer: user with office_net
+    relation editor: user with same_addr
+    relation ranker: user with below
+    relation lister: user with dyn_list
+  }
+relationships: |-
+  doc:d#viewer@user:al[office_net]
+  doc:e#editor@user:al[same_addr:{"peer": "2001:db8::42"}]
+  doc:r#ranker@user:al[below:{"peer": "2001:db8::100"}]
+  doc:l#lister@user:al[dyn_list:{"allowed": ["10.1.0.0/16", "2001:db8::/64"]}]
+  doc:l4#lister@user:al[dyn_list:{"allowed": ["10.1.0.0/16"]}]
+"""
+
+_IP6_RELS = {"d": "viewer", "e": "editor", "r": "ranker",
+             "l": "lister", "l4": "lister"}
+
+
+def _ip6_engine():
+    b = parse_bootstrap(IP6_BOOT)
+    e = Engine(schema=b.schema)
+    e.write_relationships([WriteOp("touch", r) for r in b.relationships])
+    return e
+
+
+def _ip6_check(e, doc, ip):
+    ctx = {"ip": ip} if ip is not None else None
+    return e.check_bulk([CheckItem("doc", doc, _IP6_RELS[doc], "user",
+                                   "al")], context=ctx)[0]
+
+
+def test_ipv6_literal_cidr_exact_lexicographic_boundaries():
+    """Literal CIDR allowlists lower to exact word-wise lexicographic
+    range checks in the mapped 128-bit space: the /64 boundary addresses
+    split EXACTLY, v4 members keep working, and a v6 address never
+    matches a v4 block (distinct mapped ranges)."""
+    e = _ip6_engine()
+    # inside the /64: first and last address of the block
+    assert _ip6_check(e, "d", "2001:db8::")
+    assert _ip6_check(e, "d", "2001:db8::ffff:ffff:ffff:ffff")
+    # one past either edge: exact misses (low 64 bits all-ones + 1)
+    assert not _ip6_check(e, "d", "2001:db8:0:1::")
+    assert not _ip6_check(e, "d", "2001:db7:ffff:ffff:ffff:ffff:ffff:ffff")
+    # v4 members of the same list
+    assert _ip6_check(e, "d", "10.255.255.255")
+    assert _ip6_check(e, "d", "192.168.1.7")
+    assert not _ip6_check(e, "d", "192.168.1.8")
+    # a v6 address inside the v4 block's MAPPED range only via ::ffff —
+    # the mapped form of a member matches (families share one space)
+    assert _ip6_check(e, "d", "::ffff:10.0.0.1")
+    # garbage -> missing context -> fail closed
+    assert not _ip6_check(e, "d", "not-an-ip")
+    assert not _ip6_check(e, "d", None)
+
+
+def test_ipv6_wide_compare_eq_and_ordering():
+    e = _ip6_engine()
+    # equality across all four words: low-bit differences matter
+    assert _ip6_check(e, "e", "2001:db8::42")
+    assert not _ip6_check(e, "e", "2001:db8::43")
+    assert not _ip6_check(e, "e", "2001:db8:0:0:1::42")
+    # lexicographic ordering: below 2001:db8::100 in the HIGH words and
+    # in the LOW words; v4 is always below any non-mapped v6
+    assert _ip6_check(e, "r", "2001:db8::ff")
+    assert not _ip6_check(e, "r", "2001:db8::100")
+    assert not _ip6_check(e, "r", "2001:db8::101")
+    assert _ip6_check(e, "r", "9.9.9.9")  # mapped v4 < 2001:db8::
+
+
+def test_ipv6_param_list_v4_gate_and_unencodable_counter():
+    before = metrics.counter(
+        "engine_caveat_ipv6_unencodable_total").value
+    e = _ip6_engine()
+    # a PURE-v4 param list keeps working exactly
+    assert _ip6_check(e, "l4", "10.1.2.3")
+    assert not _ip6_check(e, "l4", "10.2.0.1")
+    # the tuple's list held a v6 element: the WHOLE list is
+    # unencodable -> UNKNOWN -> fail closed (even for v4 members that a
+    # narrowed list would have admitted: a KNOWN narrowed answer would
+    # fail OPEN under '!(ip in blocked)' denylists), and counted
+    miss0 = metrics.counter(
+        "engine_caveat_denied_missing_context_total").value
+    assert not _ip6_check(e, "l", "10.1.2.3")
+    assert metrics.counter(
+        "engine_caveat_denied_missing_context_total").value > miss0
+    after = metrics.counter(
+        "engine_caveat_ipv6_unencodable_total").value
+    assert after > before
+    # a v6 request address against a v4-only list is a KNOWN miss (the
+    # sentinel lowering): denied WITHOUT a missing-context tick — the
+    # true answer, not an unknown. Isolated engine: the combined
+    # fixture's v6-bearing instance is legitimately missing on every
+    # dispatch and would tick the counter regardless of the doc asked
+    b4 = parse_bootstrap("""\
+schema: |-
+  caveat dyn_list(ip ipaddress, allowed list<ipaddress>) {
+    ip in allowed
+  }
+
+  definition user {}
+
+  definition doc {
+    relation lister: user with dyn_list
+  }
+relationships: |-
+  doc:l4#lister@user:al[dyn_list:{"allowed": ["10.1.0.0/16"]}]
+""")
+    e4 = Engine(schema=b4.schema)
+    e4.write_relationships([WriteOp("touch", r)
+                            for r in b4.relationships])
+    miss1 = metrics.counter(
+        "engine_caveat_denied_missing_context_total").value
+    assert not e4.check_bulk([CheckItem("doc", "l4", "lister", "user",
+                                        "al")],
+                             context={"ip": "2001:db8::1"})[0]
+    assert metrics.counter(
+        "engine_caveat_denied_missing_context_total").value == miss1
+    # writes carrying v6 list elements are ACCEPTED (well-typed; they
+    # resolve UNKNOWN at evaluation), never a SchemaViolation
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+    e.write_relationships([WriteOp("touch", parse_relationship(
+        'doc:lw#lister@user:al'
+        '[dyn_list:{"allowed": ["fe80::/10"]}]'))])
+
+
+def test_ipv6_vm_matches_interpreter_over_address_corpus():
+    """Differential over both families and every caveat shape: the VM's
+    verdict equals the tri-state oracle's for literal lists, wide
+    compares, and the param-list v4 gate."""
+    e = _ip6_engine()
+    b = parse_bootstrap(IP6_BOOT)
+    defs = b.schema.caveat_defs
+    corpus = [
+        "2001:db8::", "2001:db8::1", "2001:db8::42", "2001:db8::100",
+        "2001:db8::ffff:ffff:ffff:ffff", "2001:db8:0:1::", "fe80::1",
+        "::1", "::", "ffff:ffff:ffff:ffff:ffff:ffff:ffff:ffff",
+        "10.0.0.0", "10.1.2.3", "10.255.255.255", "11.0.0.0",
+        "192.168.1.7", "0.0.0.0", "255.255.255.255",
+        "::ffff:10.0.0.1", "::ffff:192.168.1.7",
+    ]
+    tuple_ctx = {"e": {"peer": "2001:db8::42"},
+                 "r": {"peer": "2001:db8::100"},
+                 "l": {"allowed": ["10.1.0.0/16", "2001:db8::/64"]}}
+    cav_of = {"d": "office_net", "e": "same_addr", "r": "below",
+              "l": "dyn_list"}
+    for doc, cname in cav_of.items():
+        defn = defs[cname]
+        params = {p.name: p.type for p in defn.params}
+        for ip in corpus:
+            got = _ip6_check(e, doc, ip)
+            ctx = dict(tuple_ctx.get(doc, {}))
+            ctx["ip"] = ip
+            want = interpret(defn.expr, ctx, params, StringInterner())
+            assert got == (want is True), (doc, ip, want, got)
+
+
+def test_ipaddress_type_misuse_rejected():
+    for body, params in (
+            ("ip + 1 > 5", (CaveatParam("ip", CaveatType("ipaddress")),)),
+            ("ip > 5", (CaveatParam("ip", CaveatType("ipaddress")),)),
+            ("5 in allowed", (CaveatParam(
+                "allowed", CaveatType("list", "ipaddress")),)),
+            ("ip in [7]", (CaveatParam("ip", CaveatType("ipaddress")),)),
+    ):
+        defn = CaveatDef("bad", params, parse_caveat_body(body))
+        with pytest.raises(CaveatError):
+            compile_caveat(defn, StringInterner())
+
+
+def test_ipv6_unencodable_list_never_fails_open_under_negation():
+    """The denylist polarity pin: '!(ip in blocked)' with a v6 element
+    in the blocked PARAM list must DENY (the list is UNKNOWN, and
+    Kleene NOT(unknown) = unknown = fail closed) — a dropped-element
+    narrowing would have answered known-False and GRANTED."""
+    boot = """\
+schema: |-
+  caveat not_blocked(ip ipaddress, blocked list<ipaddress>) {
+    !(ip in blocked)
+  }
+
+  definition user {}
+
+  definition doc {
+    relation viewer: user with not_blocked
+    permission view = viewer
+  }
+relationships: |-
+  doc:v6#viewer@user:al[not_blocked:{"blocked": ["2001:db8::/64"]}]
+  doc:v4#viewer@user:al[not_blocked:{"blocked": ["10.0.0.0/8"]}]
+"""
+    b = parse_bootstrap(boot)
+    e = Engine(schema=b.schema)
+    e.write_relationships([WriteOp("touch", r) for r in b.relationships])
+
+    def chk(doc, ip):
+        return e.check_bulk([CheckItem("doc", doc, "viewer", "user",
+                                       "al")], context={"ip": ip})[0]
+
+    # v6-bearing denylist: UNKNOWN -> denied for EVERYONE (the blocked
+    # v6 client above all — never granted by a narrowed known-False)
+    assert not chk("v6", "2001:db8::1")   # explicitly blocked: denied
+    assert not chk("v6", "9.9.9.9")       # fail closed, not fail open
+    # pure-v4 denylist keeps exact semantics either family
+    assert not chk("v4", "10.1.2.3")      # blocked
+    assert chk("v4", "11.0.0.1")          # not blocked: granted
+    assert chk("v4", "2001:db8::1")       # v6 truly not in a v4 list
+    # and the oracle agrees on the unknown polarity
+    defn = b.schema.caveat_defs["not_blocked"]
+    params = {p.name: p.type for p in defn.params}
+    assert interpret(defn.expr,
+                     {"ip": "9.9.9.9", "blocked": ["2001:db8::/64"]},
+                     params, StringInterner()) is None

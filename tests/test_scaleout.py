@@ -1,0 +1,1321 @@
+"""Scale-out: hash-partitioned engine shards + scatter-gather planner.
+
+Covers the ISSUE 11 acceptance surface:
+
+- shard-map determinism, versioning, global-vs-namespaced routing;
+- revision-vector ordering/merge/encode and the merge edge cases
+  (gathers across shards at DIFFERENT revisions, old-vector cache
+  entries never serving after any component advances);
+- single-shard checks routing direct (per-shard op counters prove no
+  scatter), scatter-gather parity with an unsharded oracle engine;
+- cross-shard split writes journaled durably and replayed to a
+  consistent state after a mid-split crash;
+- partial-shed scatter failing CLOSED with Retry-After = max over
+  shards, and the per-shard admission cost multiplier;
+- /readyz's ``sharding:`` info line;
+- the end-to-end 2-group deployment over REAL TCP engine hosts with a
+  SIGKILL'd group leader failing over without disturbing the other
+  group.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from spicedb_kubeapi_proxy_tpu.admission import (  # noqa: E402
+    AdmissionRejected,
+    CHECK,
+    LOOKUP_PREFILTER,
+    WATCH_RECOMPUTE,
+    WRITE_DTX,
+)
+from spicedb_kubeapi_proxy_tpu.engine import Engine  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine.engine import (  # noqa: E402
+    CheckItem,
+    mask_to_ids,
+)
+from spicedb_kubeapi_proxy_tpu.engine.store import (  # noqa: E402
+    RelationshipFilter,
+    WriteOp,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import (  # noqa: E402
+    Relationship,
+)
+from spicedb_kubeapi_proxy_tpu.scaleout import (  # noqa: E402
+    RevisionVector,
+    ShardedEngine,
+    ShardMap,
+    ShardMapError,
+    ShardVectorCache,
+    SplitJournal,
+    load_shard_map,
+    parse_shard_map,
+    split_resource,
+)
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics  # noqa: E402
+
+SCHEMA_YAML = """\
+schema: |-
+  use expiration
+
+  definition user {}
+
+  definition group {
+    relation member: user
+  }
+
+  definition namespace {
+    relation creator: user
+    relation viewer: user | group#member
+    permission admin = creator
+    permission view = viewer + creator
+  }
+
+  definition pod {
+    relation namespace: namespace
+    relation creator: user
+    relation viewer: user
+    permission edit = creator
+    permission view = viewer + creator + namespace->view
+  }
+relationships: ""
+"""
+
+
+def _engine() -> Engine:
+    return Engine(bootstrap=SCHEMA_YAML)
+
+
+def _map(n: int, version: int = 1) -> ShardMap:
+    return ShardMap(version=version,
+                    groups=tuple((("127.0.0.1", 0),) for _ in range(n)))
+
+
+def _planner(n: int, journal=None, cache=None):
+    engines = [_engine() for _ in range(n)]
+    return ShardedEngine(_map(n), engines, journal=journal,
+                         cache=cache), engines
+
+
+def _ops_count(mode: str, op: str = "check_bulk") -> float:
+    tot = 0.0
+    for gi in range(8):
+        tot += metrics.counter("scaleout_ops_total", group=str(gi),
+                               op=op, mode=mode).value
+    return tot
+
+
+def rel(rt, rid, rl, st, sid, srl=None) -> Relationship:
+    return Relationship(rt, rid, rl, st, sid, srl)
+
+
+# -- shard map ---------------------------------------------------------------
+
+
+def test_shard_map_deterministic_versioned_and_spread():
+    doc = ('{"version": 3, "groups": [["127.0.0.1:7001", '
+           '"127.0.0.1:7002"], ["127.0.0.1:7011"], ["127.0.0.1:7021"]]}')
+    m1, m2 = parse_shard_map(doc), parse_shard_map(doc)
+    assert m1.version == 3 and m1.n_groups == 3
+    assert m1.groups[0] == (("127.0.0.1", 7001), ("127.0.0.1", 7002))
+    # deterministic: two instances agree on every key
+    owners = {}
+    for i in range(300):
+        key = (f"ns{i}", "pod")
+        owners[key] = m1.shard_for(*key)
+        assert m2.shard_for(*key) == owners[key]
+    # consistent hashing actually spreads the keyspace
+    per_group = [0] * 3
+    for g in owners.values():
+        per_group[g] += 1
+    assert all(c > 0 for c in per_group), per_group
+    # (namespace, TYPE) is part of the key: same ns, different types may
+    # land on different groups (the documented colocation caveat)
+    assert len({m1.shard_for("nsx", t)
+                for t in ("pod", "deployment", "secret", "configmap",
+                          "service", "job")}) > 1
+
+
+def test_shard_map_validation_errors():
+    with pytest.raises(ShardMapError):
+        parse_shard_map("not json")
+    with pytest.raises(ShardMapError):
+        parse_shard_map('{"version": 0, "groups": [["h:1"]]}')
+    with pytest.raises(ShardMapError):
+        parse_shard_map('{"version": 1, "groups": []}')
+    with pytest.raises(ShardMapError):
+        parse_shard_map('{"version": 1, "groups": [["nonsense"]]}')
+    with pytest.raises(ShardMapError):
+        load_shard_map("/definitely/not/a/file.json")
+
+
+def test_global_vs_namespaced_split():
+    assert split_resource("ns1/p0") == ("ns1", True)
+    assert split_resource("ns1") == ("", False)
+    m = _map(4)
+    assert m.shard_of("pod", "ns1/p0") is not None
+    assert m.shard_of("namespace", "ns1") is None  # global: replicated
+    # anchored reads of a global object still pick ONE stable group
+    a = m.anchor_shard("namespace", "ns1")
+    assert a == m.anchor_shard("namespace", "ns1")
+    assert 0 <= a < 4
+
+
+# -- revision vectors --------------------------------------------------------
+
+
+def test_revision_vector_ordering_merge_encode():
+    v0 = RevisionVector.zero(3)
+    v1 = v0.bump(1, 5)
+    v2 = v1.bump(0, 2)
+    assert v1 == (0, 5, 0) and v2 == (2, 5, 0)
+    assert v2.dominates(v1) and not v1.dominates(v2)
+    assert v1.join(RevisionVector((3, 1, 0))) == (3, 5, 0)
+    # bump never regresses a component
+    assert v2.bump(1, 3) == (2, 5, 0)
+    # encode/parse round-trip; parse accepts sequences
+    assert RevisionVector.parse(v2.encode()) == v2
+    assert RevisionVector.parse([1, 2, 3]) == (1, 2, 3)
+    with pytest.raises(ShardMapError):
+        RevisionVector.parse("x1.2")
+    # tuple lexicographic order agrees with causality along one stream
+    assert v2 > v1 > v0
+
+
+# -- planner routing ---------------------------------------------------------
+
+
+def test_single_shard_check_routes_direct_no_scatter():
+    p, engines = _planner(2)
+    p.write_relationships([
+        WriteOp("create", rel("pod", "nsa/p0", "viewer", "user", "al")),
+        WriteOp("create", rel("pod", "nsb/p0", "viewer", "user", "bo")),
+    ])
+    s_before = _ops_count("scatter")
+    d_before = _ops_count("single")
+    assert p.check(CheckItem("pod", "nsa/p0", "view", "user", "al"))
+    assert not p.check(CheckItem("pod", "nsa/p0", "view", "user", "bo"))
+    assert p.check(CheckItem("pod", "nsb/p0", "view", "user", "bo"))
+    assert _ops_count("scatter") == s_before  # NO scatter for checks
+    assert _ops_count("single") >= d_before + 3
+    # a bulk mixing both shards' pods scatters only to the owners and
+    # reassembles in item order
+    out = p.check_bulk([
+        CheckItem("pod", "nsa/p0", "view", "user", "al"),
+        CheckItem("pod", "nsb/p0", "view", "user", "al"),
+        CheckItem("pod", "nsb/p0", "view", "user", "bo"),
+    ])
+    assert out == [True, False, True]
+    p.close()
+
+
+def test_scatter_gather_parity_with_unsharded_oracle():
+    import random
+
+    rng = random.Random(11)
+    n_ns, n_users = 12, 6
+    writes = []
+    # namespaces (global), group grants, pods across namespaces
+    for i in range(n_ns):
+        writes.append(WriteOp("create", rel(
+            "namespace", f"ns{i}", "viewer", "user",
+            f"u{rng.randrange(n_users)}")))
+    writes.append(WriteOp("create", rel(
+        "group", "admins", "member", "user", "u0")))
+    writes.append(WriteOp("create", rel(
+        "namespace", "ns0", "viewer", "group", "admins", "member")))
+    for i in range(n_ns):
+        for pj in range(3):
+            writes.append(WriteOp("create", rel(
+                "pod", f"ns{i}/p{pj}", "namespace", "namespace",
+                f"ns{i}")))
+            if rng.random() < 0.5:
+                writes.append(WriteOp("create", rel(
+                    "pod", f"ns{i}/p{pj}", "viewer", "user",
+                    f"u{rng.randrange(n_users)}")))
+
+    oracle = _engine()
+    oracle.write_relationships(list(writes))
+    for n_shards in (2, 3):
+        p, engines = _planner(n_shards)
+        p.write_relationships(list(writes))
+        for u in [f"u{i}" for i in range(n_users)]:
+            want = sorted(oracle.lookup_resources(
+                "pod", "view", "user", u))
+            got = sorted(p.lookup_resources("pod", "view", "user", u))
+            assert got == want, (n_shards, u)
+            # the gathered mask materializes the SAME ids byte-for-byte
+            mask, interner = p.lookup_resources_mask(
+                "pod", "view", "user", u)
+            assert mask_to_ids(mask, interner) == sorted(want)
+            # global-type lookups dedupe the replicated answers
+            assert sorted(p.lookup_resources(
+                "namespace", "view", "user", u)) == sorted(
+                    oracle.lookup_resources("namespace", "view",
+                                            "user", u))
+        # LookupSubjects parity on a namespaced and a global anchor
+        assert p.lookup_subjects("pod", "ns0/p0", "view", "user") == \
+            oracle.lookup_subjects("pod", "ns0/p0", "view", "user")
+        assert p.lookup_subjects("namespace", "ns0", "view", "user") \
+            == oracle.lookup_subjects("namespace", "ns0", "view",
+                                      "user")
+        # check parity over a sample
+        items = [CheckItem("pod", f"ns{i}/p0", "view", "user",
+                           f"u{i % n_users}") for i in range(n_ns)]
+        assert p.check_bulk(items) == oracle.check_bulk(items)
+        p.close()
+
+
+def test_read_and_exists_route_and_dedupe():
+    p, engines = _planner(2)
+    p.write_relationships([
+        WriteOp("create", rel("namespace", "ns1", "creator", "user",
+                              "al")),
+        WriteOp("create", rel("pod", "ns1/p0", "viewer", "user", "al")),
+        WriteOp("create", rel("pod", "ns2/p0", "viewer", "user", "bo")),
+    ])
+    # replicated global rows come back ONCE
+    got = p.read_relationships(RelationshipFilter(
+        resource_type="namespace", resource_id="ns1"))
+    assert len(got) == 1
+    # unanchored read unions disjoint namespaced slices
+    got = p.read_relationships(RelationshipFilter(resource_type="pod"))
+    assert {r.resource_id for r in got} == {"ns1/p0", "ns2/p0"}
+    assert p.exists(RelationshipFilter(resource_type="pod",
+                                       resource_id="ns2/p0"))
+    assert not p.exists(RelationshipFilter(resource_type="pod",
+                                           resource_id="ns3/p9"))
+    # global delete converges on every replica and counts ONE copy
+    n = p.delete_relationships(RelationshipFilter(
+        resource_type="namespace", resource_id="ns1"))
+    assert n == 1
+    for e in engines:
+        assert not e.store.exists(RelationshipFilter(
+            resource_type="namespace", resource_id="ns1"))
+    p.close()
+
+
+# -- revision-vector merge edge cases (satellite) ----------------------------
+
+
+def test_gather_at_mixed_revisions_is_not_torn():
+    """Shards at DIFFERENT revisions gather into a mask consistent with
+    each shard's own revision: advancing ONE shard changes only that
+    shard's slice of the union, and the vector shows exactly which
+    component moved."""
+    p, engines = _planner(2)
+    sa = p.map.shard_of("pod", "nsa/p0")
+    sb = p.map.shard_of("pod", "nsb/p0")
+    assert sa != sb, "fixture namespaces must land on distinct shards"
+    p.write_relationships([WriteOp(
+        "create", rel("pod", "nsa/p0", "viewer", "user", "al"))])
+    v1 = p.revision_vector()
+    assert sorted(p.lookup_resources("pod", "view", "user", "al")) == \
+        ["nsa/p0"]
+    # advance ONLY shard sb
+    p.write_relationships([WriteOp(
+        "create", rel("pod", "nsb/p1", "viewer", "user", "al"))])
+    v2 = p.revision_vector()
+    assert v2[sb] > v1[sb] and v2[sa] == v1[sa]
+    # the gather now reflects sb's new revision AND sa's old one —
+    # each shard answers at its own revision, no torn cross-shard view
+    assert sorted(p.lookup_resources("pod", "view", "user", "al")) == \
+        ["nsa/p0", "nsb/p1"]
+    p.close()
+
+
+def test_vector_cache_never_serves_after_component_advance():
+    cache = ShardVectorCache()
+    p, engines = _planner(2, cache=cache)
+    p.write_relationships([WriteOp(
+        "create", rel("pod", "nsa/p0", "viewer", "user", "al"))])
+    items = [CheckItem("pod", "nsa/p0", "view", "user", "al")]
+    assert p.try_cached_check(items) is None  # cold
+    assert p.check_bulk(items) == [True]
+    got = p.try_cached_check(items)
+    assert got == [True]  # hot at the current vector
+    # advance ONE component (a write to the OTHER shard): the old-vector
+    # entry must never serve again
+    p.write_relationships([WriteOp(
+        "create", rel("pod", "nsb/p0", "viewer", "user", "bo"))])
+    assert p.try_cached_check(items) is None
+    # context fragments the key
+    assert p.check_bulk(items) == [True]
+    assert p.try_cached_check(items) == [True]
+    assert p.try_cached_check(items, context={"ip": "1.2.3.4"}) is None
+    p.close()
+
+
+def test_vector_cache_unit_semantics():
+    c = ShardVectorCache(max_entries=2)
+    v1 = RevisionVector((1, 1))
+    v2 = RevisionVector((2, 1))
+    c.put("k", v1, [True])
+    assert c.get("k", v1) == [True]
+    assert c.get("k", v2) is None  # exact-vector match only
+    c.retire_below(v2)  # v1 dominated by v2 -> gone
+    assert c.get("k", v1) is None
+    c.put("a", v1, [1])
+    c.put("b", v1, [2])
+    c.put("c", v1, [3])  # LRU bound
+    assert c.get("a", v1) is None and c.get("c", v1) == [3]
+
+
+# -- cross-shard split writes (dtx journal) ----------------------------------
+
+
+class _FlakyWrites:
+    """Delegating engine wrapper whose write_relationships dies (after
+    optionally applying) — the mid-split crash injector."""
+
+    def __init__(self, inner, fail_times: int = 1,
+                 apply_before_dying: bool = False):
+        self._inner = inner
+        self.fail_times = fail_times
+        self.apply_before_dying = apply_before_dying
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def write_relationships(self, ops, preconditions=()):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            if self.apply_before_dying:
+                self._inner.write_relationships(ops, preconditions)
+            raise ConnectionResetError("injected mid-split crash")
+        return self._inner.write_relationships(ops, preconditions)
+
+
+def test_cross_shard_split_write_journals_and_replays(tmp_path):
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    smap = _map(2)
+    # shard 1 (the SECOND applied) dies mid-split
+    flaky = ShardedEngine(
+        smap, [engines[0], _FlakyWrites(engines[1])], journal=journal)
+    ops = [
+        WriteOp("create", rel("namespace", "ns1", "creator", "user",
+                              "al")),  # global -> replicates (split!)
+        WriteOp("create", rel("pod", "nsa/p0", "viewer", "user", "al")),
+        WriteOp("create", rel("pod", "nsb/p0", "viewer", "user", "al")),
+    ]
+    with pytest.raises(ConnectionResetError):
+        flaky.write_relationships(ops)
+    # the crash left a PENDING journal entry with partial progress
+    assert journal.pending_count() == 1
+    ent = journal.pending()[0]
+    assert 0 in ent["applied"] and 1 not in ent["applied"]
+    # shard 0 applied, shard 1 did not: visibly half-applied ONLY
+    # through the journal (reads would miss shard 1's slice)
+    assert engines[0].store.exists(RelationshipFilter(
+        resource_type="namespace", resource_id="ns1"))
+    assert not engines[1].store.exists(RelationshipFilter(
+        resource_type="namespace", resource_id="ns1"))
+    # "restart": a NEW planner over the same journal replays the split
+    # to completion (creates degraded to touches — idempotent)
+    p2 = ShardedEngine(smap, engines, journal=journal)
+    assert journal.pending_count() == 0
+    for e in engines:
+        assert e.store.exists(RelationshipFilter(
+            resource_type="namespace", resource_id="ns1"))
+    assert p2.check(CheckItem("pod", "nsa/p0", "view", "user", "al"))
+    assert p2.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    p2.close()
+
+
+def test_replay_idempotent_when_shard_applied_before_crash(tmp_path):
+    """The other torn shape: the shard APPLIED the sub-write but the
+    crash landed before mark_applied — replay re-touches (never a
+    duplicate-create error) and converges."""
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    smap = _map(2)
+    flaky = ShardedEngine(
+        smap,
+        [engines[0], _FlakyWrites(engines[1], apply_before_dying=True)],
+        journal=journal)
+    with pytest.raises(ConnectionResetError):
+        flaky.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "al")),
+        ])
+    assert journal.pending_count() == 1
+    p2 = ShardedEngine(smap, engines, journal=journal)
+    assert journal.pending_count() == 0
+    assert p2.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    # exactly one tuple, not two
+    assert len(p2.read_relationships(RelationshipFilter(
+        resource_type="pod", resource_id="nsb/p0"))) == 1
+    p2.close()
+
+
+class _RejectingWrites:
+    """Delegating wrapper whose write_relationships REJECTS (the engine
+    answered — provably nothing applied)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def write_relationships(self, ops, preconditions=()):
+        from spicedb_kubeapi_proxy_tpu.engine.store import (
+            PreconditionFailed,
+        )
+
+        raise PreconditionFailed("injected engine-answered rejection")
+
+
+def test_first_shard_rejection_closes_the_journal_entry(tmp_path):
+    """A split whose FIRST shard REJECTS (the engine answered:
+    precondition/schema) applied nothing anywhere: the journal entry is
+    finished, not replayed — the caller saw the error and recovery must
+    not resurrect the write."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import (
+        PreconditionFailed,
+    )
+
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    flaky = ShardedEngine(
+        _map(2), [_RejectingWrites(engines[0]), engines[1]],
+        journal=journal)
+    with pytest.raises(PreconditionFailed):
+        flaky.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "al")),
+        ])
+    assert journal.pending_count() == 0
+    p2 = ShardedEngine(_map(2), engines, journal=journal)
+    assert not p2.check(CheckItem("pod", "nsb/p0", "view", "user",
+                                  "al"))
+    p2.close()
+
+
+def test_first_shard_transport_death_stays_pending(tmp_path):
+    """A TRANSPORT failure on the first shard is ambiguous — the write
+    may have applied even though the caller saw an error. The entry
+    stays pending and recovery touch-replays everything: at-LEAST-once
+    under ambiguity, never silently half-applied."""
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    flaky = ShardedEngine(
+        _map(2),
+        [_FlakyWrites(engines[0], apply_before_dying=True),
+         engines[1]],
+        journal=journal)
+    with pytest.raises(ConnectionResetError):
+        flaky.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "al")),
+        ])
+    assert journal.pending_count() == 1
+    p2 = ShardedEngine(_map(2), engines, journal=journal)
+    assert journal.pending_count() == 0
+    # BOTH shards converged (shard 0's leg had applied pre-crash; the
+    # touch replay was idempotent)
+    assert p2.check(CheckItem("pod", "nsa/p0", "view", "user", "al"))
+    assert p2.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    assert len(p2.read_relationships(RelationshipFilter(
+        resource_type="pod", resource_id="nsa/p0"))) == 1
+    p2.close()
+
+
+def test_later_shard_precondition_cannot_reject():
+    """Every precondition decision point sits at or before the FIRST
+    shard's apply (anchored-global pcs bind on the first split shard;
+    later-shard owners are probed up front) — so a pending journal
+    entry is always safe to replay with preconditions stripped."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import (
+        Precondition,
+        PreconditionFailed,
+    )
+
+    p, engines = _planner(2)
+    # a namespaced pc whose owner is NOT the first split shard: probed
+    # up front, so a failing one aborts BEFORE anything applies
+    ns0 = next(f"q{i}" for i in range(64)
+               if p.map.shard_for(f"q{i}", "pod") == 0)
+    ns1 = next(f"q{i}" for i in range(64)
+               if p.map.shard_for(f"q{i}", "pod") == 1)
+    pc = Precondition(RelationshipFilter(
+        resource_type="pod", resource_id=f"{ns1}/p9",
+        relation="viewer"), must_exist=True)
+    with pytest.raises(PreconditionFailed):
+        p.write_relationships([
+            WriteOp("create", rel("pod", f"{ns0}/p1", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", f"{ns1}/p1", "viewer", "user",
+                                  "al")),
+        ], [pc])
+    # nothing applied on either shard, nothing pending
+    assert not p.exists(RelationshipFilter(resource_type="pod",
+                                           resource_id=f"{ns0}/p1"))
+    assert not p.exists(RelationshipFilter(resource_type="pod",
+                                           resource_id=f"{ns1}/p1"))
+    p.close()
+
+
+# -- per-shard admission (satellite of the tentpole) -------------------------
+
+
+class _SheddingEngine:
+    def __init__(self, inner, retry_after: float):
+        self._inner = inner
+        self.retry_after = retry_after
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def lookup_resources(self, *a, **kw):
+        raise AdmissionRejected("lookup-prefilter", "host full",
+                                retry_after=self.retry_after,
+                                dependency="engine-admission")
+
+
+def test_partial_shed_scatter_fails_closed_max_retry_after():
+    engines = [_engine(), _engine(), _engine()]
+    p = ShardedEngine(_map(3), [
+        engines[0],
+        _SheddingEngine(engines[1], 2.0),
+        _SheddingEngine(engines[2], 7.0),
+    ])
+    before = metrics.counter("scaleout_partial_shed_total").value
+    with pytest.raises(AdmissionRejected) as ei:
+        p.lookup_resources("pod", "view", "user", "al")
+    # fails CLOSED (never a partial union), Retry-After = max over the
+    # shedding shards, its own dependency label
+    assert ei.value.retry_after == 7.0
+    assert ei.value.dependency == "shard-admission"
+    assert metrics.counter(
+        "scaleout_partial_shed_total").value == before + 1
+    p.close()
+
+
+def test_admission_fanout_and_scaled_cost():
+    p, _ = _planner(4)
+    # scatter classes charge once per touched shard
+    assert p.admission_fanout(LOOKUP_PREFILTER) == 4
+    assert p.admission_fanout(WATCH_RECOMPUTE) == 4
+    # anchored classes stay 1x
+    assert p.admission_fanout(CHECK) == 1
+    assert p.admission_fanout(WRITE_DTX) == 1
+    scaled = LOOKUP_PREFILTER.scaled(4)
+    assert scaled.weight == LOOKUP_PREFILTER.weight * 4
+    assert scaled.name == LOOKUP_PREFILTER.name  # same shed/metric label
+    assert scaled.priority == LOOKUP_PREFILTER.priority
+    assert CHECK.scaled(1) is CHECK
+    p.close()
+
+
+def test_middleware_charges_scatter_per_shard():
+    """End-to-end through the authz middleware: a list-prefilter against
+    a 3-group planner acquires 3x the lookup weight from the proxy-side
+    admission controller."""
+    import asyncio
+
+    from spicedb_kubeapi_proxy_tpu.authz.middleware import (
+        AuthzDeps,
+        authorize,
+    )
+    from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import (
+        parse_request_info,
+    )
+    from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest
+    from spicedb_kubeapi_proxy_tpu.rules.input import UserInfo
+    from spicedb_kubeapi_proxy_tpu.rules.matcher import MapMatcher
+
+    RULES = open(os.path.join(os.path.dirname(__file__), "..",
+                              "deploy", "rules.yaml")).read()
+
+    class _RecordingAdmission:
+        def __init__(self):
+            self.classes = []
+
+        async def acquire_async(self, tenant, cls):
+            self.classes.append(cls)
+
+            class _T:
+                def release(self, observe=True):
+                    pass
+
+            return _T()
+
+    async def fake_upstream(req):
+        from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyResponse
+
+        return ProxyResponse(status=200, headers={
+            "Content-Type": "application/json"},
+            body=b'{"kind":"NamespaceList","items":[]}')
+
+    p, _ = _planner(3)
+    adm = _RecordingAdmission()
+    deps = AuthzDeps(matcher=MapMatcher.from_yaml(RULES), engine=p,
+                     upstream=fake_upstream, admission=adm)
+    req = ProxyRequest(
+        method="GET", path="/api/v1/namespaces", query={}, headers={},
+        body=b"",
+        request_info=parse_request_info("GET", "/api/v1/namespaces",
+                                        {}),
+        user=UserInfo(name="alice"))
+    resp = asyncio.run(authorize(req, deps))
+    assert resp.status == 200
+    assert len(adm.classes) == 1
+    cls = adm.classes[0]
+    assert cls.name == "lookup-prefilter"
+    assert cls.weight == LOOKUP_PREFILTER.weight * 3  # 3 shards
+    p.close()
+
+
+# -- watch streams -----------------------------------------------------------
+
+
+def test_sharded_watch_stream_vector_resumption():
+    p, engines = _planner(2)
+    stream = p.watch_push_stream(p.map.zero_vector())
+    try:
+        p.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "bo")),
+        ])
+        seen = []
+        deadline = time.monotonic() + 10
+        while len(seen) < 2 and time.monotonic() < deadline:
+            seen.extend(stream.next_batch())
+        assert len(seen) >= 2
+        # revisions are VECTORS, monotone along the merged stream
+        vecs = [e.revision for e in seen]
+        assert all(isinstance(v, RevisionVector) for v in vecs)
+        for a, b in zip(vecs, vecs[1:]):
+            assert b.dominates(a)
+        # resuming from the final vector replays nothing
+        assert p.watch_since(vecs[-1]) == []
+        # resuming from zero replays both shards' events, stamped
+        # monotonically
+        replay = p.watch_since(p.map.zero_vector())
+        assert {e.relationship.resource_id for e in replay} == {
+            "nsa/p0", "nsb/p0"}
+    finally:
+        stream.close()
+        p.close()
+
+
+# -- /readyz sharding line (satellite) ---------------------------------------
+
+
+def test_readyz_reports_sharding_line(tmp_path):
+    import asyncio
+
+    from fake_kube import FakeKube
+    from spicedb_kubeapi_proxy_tpu.engine.remote import EngineServer
+    from spicedb_kubeapi_proxy_tpu.proxy.inmemory import InMemoryClient
+    from spicedb_kubeapi_proxy_tpu.proxy.options import Options
+
+    RULES = open(os.path.join(os.path.dirname(__file__), "..",
+                              "deploy", "rules.yaml")).read()
+
+    async def go():
+        srvs = [EngineServer(_engine()), EngineServer(_engine())]
+        ports = [await s.start() for s in srvs]
+        smap = ('{"version": 2, "groups": [["127.0.0.1:%d"], '
+                '["127.0.0.1:%d"]]}' % (ports[0], ports[1]))
+        cfg = Options(
+            shard_map=smap,
+            shard_journal_path=str(tmp_path / "sj.sqlite"),
+            engine_insecure=True,
+            rule_content=RULES,
+            upstream=FakeKube(),
+            workflow_database_path=str(tmp_path / "dtx.sqlite"),
+        ).complete()
+        assert isinstance(cfg.engine, ShardedEngine)
+        await cfg.workflow.resume_pending()
+        alice = InMemoryClient(cfg.server.handle, user="alice")
+        resp = await alice.get("/readyz")
+        assert resp.status == 200, resp.body
+        body = resp.body.decode()
+        assert "[+]sharding: groups=2 map_version=2" in body
+        assert "g0=leader" in body and "g1=leader" in body
+        assert "pending_splits=0" in body
+        # and requests actually flow through the planner
+        resp = await alice.get("/api/v1/namespaces")
+        assert resp.status == 200
+        await cfg.workflow.shutdown()
+        cfg.engine.close()
+        for s in srvs:
+            await s.stop()
+
+    asyncio.run(go())
+
+
+def test_options_validation():
+    from spicedb_kubeapi_proxy_tpu.proxy.options import (
+        Options,
+        OptionsError,
+    )
+
+    good = '{"version": 1, "groups": [["127.0.0.1:1"]]}'
+    with pytest.raises(OptionsError, match="mutually exclusive"):
+        Options(shard_map=good, engine_endpoint="tcp://h:1",
+                rule_content="x", upstream=object()).validate()
+    with pytest.raises(OptionsError, match="bootstrap"):
+        Options(shard_map=good, bootstrap_content="x",
+                rule_content="x", upstream=object()).validate()
+    with pytest.raises(OptionsError):
+        Options(shard_map='{"version": 0, "groups": [["h:1"]]}',
+                rule_content="x", upstream=object()).validate()
+
+
+# -- the end-to-end acceptance: 2 groups over real TCP -----------------------
+
+
+_HOST_WORKER = r"""
+import os, sys
+mode = sys.argv[1]
+bootstrap = sys.argv[2]
+repo = sys.argv[-1]
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, repo)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spicedb_kubeapi_proxy_tpu.engine.remote import main
+
+print("HOST STARTING", flush=True)
+if mode == "peer":
+    peer_id, port0, port1, data_dir = sys.argv[3:7]
+    sys.exit(main([
+        "--bootstrap", bootstrap,
+        "--peers", "127.0.0.1:%s,127.0.0.1:%s" % (port0, port1),
+        "--peer-id", peer_id,
+        "--bind-port", port0 if peer_id == "0" else port1,
+        "--token", "sh-tok", "--engine-insecure",
+        "--data-dir", data_dir, "--wal-fsync", "always",
+        "--mirror-heartbeat-seconds", "0.3",
+        "--failover-boot-grace", "30",
+    ]))
+else:
+    port = sys.argv[3]
+    sys.exit(main([
+        "--bootstrap", bootstrap,
+        "--bind-port", port,
+        "--token", "sh-tok", "--engine-insecure",
+    ]))
+"""
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_e2e_two_tcp_groups_failover_and_split_replay(tmp_path):
+    """The ISSUE 11 acceptance run, over REAL TCP engine hosts:
+
+    - group 0 = a 2-peer failover set, group 1 = a single host;
+    - single-shard checks answer with NO scatter (op counters);
+    - scatter-gathered prefilter ids match an unsharded oracle
+      byte-for-byte over the same tuples;
+    - a cross-shard write interrupted mid-split replays to a consistent
+      state on "restart" (a fresh planner over the same journal);
+    - SIGKILL of group 0's leader fails over WITHOUT disturbing group 1
+      (its checks keep answering throughout the election window).
+    """
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        FailoverEngine,
+        RemoteEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+        DependencyUnavailable,
+    )
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    script = str(tmp_path / "host_worker.py")
+    with open(script, "w") as f:
+        f.write(_HOST_WORKER)
+    bootstrap = str(tmp_path / "bootstrap.yaml")
+    with open(bootstrap, "w") as f:
+        f.write(SCHEMA_YAML)
+    g0p0, g0p1, g1p = _free_port(), _free_port(), _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    def boot_peer(peer_id):
+        return subprocess.Popen(
+            [sys.executable, script, "peer", bootstrap, str(peer_id),
+             str(g0p0), str(g0p1), str(tmp_path / f"data{peer_id}"),
+             repo_root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo_root)
+
+    def boot_single():
+        return subprocess.Popen(
+            [sys.executable, script, "single", bootstrap, str(g1p),
+             repo_root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo_root)
+
+    procs = {"p0": boot_peer(0), "p1": boot_peer(1),
+             "g1": boot_single()}
+    smap = ShardMap(version=1, groups=(
+        (("127.0.0.1", g0p0), ("127.0.0.1", g0p1)),
+        (("127.0.0.1", g1p),)))
+    journal = SplitJournal(str(tmp_path / "journal.sqlite"))
+    planner = None
+    client_kw = dict(connect_timeout=2.0, timeout=20.0, retries=0)
+
+    def make_groups():
+        return [
+            FailoverEngine([("127.0.0.1", g0p0), ("127.0.0.1", g0p1)],
+                           token="sh-tok", probe_timeout=2.0,
+                           resolve_deadline=3.0, **client_kw),
+            RemoteEngine("127.0.0.1", g1p, token="sh-tok",
+                         **client_kw),
+        ]
+
+    def wait_ready(budget=120.0):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            for p in procs.values():
+                assert p.poll() is None, p.communicate()[0][-3000:]
+            ok = 0
+            for port in (g0p0, g0p1, g1p):
+                probe = RemoteEngine("127.0.0.1", port, token="sh-tok",
+                                     timeout=2.0, connect_timeout=2.0,
+                                     retries=0)
+                try:
+                    st = probe.failover_state()
+                    if st["role"] == "leader":
+                        ok += 1
+                except Exception:
+                    pass
+                finally:
+                    probe.close()
+            if ok >= 2:  # group 0's leader + the single host
+                return
+            time.sleep(0.3)
+        raise AssertionError("engine hosts never became ready")
+
+    try:
+        wait_ready()
+        planner = ShardedEngine(smap, make_groups(), journal=journal)
+        # find namespaces owned by each group under THIS map
+        ns_g0 = next(f"ns{i}" for i in range(64)
+                     if smap.shard_of("pod", f"ns{i}/p") == 0)
+        ns_g1 = next(f"ns{i}" for i in range(64)
+                     if smap.shard_of("pod", f"ns{i}/p") == 1)
+
+        # seed: a cross-shard write (global namespaces + both groups'
+        # pods) through the journaled split path
+        writes = [
+            WriteOp("create", rel("namespace", ns_g0, "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("namespace", ns_g1, "viewer", "user",
+                                  "bo")),
+            WriteOp("create", rel("pod", f"{ns_g0}/p0", "namespace",
+                                  "namespace", ns_g0)),
+            WriteOp("create", rel("pod", f"{ns_g1}/p0", "namespace",
+                                  "namespace", ns_g1)),
+            WriteOp("create", rel("pod", f"{ns_g0}/p0", "viewer",
+                                  "user", "solo")),
+        ]
+        planner.write_relationships(list(writes))
+        assert journal.pending_count() == 0
+
+        # (a) single-shard checks: NO scatter, counter-verified
+        s_before = _ops_count("scatter")
+        assert planner.check(CheckItem("pod", f"{ns_g0}/p0", "view",
+                                       "user", "al"))
+        assert planner.check(CheckItem("pod", f"{ns_g1}/p0", "view",
+                                       "user", "bo"))
+        assert not planner.check(CheckItem("pod", f"{ns_g1}/p0",
+                                           "view", "user", "al"))
+        assert _ops_count("scatter") == s_before
+
+        # (b) scatter-gather parity vs an unsharded oracle
+        oracle = _engine()
+        oracle.write_relationships(list(writes))
+        for u in ("al", "bo", "solo"):
+            assert sorted(planner.lookup_resources(
+                "pod", "view", "user", u)) == sorted(
+                    oracle.lookup_resources("pod", "view", "user", u))
+
+        # (c) cross-shard write interrupted mid-split replays on
+        # restart: group 1's leg dies after group 0 applied
+        flaky_groups = make_groups()
+        flaky_groups[1] = _FlakyWrites(flaky_groups[1])
+        flaky = ShardedEngine(smap, flaky_groups, journal=journal,
+                              recover=False)
+        with pytest.raises(ConnectionResetError):
+            flaky.write_relationships([
+                WriteOp("create", rel("pod", f"{ns_g0}/p1", "viewer",
+                                      "user", "cr")),
+                WriteOp("create", rel("pod", f"{ns_g1}/p1", "viewer",
+                                      "user", "cr")),
+            ])
+        assert journal.pending_count() == 1
+        flaky.close(close_journal=False)  # the journal outlives the
+        #                                   "crashed" planner
+        planner2 = ShardedEngine(smap, make_groups(), journal=journal,
+                                 recover=True)  # "the restart"
+        assert journal.pending_count() == 0
+        assert planner2.check(CheckItem("pod", f"{ns_g0}/p1", "view",
+                                        "user", "cr"))
+        assert planner2.check(CheckItem("pod", f"{ns_g1}/p1", "view",
+                                        "user", "cr"))
+
+        # (d) SIGKILL group 0's leader: group 1 undisturbed throughout
+        leader_port = None
+        for port, proc_key in ((g0p0, "p0"), (g0p1, "p1")):
+            probe = RemoteEngine("127.0.0.1", port, token="sh-tok",
+                                 timeout=2.0, connect_timeout=2.0,
+                                 retries=0)
+            try:
+                if probe.failover_state()["role"] == "leader":
+                    leader_port, victim = port, proc_key
+            except Exception:
+                pass
+            finally:
+                probe.close()
+        assert leader_port is not None
+        procs[victim].kill()
+        procs[victim].wait(timeout=10)
+        t_kill = time.monotonic()
+        g0_recovered = False
+        g1_failures = 0
+        while time.monotonic() - t_kill < 45:
+            # group 1's slice keeps answering DURING the election
+            try:
+                assert planner2.check(CheckItem(
+                    "pod", f"{ns_g1}/p0", "view", "user", "bo"))
+            except (DependencyUnavailable, OSError):
+                g1_failures += 1
+            try:
+                if planner2.check(CheckItem(
+                        "pod", f"{ns_g0}/p0", "view", "user", "al")):
+                    g0_recovered = True
+                    break
+            except (DependencyUnavailable, OSError):
+                pass  # fail-closed window: expected
+            time.sleep(0.3)
+        assert g1_failures == 0, \
+            f"group 1 disturbed by group 0's failover ({g1_failures})"
+        assert g0_recovered, "group 0 never failed over"
+        planner2.close()
+    finally:
+        if planner is not None:
+            planner.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        outs = []
+        for p in procs.values():
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            outs.append(p.communicate()[0])
+    for out in outs:
+        assert "STARTING" in out, out[-1500:]
+
+
+# -- review-hardening regressions --------------------------------------------
+
+
+def test_cache_key_tolerates_list_valued_context():
+    """The middleware's request context always carries a LIST (groups):
+    the cache key must stay hashable — probe and fill, no TypeError."""
+    cache = ShardVectorCache()
+    p, _ = _planner(2, cache=cache)
+    p.write_relationships([WriteOp(
+        "create", rel("pod", "nsa/p0", "viewer", "user", "al"))])
+    items = [CheckItem("pod", "nsa/p0", "view", "user", "al")]
+    ctx = {"user": "al", "groups": ["system:authenticated", "dev"],
+           "verb": "get", "ip": "10.0.0.1"}
+    assert p.try_cached_check(items, context=ctx) is None
+    assert p.check_bulk(items, context=ctx) == [True]
+    assert p.try_cached_check(items, context=ctx) == [True]
+    # different groups list = different key
+    ctx2 = dict(ctx, groups=["other"])
+    assert p.try_cached_check(items, context=ctx2) is None
+    p.close()
+
+
+def test_cache_entries_are_ttl_bounded():
+    """The planner cannot see engine-side verdict-flip watermarks: a
+    vector-keyed entry must stop serving after the TTL even when no
+    write advances the vector (time-window grants)."""
+    now = [0.0]
+    c = ShardVectorCache(ttl=5.0, clock=lambda: now[0])
+    v = RevisionVector((1, 1))
+    c.put("k", v, [True])
+    assert c.get("k", v) == [True]
+    now[0] = 4.9
+    assert c.get("k", v) == [True]
+    now[0] = 5.1
+    assert c.get("k", v) is None  # expired, never served stale
+
+
+def test_scatter_delete_precondition_decides_before_any_leg():
+    """A failed precondition on the decision shard aborts the WHOLE
+    scatter delete with every other shard untouched."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import (
+        Precondition,
+        PreconditionFailed,
+    )
+
+    p, engines = _planner(3)
+    p.write_relationships([
+        WriteOp("create", rel("pod", "nsa/p0", "viewer", "user", "al")),
+        WriteOp("create", rel("pod", "nsb/p0", "viewer", "user", "al")),
+        WriteOp("create", rel("pod", "nsc/p0", "viewer", "user", "al")),
+    ])
+    pc = Precondition(RelationshipFilter(
+        resource_type="namespace", resource_id="ghost"),
+        must_exist=True)
+    with pytest.raises(PreconditionFailed):
+        p.delete_relationships(
+            RelationshipFilter(resource_type="pod"), [pc])
+    # NOTHING was deleted anywhere
+    assert len(p.read_relationships(RelationshipFilter(
+        resource_type="pod"))) == 3
+    p.close()
+
+
+def test_unanchored_precondition_probed_not_bound_per_shard():
+    """An unanchored must_exist precondition over a namespaced type
+    holds when ANY shard has matching rows — it must not fail the
+    split on the shards that hold nothing."""
+    p, engines = _planner(2)
+    p.write_relationships([WriteOp(
+        "create", rel("pod", "nsa/p0", "viewer", "user", "al"))])
+    from spicedb_kubeapi_proxy_tpu.engine.store import Precondition
+
+    pc = Precondition(RelationshipFilter(resource_type="pod"),
+                      must_exist=True)
+    # cross-shard split (global + both shards) with the unanchored pc
+    p.write_relationships([
+        WriteOp("create", rel("namespace", "ns1", "creator", "user",
+                              "al")),
+        WriteOp("create", rel("pod", "nsb/p0", "viewer", "user", "al")),
+    ], [pc])
+    assert p.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    p.close()
+
+
+def test_recovery_reroutes_entries_from_a_different_map(tmp_path):
+    """A pending split journaled under a LARGER map must not crash boot
+    on a smaller one: the unapplied ops re-route through the CURRENT
+    map's owners."""
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    # simulate a 4-group deployment's crash: shard indices 0..3, 0 done
+    plan = {
+        0: [{"op": "create",
+             "rel": {"resource_type": "namespace", "resource_id": "n1",
+                     "relation": "creator", "subject_type": "user",
+                     "subject_id": "al", "subject_relation": None,
+                     "expiration": None, "caveat": None,
+                     "caveat_context": None}}],
+        3: [{"op": "create",
+             "rel": {"resource_type": "pod", "resource_id": "nsz/p0",
+                     "relation": "viewer", "subject_type": "user",
+                     "subject_id": "al", "subject_relation": None,
+                     "expiration": None, "caveat": None,
+                     "caveat_context": None}}],
+    }
+    sid = journal.begin(plan, [], map_version=9)
+    journal.mark_applied(sid, 0)
+    # boot a 2-group planner over the same journal: no IndexError, the
+    # unapplied shard-3 ops land on their CURRENT owner
+    p = ShardedEngine(_map(2, version=10),
+                      [_engine(), _engine()], journal=journal)
+    assert journal.pending_count() == 0
+    assert p.exists(RelationshipFilter(resource_type="pod",
+                                       resource_id="nsz/p0"))
+    p.close()
+
+
+def test_namespaced_lookup_subjects_routes_direct():
+    p, _ = _planner(3)
+    p.write_relationships([
+        WriteOp("create", rel("pod", "nsa/p0", "viewer", "user", "al")),
+        WriteOp("create", rel("namespace", "ns1", "viewer", "user",
+                              "gl")),
+    ])
+    s_before = _ops_count("scatter", op="lookup_subjects")
+    d_before = _ops_count("single", op="lookup_subjects")
+    assert p.lookup_subjects("pod", "nsa/p0", "view", "user") == ["al"]
+    assert _ops_count("scatter", op="lookup_subjects") == s_before
+    assert _ops_count("single", op="lookup_subjects") == d_before + 1
+    # global anchors still scatter (each shard's subject universe
+    # covers its own slice) and union exactly
+    assert p.lookup_subjects("namespace", "ns1", "view", "user") == \
+        ["gl"]
+    assert _ops_count("scatter", op="lookup_subjects") > s_before
+    p.close()
+
+
+class _DeadlineWrites:
+    """Delegating wrapper raising an AMBIGUOUS failure (an exhausted
+    deadline — DependencyUnavailable, not provably-undispatched)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def write_relationships(self, ops, preconditions=()):
+        from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+            DependencyUnavailable,
+        )
+
+        raise DependencyUnavailable("engine:x", "deadline exhausted")
+
+
+def test_first_shard_deadline_is_ambiguous_stays_pending(tmp_path):
+    """An exhausted deadline on the first shard may have dispatched
+    (FailoverEngine's own rule): the journal entry must stay pending —
+    closing it would leave a silently half-applied split if the write
+    actually landed."""
+    from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+        DependencyUnavailable,
+    )
+
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    flaky = ShardedEngine(
+        _map(2), [_DeadlineWrites(engines[0]), engines[1]],
+        journal=journal)
+    with pytest.raises(DependencyUnavailable):
+        flaky.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "al")),
+        ])
+    assert journal.pending_count() == 1
+    p2 = ShardedEngine(_map(2), engines, journal=journal)
+    assert journal.pending_count() == 0
+    assert p2.check(CheckItem("pod", "nsa/p0", "view", "user", "al"))
+    assert p2.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    p2.close()
+
+
+def test_single_shard_write_routes_cross_shard_preconditions():
+    """A single-shard write carrying a precondition owned by ANOTHER
+    shard must evaluate it against the owner (via the routed probe),
+    not against a store that doesn't hold the slice — where must_exist
+    would always fail and must_not_exist would always pass (fail
+    open)."""
+    from spicedb_kubeapi_proxy_tpu.engine.store import (
+        Precondition,
+        PreconditionFailed,
+    )
+
+    p, engines = _planner(2)
+    ns0 = next(f"q{i}" for i in range(64)
+               if p.map.shard_for(f"q{i}", "pod") == 0)
+    ns1 = next(f"q{i}" for i in range(64)
+               if p.map.shard_for(f"q{i}", "pod") == 1)
+    p.write_relationships([WriteOp(
+        "create", rel("pod", f"{ns1}/guard", "viewer", "user", "g"))])
+    guard = RelationshipFilter(resource_type="pod",
+                               resource_id=f"{ns1}/guard",
+                               relation="viewer")
+    # must_exist on the OTHER shard's tuple: holds -> write succeeds
+    p.write_relationships(
+        [WriteOp("create", rel("pod", f"{ns0}/p0", "viewer", "user",
+                               "al"))],
+        [Precondition(guard, must_exist=True)])
+    assert p.check(CheckItem("pod", f"{ns0}/p0", "view", "user", "al"))
+    # must_NOT_exist on that same tuple: fails CLOSED, nothing written
+    with pytest.raises(PreconditionFailed):
+        p.write_relationships(
+            [WriteOp("create", rel("pod", f"{ns0}/p1", "viewer",
+                                   "user", "al"))],
+            [Precondition(guard, must_exist=False)])
+    assert not p.exists(RelationshipFilter(resource_type="pod",
+                                           resource_id=f"{ns0}/p1"))
+    p.close()
+
+
+def test_boot_survives_unreachable_shard_with_pending_splits(tmp_path):
+    """Deferred recovery: a pending split plus one unreachable group
+    must NOT prevent planner construction (a one-slice outage must not
+    become a full-proxy outage). The entries stay visibly pending and
+    replay on the next healthy recover pass — including the lazy one
+    before the next split write."""
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    flaky = ShardedEngine(
+        _map(2), [engines[0], _FlakyWrites(engines[1])],
+        journal=journal)
+    with pytest.raises(ConnectionResetError):
+        flaky.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "al")),
+        ])
+    assert journal.pending_count() == 1
+    # "restart" with shard 1 STILL down: boots anyway, entry pending
+    down = ShardedEngine(
+        _map(2), [engines[0], _FlakyWrites(engines[1], fail_times=99)],
+        journal=journal)
+    assert journal.pending_count() == 1
+    assert down.sharding_status()["pending_splits"] == 1
+    # the healthy restart replays to completion
+    p2 = ShardedEngine(_map(2), engines, journal=journal)
+    assert journal.pending_count() == 0
+    assert p2.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    p2.close()
+
+
+def test_split_write_retries_deferred_recovery_first(tmp_path):
+    """The lazy recovery hook: a planner that booted with deferred
+    pending entries replays them before journaling its next split."""
+    engines = [_engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    flaky = ShardedEngine(
+        _map(2), [engines[0], _FlakyWrites(engines[1])],
+        journal=journal)
+    with pytest.raises(ConnectionResetError):
+        flaky.write_relationships([
+            WriteOp("create", rel("pod", "nsa/p0", "viewer", "user",
+                                  "al")),
+            WriteOp("create", rel("pod", "nsb/p0", "viewer", "user",
+                                  "al")),
+        ])
+    assert journal.pending_count() == 1
+    # shard 1 recovered, but this planner booted while it was down
+    # (recover=False models the deferred state): its next split write
+    # replays the backlog first
+    p2 = ShardedEngine(_map(2), engines, journal=journal,
+                       recover=False)
+    assert journal.pending_count() == 1
+    p2.write_relationships([
+        WriteOp("create", rel("namespace", "nsx", "creator", "user",
+                              "al")),
+    ])
+    assert journal.pending_count() == 0
+    assert p2.check(CheckItem("pod", "nsb/p0", "view", "user", "al"))
+    p2.close()

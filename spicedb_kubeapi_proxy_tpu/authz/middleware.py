@@ -30,6 +30,7 @@ from typing import Optional
 from ..dtx.runner import ActivityError, WorkflowEngine, WorkflowTimeout
 from ..dtx.workflow import KubeResp, LOCK_MODE_PESSIMISTIC
 from ..engine import Engine
+from ..engine.remote import EngineInternalError
 from ..obs.trace import tracer
 from ..proxy.types import ProxyRequest, ProxyResponse, kube_status
 from ..utils.metrics import metrics
@@ -49,6 +50,14 @@ WRITE_VERBS = ("create", "update", "patch", "delete")
 ALWAYS_ALLOWED_PREFIXES = ("/api", "/apis", "/openapi", "/version")
 
 WORKFLOW_RESULT_TIMEOUT = 30.0  # reference DefaultWorkflowTimeout
+
+# every fail-closed 503 carries Retry-After in [1, this] seconds: the
+# sources (breaker reset windows, admission drain estimates, shard
+# partial-shed maxima, overlay fold estimates, leaderless elections)
+# each bound their own hint, but the cap holds even if a future source
+# forgets — an unbounded Retry-After parks polite clients forever, the
+# availability failure mode the chaos invariants treat as fail-open
+RETRY_AFTER_CAP_S = 60
 
 
 @dataclass
@@ -201,6 +210,23 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
     source by term fencing, parallel/failover.py)."""
     try:
         return await _authorize_inner(req, deps)
+    except EngineInternalError as exc:
+        # a remote engine host ANSWERED kind="internal" (an exception
+        # inside its op handler, including chaos-armed server-side
+        # faults). Not a transport failure, so breakers rightly stay
+        # closed — but from this request's view the dependency failed:
+        # surface the same bounded, RETRYABLE fail-closed 503 as every
+        # other dependency failure, not a raw 500 panic (the chaos
+        # campaign's never-fail-open invariant requires
+        # deny/403/503-with-Retry-After for every injected fault; a
+        # 500 with no Retry-After strands polite clients). Scoped to
+        # the INTERNAL kind only: auth/proto/frame errors are
+        # permanent misconfigurations and must stay loud, not become
+        # endlessly-retried "transient" 503s.
+        e = DependencyUnavailable("engine-internal", str(exc),
+                                  retry_after=1.0)
+        tracer.flag("error", str(e))
+        return _fail_closed_503(e)
     except DependencyUnavailable as e:
         from ..admission import AdmissionRejected
 
@@ -230,22 +256,34 @@ async def authorize(req: ProxyRequest, deps: AuthzDeps) -> ProxyResponse:
                     metrics.counter("audit_write_errors_total").inc()
         else:
             tracer.flag("error", str(e))
-        metrics.counter("proxy_dependency_unavailable_total",
-                        dependency=e.dependency).inc()
-        resp = kube_status(
-            503, f"dependency {e.dependency} unavailable: {e}",
-            "ServiceUnavailable")
-        resp.headers["Retry-After"] = str(max(1, int(e.retry_after + 0.5)))
-        # these early rejects return BEFORE the root span's normal finish
-        # path stamps headers, and some callers (in-memory transports,
-        # tests) invoke authorize() without the server's root-span
-        # wrapper at all — stamp the trace id HERE so a shed/breaker 503
-        # is always followable from the client into /debug/traces
-        # (server.handle's setdefault then keeps this value)
-        trace_id = tracer.current_trace_id()
-        if trace_id is not None:
-            resp.headers.setdefault("X-Trace-Id", trace_id)
-        return resp
+        return _fail_closed_503(e)
+
+
+def _fail_closed_503(e: DependencyUnavailable) -> ProxyResponse:
+    """The ONE construction of the fail-closed 503: counted per
+    dependency, Retry-After clamped to [1, RETRY_AFTER_CAP_S], and
+    trace-stamped — every DependencyUnavailable source (and the
+    engine-internal wrapper above) funnels through here so a new header
+    or a cap change can never miss a branch."""
+    metrics.counter("proxy_dependency_unavailable_total",
+                    dependency=e.dependency).inc()
+    resp = kube_status(
+        503, f"dependency {e.dependency} unavailable: {e}",
+        "ServiceUnavailable")
+    retry_after = e.retry_after if isinstance(
+        e.retry_after, (int, float)) else 1.0
+    resp.headers["Retry-After"] = str(
+        min(RETRY_AFTER_CAP_S, max(1, int(retry_after + 0.5))))
+    # these early rejects return BEFORE the root span's normal finish
+    # path stamps headers, and some callers (in-memory transports,
+    # tests) invoke authorize() without the server's root-span
+    # wrapper at all — stamp the trace id HERE so a shed/breaker 503
+    # is always followable from the client into /debug/traces
+    # (server.handle's setdefault then keeps this value)
+    trace_id = tracer.current_trace_id()
+    if trace_id is not None:
+        resp.headers.setdefault("X-Trace-Id", trace_id)
+    return resp
 
 
 async def _authorize_inner(req: ProxyRequest,

@@ -59,6 +59,7 @@ from ..utils.resilience import (
     CircuitBreaker,
     Deadline,
     DependencyUnavailable,
+    RetryBudget,
     RetryPolicy,
 )
 
@@ -119,11 +120,26 @@ class NotLeaderError(DependencyUnavailable):
             retry_after=1.0)
 
 
+class RemoteEngineError(RuntimeError):
+    pass
+
+
+class EngineInternalError(RemoteEngineError):
+    """The engine host ANSWERED kind="internal": an exception inside its
+    op handler (including chaos-armed server-side faults). Distinct from
+    the RemoteEngineError base — which also covers auth/proto/frame
+    errors that are PERMANENT (wrong token, oversized frame) — so the
+    authz middleware can map only genuine host-side failures to the
+    retryable fail-closed 503 family without turning a misconfiguration
+    into an endlessly-retried "transient" outage."""
+
+
 _ERROR_KINDS = {
     "precondition": PreconditionFailed,
     "schema": SchemaViolation,
     "store": StoreError,
     "not_leader": NotLeaderError,
+    "internal": EngineInternalError,
 }
 
 # ops that are safe to retry after a transport failure even if the
@@ -142,9 +158,14 @@ _IDEMPOTENT_OPS = frozenset({
 # failpoints so chaos tests drive the same classification
 TRANSPORT_ERRORS = (OSError, FailPointError)
 
-
-class RemoteEngineError(RuntimeError):
-    pass
+# ops exempt from the server-side fault sites (engine.dispatch /
+# engine.respond): the chaos CONTROL plane and failover resolution. A
+# p=1 error/drop schedule would otherwise brick its own chaos_reset —
+# an unrecoverable host where the campaign meant a recoverable fault —
+# and blind the client-side leader discovery the campaign steers by.
+_CHAOS_EXEMPT_OPS = frozenset({
+    "chaos_arm", "chaos_reset", "chaos_status", "failover_state",
+})
 
 
 # -- codecs ------------------------------------------------------------------
@@ -247,10 +268,18 @@ class EngineServer:
     def __init__(self, engine: Engine, host: str = "127.0.0.1",
                  port: int = 0, token: Optional[str] = None,
                  ssl_context=None, max_workers: int = 64,
-                 failover_status=None, admission=None):
+                 failover_status=None, admission=None,
+                 allow_chaos: bool = False):
         from concurrent.futures import ThreadPoolExecutor
 
         self.engine = engine
+        # test-only fault plane (--enable-chaos-ops): when on, the
+        # chaos_arm/chaos_reset/chaos_status wire ops let a campaign
+        # runner install seeded fault schedules into THIS process's
+        # failpoint registry — the only way to drive deterministic
+        # multi-process chaos against subprocess engine hosts. Off by
+        # default and meant to stay off outside test topologies.
+        self.allow_chaos = allow_chaos
         self.host = host
         self.port = port
         self.token = token
@@ -355,6 +384,14 @@ class EngineServer:
                 if req is None:
                     return
                 resp = await self._dispatch(req, peer_tenant)
+                if req.get("op") not in _CHAOS_EXEMPT_OPS \
+                        and failpoints.branch("engine.respond"):
+                    # chaos: the response falls into the void — the
+                    # client sees a reset (its request MAY have applied:
+                    # exactly the ambiguity the no-retry-after-send
+                    # write rule and the split-journal pending rule are
+                    # specified against)
+                    return
                 if isinstance(resp, BinaryResult):
                     authed = True
                     writer.write(_pack_binary(resp))
@@ -411,7 +448,13 @@ class EngineServer:
                 return {"ok": False, "kind": "proto",
                         "error": f"unknown op {op!r}"}
             if self.failover_status is not None \
-                    and op not in ("failover_state", "traces"):
+                    and op not in ("failover_state", "traces",
+                                   "chaos_arm", "chaos_reset",
+                                   "chaos_status"):
+                # chaos ops are control-plane like failover_state: a
+                # campaign must be able to arm faults on FOLLOWERS (the
+                # crash/partition targets) — a role gate would restrict
+                # chaos to whichever host happens to lead
                 # traces is diagnostics like failover_state: an operator
                 # following a trace through a follower (or a deposed
                 # leader) must be able to read its fragments
@@ -457,6 +500,18 @@ class EngineServer:
                                      **{"class": cls.name}):
                         ticket = await self.admission.acquire_async(
                             tenant, cls)
+            if op not in _CHAOS_EXEMPT_OPS \
+                    and failpoints.armed("engine.dispatch"):
+                # server-side fault site (chaos schedules): runs in the
+                # WORKER thread so a delay action models a browned-out
+                # device/host without stalling the event loop, an error
+                # action a host answering with internal failures, and a
+                # crash action a hard process death mid-dispatch
+                inner0 = fn
+
+                def fn(r, _inner=inner0):  # noqa: F811
+                    failpoints.hit("engine.dispatch")
+                    return _inner(r)
             captured = tracer.capture()
             if captured is not None:
                 # run_in_executor does NOT copy contextvars: re-enter the
@@ -764,6 +819,40 @@ class EngineServer:
         into its own traces by trace_id."""
         return tracer.recent(int(req.get("limit", 64)))
 
+    # -- chaos control plane (flag-gated, test-only) -------------------------
+
+    def _chaos_gate(self) -> None:
+        if not self.allow_chaos:
+            raise StoreError(
+                "chaos ops are disabled on this host (boot with "
+                "--enable-chaos-ops to accept fault schedules)")
+
+    def _op_chaos_arm(self, req: dict):
+        """Install a seeded fault schedule (chaos/schedule.py wire form)
+        into this process's failpoint registry. Returns the schedule's
+        digest so the campaign can pin that every process armed the
+        byte-identical decision tables."""
+        self._chaos_gate()
+        from ..chaos.schedule import FaultSchedule
+
+        sched = FaultSchedule.parse(req["schedule"])
+        sched.arm()
+        return {"armed": [s.site for s in sched.specs],
+                "digest": sched.digest()}
+
+    def _op_chaos_reset(self, req: dict):
+        self._chaos_gate()
+        failpoints.disable_all()
+        return {"reset": True}
+
+    def _op_chaos_status(self, req: dict):
+        """Armed sites + trigger counts + this process's fault-history
+        digest (deterministic for a given seed and request sequence)."""
+        self._chaos_gate()
+        return {"sites": failpoints.status(),
+                "history": failpoints.history(),
+                "history_digest": failpoints.history_digest()}
+
 
 # -- client ------------------------------------------------------------------
 
@@ -864,7 +953,8 @@ class RemoteEngine:
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  breaker_failure_threshold: int = 5,
-                 breaker_reset_seconds: float = 10.0):
+                 breaker_reset_seconds: float = 10.0,
+                 retry_budget: Optional[RetryBudget] = None):
         self.host = host
         self.port = port
         self.token = token
@@ -874,6 +964,12 @@ class RemoteEngine:
         # failures on writes surface after exactly one attempt
         self.retries = retries
         self.retry_policy = retry_policy or RetryPolicy(base=0.05, cap=1.0)
+        # shared token-bucket retry allowance (utils/resilience.py
+        # RetryBudget): one budget spans the WHOLE client stack above a
+        # dependency (this client, a FailoverEngine's re-aims, a
+        # planner's scatter re-issues), so sustained failure can't
+        # multiply retries across layers. None = unbudgeted.
+        self.retry_budget = retry_budget
         self.breaker = breaker or CircuitBreaker(
             self.dependency,
             failure_threshold=breaker_failure_threshold,
@@ -999,6 +1095,8 @@ class RemoteEngine:
         payload = _pack(msg)
         attempts = (self.retries + 1) if op in _IDEMPOTENT_OPS else 1
         delays = self.retry_policy.delays()
+        if self.retry_budget is not None:
+            self.retry_budget.on_attempt()
         # ONE wall-clock budget shared by every attempt: retries against
         # a host that accepts but never answers must not multiply the
         # caller's worst-case stall to attempts * read-timeout — the
@@ -1016,6 +1114,11 @@ class RemoteEngine:
                     self.breaker.record_failure()
                     deadline.check(self.dependency)
                     if attempts <= 0:
+                        raise
+                    if self.retry_budget is not None \
+                            and not self.retry_budget.allow():
+                        # budget dry: surface the failure instead of
+                        # joining a retry storm (the refusal is counted)
                         raise
                     metrics.counter("proxy_dependency_retries_total",
                                     dependency=self.dependency).inc()
@@ -1278,6 +1381,20 @@ class RemoteEngine:
         except RemoteEngineError:
             return []
 
+    # chaos control plane (single-attempt like failover_state: arming a
+    # fault must not itself burn the retry budget it is about to test)
+
+    def chaos_arm(self, schedule_doc: dict) -> dict:
+        """Arm a fault schedule on the host (requires the host's
+        --enable-chaos-ops); returns {armed, digest}."""
+        return self._call("chaos_arm", schedule=schedule_doc)
+
+    def chaos_reset(self) -> dict:
+        return self._call("chaos_reset")
+
+    def chaos_status(self) -> dict:
+        return self._call("chaos_status")
+
 
 # -- client-side engine failover ----------------------------------------------
 
@@ -1336,14 +1453,22 @@ class FailoverEngine:
             raise RemoteEngineError("failover engine needs >= 1 endpoint")
         self.endpoints = [(h, int(p)) for h, p in endpoints]
         self.token = token
+        # ONE retry budget spans the whole failover stack: per-endpoint
+        # transport retries AND this layer's re-issues draw from the
+        # same bucket, so a dead/browned-out set can't amplify load by
+        # layers × retries (utils/resilience.py RetryBudget)
+        self.retry_budget = client_kw.get("retry_budget")
         self._clients = [RemoteEngine(h, p, token=token, **client_kw)
                          for h, p in self.endpoints]
         # dedicated probe clients: short budgets, single attempt, and a
         # breaker that never opens — resolution must stay able to ask a
         # freshly-recovered host "are you the leader yet?" even after
-        # thousands of failed probes
+        # thousands of failed probes. NO retry budget: probes are how
+        # resolution heals, and their deposits/withdrawals would distort
+        # the data-path budget.
         probe_kw = dict(client_kw)
         probe_kw.pop("breaker", None)
+        probe_kw.pop("retry_budget", None)
         probe_kw["timeout"] = probe_timeout
         probe_kw["connect_timeout"] = min(
             probe_timeout, client_kw.get("connect_timeout", probe_timeout))
@@ -1477,7 +1602,15 @@ class FailoverEngine:
             self._resolve()
             raise cause
         # re-resolve (bounded by resolve_deadline — an election takes
-        # heartbeat-timeout + promotion time) and re-issue
+        # heartbeat-timeout + promotion time) and re-issue. The re-issue
+        # is a RETRY of the logical op: it draws from the shared budget,
+        # so a whole fleet re-aiming at a browned-out set stays bounded.
+        if self.retry_budget is not None and not self.retry_budget.allow():
+            raise DependencyUnavailable(
+                self.dependency,
+                f"retry budget for {self.dependency} exhausted during "
+                "failover re-aim",
+                retry_after=1.0) from cause
         deadline = time.monotonic() + self._resolve_deadline
         while not self._resolve():
             if time.monotonic() >= deadline:
@@ -1562,6 +1695,27 @@ class FailoverEngine:
                 out.extend(c.fetch_traces(limit))
             except Exception:  # noqa: BLE001 - diagnostics best-effort
                 continue
+        return out
+
+    def chaos_arm(self, schedule_doc: dict) -> dict:
+        """Arm a fault schedule on EVERY reachable endpoint of the set
+        (a campaign targets the whole replication group — the fault must
+        survive a failover). Returns {endpoint: result-or-error}."""
+        out: dict = {}
+        for c in self._clients:
+            try:
+                out[c.dependency] = c.chaos_arm(schedule_doc)
+            except Exception as e:  # noqa: BLE001 - report per endpoint
+                out[c.dependency] = {"error": repr(e)}
+        return out
+
+    def chaos_reset(self) -> dict:
+        out: dict = {}
+        for c in self._clients:
+            try:
+                out[c.dependency] = c.chaos_reset()
+            except Exception as e:  # noqa: BLE001 - report per endpoint
+                out[c.dependency] = {"error": repr(e)}
         return out
 
     @property
@@ -1772,6 +1926,14 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-slow-ms", type=float, default=250.0,
                     help="ops at or above this duration are always kept "
                          "by tail sampling")
+    ap.add_argument("--enable-chaos-ops", action="store_true",
+                    help="TEST ONLY: accept chaos_arm/chaos_reset/"
+                         "chaos_status wire ops that install seeded "
+                         "fault schedules (error/drop/delay/crash) into "
+                         "this process's failpoint registry — how the "
+                         "chaos campaign drives deterministic faults on "
+                         "subprocess engine hosts. Never enable in "
+                         "production")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     if not 0.0 <= args.trace_sample <= 1.0:
@@ -1978,9 +2140,13 @@ def main(argv=None) -> int:
                  args.admission_tenant_queue_depth,
                  args.admission_queue_depth,
                  args.admission_queue_timeout)
+    if args.enable_chaos_ops:
+        log.warning("chaos ops ENABLED: this host accepts wire-armed "
+                    "fault schedules (test topologies only)")
     server = EngineServer(engine, args.bind_host, args.bind_port,
                           token=args.token, ssl_context=server_ssl,
-                          admission=admission)
+                          admission=admission,
+                          allow_chaos=args.enable_chaos_ops)
     coordinator = None
     if peers is not None:
         from ..parallel.failover import FailoverCoordinator

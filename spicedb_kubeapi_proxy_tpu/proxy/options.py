@@ -264,6 +264,17 @@ class Options:
     # circuit waits before admitting a half-open probe
     breaker_failure_threshold: int = 5
     breaker_reset_seconds: float = 10.0
+    # layered retry budgets (utils/resilience.RetryBudget): ONE token
+    # bucket per dependency stack — the upstream gets its own, and a
+    # single shared bucket spans the whole engine client stack
+    # (RemoteEngine transport retries, FailoverEngine re-aims, planner
+    # scatter re-issues), so a shard brownout is bounded to
+    # burst + ratio × attempts total retries instead of
+    # N_layers × N_retries × attempts (metastable-failure guard).
+    # ratio = tokens deposited per first attempt; burst = bucket cap.
+    # ratio 0 with a huge burst approximates unbudgeted retries.
+    retry_budget_ratio: float = 0.1
+    retry_budget_burst: float = 20.0
     # -- admission control (admission/) --------------------------------------
     # cost-classed, per-tenant (= authenticated user) fair queueing with
     # an adaptive concurrency limit and priority load shedding in front
@@ -442,6 +453,10 @@ class Options:
             raise OptionsError("breaker-failure-threshold must be >= 1")
         if self.breaker_reset_seconds < 0:
             raise OptionsError("breaker-reset-seconds must be >= 0")
+        if self.retry_budget_ratio < 0:
+            raise OptionsError("retry-budget-ratio must be >= 0")
+        if self.retry_budget_burst < 1:
+            raise OptionsError("retry-budget-burst must be >= 1")
         if self.admission:
             from ..admission import validate_config
 
@@ -590,6 +605,14 @@ class Options:
                         self.engine_client_key_file)
                 except TLSConfigError as e:
                     raise OptionsError(str(e)) from None
+            from ..utils.resilience import RetryBudget
+
+            # ONE budget for the WHOLE engine client stack: every
+            # group's RemoteEngine/FailoverEngine and the planner's
+            # scatter re-issues draw from the same bucket
+            engine_budget = RetryBudget(
+                "engine-stack", ratio=self.retry_budget_ratio,
+                burst=self.retry_budget_burst)
             client_kw = dict(
                 ssl_context=ssl_context,
                 server_hostname=self.engine_server_name,
@@ -597,7 +620,8 @@ class Options:
                 timeout=self.engine_read_timeout,
                 retries=self.engine_retries,
                 breaker_failure_threshold=self.breaker_failure_threshold,
-                breaker_reset_seconds=self.breaker_reset_seconds)
+                breaker_reset_seconds=self.breaker_reset_seconds,
+                retry_budget=engine_budget)
             if self.shard_map:
                 # scale-out (scaleout/): one client per engine GROUP
                 # (multi-endpoint groups get client-side leader
@@ -640,7 +664,8 @@ class Options:
                 engine = ShardedEngine(
                     smap, groups, journal=SplitJournal(journal_path),
                     cache=(ShardVectorCache() if self.shard_cache
-                           else None))
+                           else None),
+                    retry_budget=engine_budget)
             elif len(remote) == 1:
                 engine = RemoteEngine(*remote[0],
                                       token=self.engine_token,
@@ -697,6 +722,7 @@ class Options:
                     max_mask_bytes=self.authz_cache_mask_bytes)
         upstream = self.upstream
         if upstream is None:
+            from ..utils.resilience import RetryBudget as _RB
             from .kubeconfig import UpstreamConfig
 
             if self.upstream_url:
@@ -729,6 +755,9 @@ class Options:
                 retries=self.upstream_retries,
                 breaker_failure_threshold=self.breaker_failure_threshold,
                 breaker_reset_seconds=self.breaker_reset_seconds,
+                retry_budget=_RB("upstream",
+                                 ratio=self.retry_budget_ratio,
+                                 burst=self.retry_budget_burst),
             )
         # durable dual-writes live with the durable store: an unset path
         # lands the workflow DB inside --data-dir when one is configured
@@ -889,6 +918,7 @@ class Options:
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
         "breaker_reset_seconds",
+        "retry_budget_ratio", "retry_budget_burst",
         "admission", "admission_initial_concurrency",
         "admission_min_concurrency", "admission_max_concurrency",
         "admission_tenant_rate", "admission_tenant_burst",
@@ -1176,6 +1206,17 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--breaker-reset-seconds", type=float, default=10.0,
                         help="how long an open circuit waits before "
                              "admitting a half-open probe")
+    parser.add_argument("--retry-budget-ratio", type=float, default=0.1,
+                        help="layered retry budget: tokens deposited per "
+                             "first attempt (each retry anywhere in the "
+                             "dependency stack — transport retry, "
+                             "failover re-aim, scatter re-issue — "
+                             "withdraws one), bounding steady-state "
+                             "retry amplification")
+    parser.add_argument("--retry-budget-burst", type=float, default=20.0,
+                        help="layered retry budget: bucket capacity (the "
+                             "transient-blip allowance before retries "
+                             "are rationed to the ratio)")
     parser.add_argument("--admission", type=parse_bool_flag, nargs="?",
                         const=True, default=False, metavar="BOOL",
                         help="admission control: cost-classed, per-tenant "
@@ -1333,6 +1374,8 @@ def options_from_args(args: argparse.Namespace) -> Options:
         engine_retries=args.engine_retries,
         breaker_failure_threshold=args.breaker_failure_threshold,
         breaker_reset_seconds=args.breaker_reset_seconds,
+        retry_budget_ratio=args.retry_budget_ratio,
+        retry_budget_burst=args.retry_budget_burst,
         admission=args.admission,
         admission_initial_concurrency=args.admission_initial_concurrency,
         admission_min_concurrency=args.admission_min_concurrency,

@@ -17,7 +17,12 @@ from urllib.parse import urlsplit
 
 from ..utils.failpoints import FailPointError, failpoints
 from ..utils.metrics import metrics
-from ..utils.resilience import CircuitBreaker, Deadline, RetryPolicy
+from ..utils.resilience import (
+    CircuitBreaker,
+    Deadline,
+    RetryBudget,
+    RetryPolicy,
+)
 from .types import ProxyRequest, ProxyResponse
 
 HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "upgrade",
@@ -51,7 +56,8 @@ class HttpUpstream:
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  breaker_failure_threshold: int = 5,
-                 breaker_reset_seconds: float = 10.0):
+                 breaker_reset_seconds: float = 10.0,
+                 retry_budget: Optional[RetryBudget] = None):
         u = urlsplit(base_url)
         self.scheme = u.scheme or "http"
         self.host = u.hostname or "127.0.0.1"
@@ -67,6 +73,11 @@ class HttpUpstream:
         # a write may have been applied even if the response never came
         self.retries = retries
         self.retry_policy = retry_policy or RetryPolicy(base=0.05, cap=1.0)
+        # shared token-bucket retry allowance (utils/resilience.py
+        # RetryBudget): bounds total upstream retries under sustained
+        # failure so a wedged kube-apiserver never sees a retry storm
+        # on top of its outage. None = unbudgeted.
+        self.retry_budget = retry_budget
         self.breaker = breaker or CircuitBreaker(
             "upstream", failure_threshold=breaker_failure_threshold,
             reset_timeout=breaker_reset_seconds)
@@ -85,6 +96,8 @@ class HttpUpstream:
         attempts = (self.retries + 1
                     if req.method.upper() in ("GET", "HEAD") else 1)
         delays = self.retry_policy.delays()
+        if self.retry_budget is not None:
+            self.retry_budget.on_attempt()
         while True:
             attempts -= 1
             self.breaker.allow()
@@ -98,6 +111,11 @@ class HttpUpstream:
                 # requests: surface it as the 503-mapped family
                 deadline.check("upstream")
                 if attempts <= 0 or head_seen[0]:
+                    raise
+                if self.retry_budget is not None \
+                        and not self.retry_budget.allow():
+                    # budget dry: surface the failure (counted) rather
+                    # than pile a retry storm onto a wedged upstream
                     raise
                 metrics.counter("proxy_dependency_retries_total",
                                 dependency="upstream").inc()

@@ -47,7 +47,11 @@ from ..admission import (
     WATCH_RECOMPUTE,
 )
 from ..engine.engine import CheckItem, SchemaViolation, WatchEvent
-from ..engine.remote import NotLeaderError, RemoteInterner
+from ..engine.remote import (
+    NotLeaderError,
+    RemoteInterner,
+    TRANSPORT_ERRORS,
+)
 from ..engine.store import PreconditionFailed, StoreError
 from ..utils.resilience import BreakerOpen
 from ..engine.store import RelationshipFilter, WriteOp
@@ -274,7 +278,7 @@ class ShardedEngine:
     def __init__(self, shard_map: ShardMap, groups: list,
                  journal: Optional[SplitJournal] = None,
                  cache: Optional[ShardVectorCache] = None,
-                 recover: bool = True):
+                 recover: bool = True, retry_budget=None):
         if len(groups) != shard_map.n_groups:
             raise ValueError(
                 f"shard map names {shard_map.n_groups} groups, got "
@@ -283,6 +287,11 @@ class ShardedEngine:
         self.groups = list(groups)
         self.journal = journal
         self.cache = cache
+        # the SAME RetryBudget instance the group clients hold
+        # (utils/resilience.py): the planner's scatter-leg re-issues
+        # draw from it too, so a browned-out shard sees one bounded
+        # retry stream instead of per-layer multiplication
+        self.retry_budget = retry_budget
         self.store = _ShardedStoreShim(self)
         self.dependency = "engine-shards"
         self._pool = ThreadPoolExecutor(
@@ -366,13 +375,24 @@ class ShardedEngine:
             return self.map.n_groups
         return 1
 
+    # scatter ops whose legs are PURE READS: a failed leg may be
+    # re-issued once through the shared retry budget (writes/deletes
+    # never — their at-least-once story is the journal's)
+    _RETRYABLE_SCATTER = frozenset({
+        "lookup_resources", "lookup_subjects", "read_relationships",
+        "exists", "watch_since", "revision", "check_bulk",
+    })
+
     def _scatter(self, op: str, fn,
                  shards: Optional[list] = None) -> dict:
         """Run ``fn(group_index, client)`` on the named shards (default:
         all) concurrently; returns {shard: result}. One shard shedding
         (AdmissionRejected) fails the WHOLE scatter closed with
-        Retry-After = max over the shedding shards; any other error
-        propagates after the fan-in."""
+        Retry-After = max over the shedding shards; a read leg dying on
+        the transport gets ONE budget-gated re-issue (the group client
+        already spent its own retries — this layer's re-issue draws
+        from the SAME RetryBudget, so the stack stays bounded); any
+        other error propagates after the fan-in."""
         targets = list(range(len(self.groups))) if shards is None \
             else sorted(set(shards))
         with tracer.span("shard_fanout", op=op, shards=len(targets)):
@@ -392,6 +412,27 @@ class ShardedEngine:
                 except AdmissionRejected as e:
                     sheds[gi] = e
                 except Exception as e:  # noqa: BLE001 - re-raised below
+                    # re-issue only TRANSPORT deaths: an open breaker or
+                    # a deadline-family rejection is deterministic on
+                    # the immediate retry — withdrawing a token for it
+                    # would drain the shared budget on attempts that
+                    # cannot succeed (the group client already spent
+                    # its own classified handling on those)
+                    if op in self._RETRYABLE_SCATTER \
+                            and isinstance(e, TRANSPORT_ERRORS) \
+                            and self.retry_budget is not None \
+                            and self.retry_budget.allow():
+                        try:
+                            results[gi] = fn(gi, self.groups[gi])
+                            metrics.counter(
+                                "scaleout_scatter_retries_total",
+                                group=str(gi)).inc()
+                            continue
+                        except AdmissionRejected as e2:
+                            sheds[gi] = e2
+                            continue
+                        except Exception as e2:  # noqa: BLE001
+                            e = e2
                     if first_err is None:
                         first_err = e
         if sheds:

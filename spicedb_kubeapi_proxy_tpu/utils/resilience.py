@@ -20,6 +20,15 @@ fail-open authorization. Three cooperating pieces:
   Retry-After hint); after ``reset_timeout`` one probe is admitted at a
   time. State is exported as the ``proxy_dependency_breaker_state``
   gauge and surfaced on ``/readyz`` with a per-dependency reason.
+- :class:`RetryBudget` — a token-bucket retry allowance SHARED across
+  every retrying layer of one dependency stack (transport retries in
+  RemoteEngine/HttpUpstream, FailoverEngine re-aim re-issues, planner
+  scatter-leg re-issues). Each first attempt deposits ``ratio`` tokens
+  (capped at ``burst``); each retry, anywhere in the stack, withdraws
+  one — so a browned-out shard sees at most ``burst + ratio × attempts``
+  retries TOTAL instead of N_layers × N_retries × attempts (the
+  metastable-failure guard: retry amplification is what turns a brief
+  brownout into a self-sustaining overload).
 
 Failures that feed the breaker are TRANSPORT failures (connect refused,
 reset, timeout, armed failpoint) — an upstream 500 or an engine
@@ -136,6 +145,82 @@ class RetryPolicy:
                                                     max(self.base, prev * 3)))
             prev = max(delay, self.base)
             yield delay
+
+
+class RetryBudget:
+    """Layered-retry amplification guard (see module docstring).
+
+    The bucket starts FULL (``burst`` tokens): a cold stack can absorb a
+    transient blip at full retry aggressiveness; only sustained failure
+    drains it, after which retries are rationed to ``ratio`` per fresh
+    attempt — the steady-state amplification bound. ``allow()`` answers
+    whether ONE retry may proceed and counts every refusal in
+    ``resilience_retry_budget_exhausted_total{dependency}``; callers that
+    get False surface the underlying failure immediately instead of
+    retrying. Thread-safe; a ``ratio`` of 0 with a huge ``burst``
+    degenerates to the unbudgeted behavior."""
+
+    __slots__ = ("dependency", "ratio", "burst", "_tokens", "_attempts",
+                 "_lock")
+
+    def __init__(self, dependency: str = "engine", ratio: float = 0.1,
+                 burst: float = 10.0):
+        if ratio < 0:
+            raise ValueError("retry-budget ratio must be >= 0")
+        if burst < 1:
+            raise ValueError("retry-budget burst must be >= 1")
+        self.dependency = dependency
+        self.ratio = ratio
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        # lifetime deposit count: the EXACT denominator of the
+        # amplification bound (burst + ratio × attempts) — verifiers
+        # snapshot it instead of guessing deposits from logical-op
+        # counts (one scatter op deposits once per leg)
+        self._attempts = 0
+        self._lock = threading.Lock()
+        self._gauge().set(self._tokens)
+
+    def _gauge(self):
+        return metrics.gauge("resilience_retry_budget_tokens",
+                             dependency=self.dependency)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    @property
+    def attempts(self) -> int:
+        with self._lock:
+            return self._attempts
+
+    def on_attempt(self) -> None:
+        """Credit one FIRST attempt (not a retry): deposits ``ratio``
+        tokens, capped at ``burst``. Every logical call through a
+        budgeted client calls this exactly once."""
+        with self._lock:
+            self._attempts += 1
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+            t = self._tokens
+        self._gauge().set(t)
+
+    def allow(self) -> bool:
+        """Withdraw one retry's token; False (counted) when the budget
+        is dry — the caller must surface its failure, not retry."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                t = self._tokens
+                ok = True
+            else:
+                t = self._tokens
+                ok = False
+        self._gauge().set(t)
+        if not ok:
+            metrics.counter("resilience_retry_budget_exhausted_total",
+                            dependency=self.dependency).inc()
+        return ok
 
 
 class CircuitBreaker:

@@ -1,0 +1,796 @@
+"""Chaos campaign (ISSUE 12): deterministic fault schedules, safety
+invariants, layered retry budgets.
+
+Tier-1 (fast, deterministic): failpoint env hardening (non-positive
+budgets rejected, ``name:p=`` probabilistic arming off the seeded chaos
+RNG), fault-schedule determinism with the seed-0 digest PINNED
+bench-contract style, fault actions (error/drop/delay/crash), the
+flag-gated chaos wire ops, RetryBudget units + the counter-verified
+amplification bound at every layer (transport retries, failover
+re-aim, planner scatter re-issue), the parametrized Retry-After audit
+across every fail-closed 503 source, invariant-checker units, and the
+in-process campaign smoke.
+
+Slow-marked (the CI chaos job): the subprocess campaign regression home
+and the composed ShardedWatchStream resumption across a group-leader
+SIGKILL (PR 11 tested resumption and failover separately, never
+composed).
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from spicedb_kubeapi_proxy_tpu.admission import (  # noqa: E402
+    AdmissionRejected,
+)
+from spicedb_kubeapi_proxy_tpu.authz import (  # noqa: E402
+    AuthzDeps,
+    authorize,
+)
+from spicedb_kubeapi_proxy_tpu.chaos import (  # noqa: E402
+    ChaosScheduleError,
+    EpisodeEvidence,
+    FaultSchedule,
+    FaultSpec,
+    InvariantViolation,
+    OpRecord,
+    brownout_schedule,
+    check_all,
+    check_never_fail_open,
+    check_no_stale_verdict,
+    check_retry_amplification,
+    check_zero_acked_write_loss,
+    retry_amplification_bound,
+)
+from spicedb_kubeapi_proxy_tpu.chaos.campaign import (  # noqa: E402
+    Campaign,
+    CampaignConfig,
+    SubprocessTopology,
+)
+from spicedb_kubeapi_proxy_tpu.chaos.invariants import (  # noqa: E402
+    KIND_CHECK,
+    KIND_DELETE,
+    KIND_WRITE,
+    OUTCOME_OK,
+    OUTCOME_SHED,
+)
+from spicedb_kubeapi_proxy_tpu.engine import (  # noqa: E402
+    CheckItem,
+    Engine,
+)
+from spicedb_kubeapi_proxy_tpu.engine.compaction import (  # noqa: E402
+    OverlayBackpressure,
+)
+from spicedb_kubeapi_proxy_tpu.engine.remote import (  # noqa: E402
+    EngineInternalError,
+    EngineServer,
+    NotLeaderError,
+    RemoteEngine,
+)
+from spicedb_kubeapi_proxy_tpu.engine.store import (  # noqa: E402
+    StoreError,
+    WriteOp,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import (  # noqa: E402
+    Relationship,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.requestinfo import (  # noqa: E402
+    parse_request_info,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.types import ProxyRequest  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.rules import MapMatcher  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.rules.input import UserInfo  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.utils.failpoints import (  # noqa: E402
+    DECISION_HORIZON,
+    FailPointError,
+    _Registry,
+    decision_sequence,
+    failpoints,
+)
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.utils.resilience import (  # noqa: E402
+    BreakerOpen,
+    CircuitBreaker,
+    DeadlineExceeded,
+    RetryBudget,
+    RetryPolicy,
+)
+
+pytestmark = pytest.mark.chaos
+
+NO_BACKOFF = RetryPolicy(base=0.0, cap=0.0)
+
+# the bench-contract-style pin: the stock brownout schedule at seed 0
+# must derive these exact decision tables forever — a drift here means
+# "re-running a seed" no longer reproduces historical fault histories
+BROWNOUT_SEED0_DIGEST = \
+    "0f050b3ea4cbcfb8c308607124ed4f16f523960e42d3686a0130f30de51042ad"
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    failpoints.disable_all()
+    metrics.reset()
+    yield
+    failpoints.disable_all()
+    metrics.reset()
+
+
+# -- satellite: FAILPOINTS env hardening --------------------------------------
+
+
+def test_env_rejects_nonpositive_budgets(monkeypatch):
+    """`name:-3` used to arm-then-pop silently; now it warns and stays
+    un-armed (as does `name:0`), while positive budgets still arm."""
+    monkeypatch.setenv("FAILPOINTS", "a.bad:-3,b.bad:0,c.ok:2,d.ok")
+    reg = _Registry()
+    assert not reg.armed("a.bad")
+    assert not reg.armed("b.bad")
+    assert reg.armed("c.ok")
+    assert reg.armed("d.ok")
+
+
+def test_env_probabilistic_arming_is_seed_deterministic(monkeypatch):
+    """`name:p=0.25` arms off the seeded chaos RNG (CHAOS_SEED): two
+    registries with the same seed fire on the same hit indices; a
+    different seed gives a different pattern; malformed p is ignored."""
+    monkeypatch.setenv("FAILPOINTS", "x.prob:p=0.25,bad:p=2.0,worse:p=x")
+    monkeypatch.setenv("CHAOS_SEED", "42")
+
+    def pattern(reg):
+        out = []
+        for _ in range(64):
+            try:
+                reg.hit("x.prob")
+                out.append(False)
+            except FailPointError:
+                out.append(True)
+        return out
+
+    reg1, reg2 = _Registry(), _Registry()
+    assert reg1.armed("x.prob")
+    assert not reg1.armed("bad") and not reg1.armed("worse")
+    p1, p2 = pattern(reg1), pattern(reg2)
+    assert p1 == p2, "same seed must fire on the same hit indices"
+    assert 0 < sum(p1) < 64  # actually probabilistic
+    assert p1 == decision_sequence(42, "x.prob", 0.25)[:64]
+    monkeypatch.setenv("CHAOS_SEED", "43")
+    assert pattern(_Registry()) != p1
+
+
+# -- fault schedules: determinism, digest pin, actions ------------------------
+
+
+def test_schedule_digest_pinned_and_reproducible():
+    assert brownout_schedule(0).digest() == BROWNOUT_SEED0_DIGEST
+    assert brownout_schedule(0).digest() == brownout_schedule(0).digest()
+    assert brownout_schedule(1).digest() != BROWNOUT_SEED0_DIGEST
+    # the wire round trip re-derives byte-identical decision tables
+    s = brownout_schedule(3)
+    assert FaultSchedule.parse(s.encode()).digest() == s.digest()
+
+
+def test_schedule_validation():
+    with pytest.raises(ChaosScheduleError):
+        FaultSpec("s", "explode")
+    with pytest.raises(ChaosScheduleError):
+        FaultSpec("s", "delay:nope")
+    with pytest.raises(ChaosScheduleError):
+        FaultSpec("s", "error", p=0.0)
+    with pytest.raises(ChaosScheduleError):
+        FaultSpec("s", "error", budget=0)
+    with pytest.raises(ChaosScheduleError):
+        FaultSchedule(0, [FaultSpec("dup"), FaultSpec("dup")])
+    with pytest.raises(ChaosScheduleError):
+        FaultSchedule.parse({"seed": 0})
+
+
+def test_fault_actions_error_drop_delay_crash(monkeypatch):
+    # error at a hit site raises; budget disarms deterministically
+    FaultSchedule(0, [FaultSpec("t.err", "error", budget=2)]).arm()
+    for _ in range(2):
+        with pytest.raises(FailPointError):
+            failpoints.hit("t.err")
+    failpoints.hit("t.err")  # budget spent: a no-op again
+
+    # drop at a branch site returns True (the frame falls on the floor)
+    FaultSchedule(0, [FaultSpec("t.drop", "drop", budget=1)]).arm()
+    assert failpoints.branch("t.drop") is True
+    assert failpoints.branch("t.drop") is False
+
+    # delay sleeps and lets the op proceed (no raise)
+    FaultSchedule(0, [FaultSpec("t.delay", "delay:40", budget=1)]).arm()
+    t0 = time.monotonic()
+    failpoints.hit("t.delay")
+    assert time.monotonic() - t0 >= 0.03
+
+    # crash SIGKILLs the process — assert the call, not the death
+    calls = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: calls.append(
+        (pid, sig)))
+    FaultSchedule(0, [FaultSpec("t.crash", "crash", budget=1)]).arm()
+    failpoints.hit("t.crash")
+    import signal as _signal
+
+    assert calls == [(os.getpid(), _signal.SIGKILL)]
+
+
+def test_history_digest_deterministic_for_same_hit_sequence():
+    sched = FaultSchedule(5, [FaultSpec("h.x", "error", p=0.5,
+                                        budget=DECISION_HORIZON)])
+
+    def run():
+        failpoints.disable_all()
+        sched.arm()
+        for _ in range(50):
+            try:
+                failpoints.hit("h.x")
+            except FailPointError:
+                pass
+        return failpoints.history_digest()
+
+    assert run() == run()
+
+
+# -- the flag-gated chaos wire ops --------------------------------------------
+
+
+def test_chaos_ops_flag_gated_and_deterministic_over_the_wire():
+    async def go():
+        e = Engine()
+        e.write_relationships([WriteOp("touch", parse_relationship(
+            "namespace:dev#creator@user:alice"))])
+
+        # gate OFF: chaos ops refused, nothing armed
+        srv_off = EngineServer(e)
+        port = await srv_off.start()
+        remote = RemoteEngine("127.0.0.1", port, retries=0,
+                              retry_policy=NO_BACKOFF)
+        sched = FaultSchedule(0, [FaultSpec("engine.dispatch", "error",
+                                            budget=2)])
+        with pytest.raises(StoreError, match="chaos ops are disabled"):
+            await asyncio.to_thread(remote.chaos_arm, sched.encode())
+        remote.close()
+        await srv_off.stop()
+
+        # gate ON: arming returns the schedule digest, the armed site
+        # fires exactly budget times, status reports the history
+        srv = EngineServer(e, allow_chaos=True)
+        port = await srv.start()
+        remote = RemoteEngine("127.0.0.1", port, retries=0,
+                              retry_policy=NO_BACKOFF)
+        got = await asyncio.to_thread(remote.chaos_arm, sched.encode())
+        assert got["digest"] == sched.digest()
+        assert got["armed"] == ["engine.dispatch"]
+        from spicedb_kubeapi_proxy_tpu.engine.remote import (
+            RemoteEngineError,
+        )
+
+        for _ in range(2):
+            with pytest.raises(RemoteEngineError):
+                await asyncio.to_thread(lambda: remote.revision)
+        # budget spent: the host answers again
+        assert await asyncio.to_thread(lambda: remote.revision) \
+            == e.revision
+        st = await asyncio.to_thread(remote.chaos_status)
+        fired = {s["name"]: s["fired"] for s in st["sites"]}
+        # the error rule disarmed after its budget; history remembers
+        assert len(st["history"]) == 2
+        assert all(site == "engine.dispatch"
+                   for site, _, _ in st["history"])
+        assert fired.get("engine.dispatch", 0) in (0, 2)
+        await asyncio.to_thread(remote.chaos_reset)
+        remote.close()
+        await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_chaos_delay_schedule_browns_out_dispatch_without_error():
+    """A wire-armed delay schedule slows the op (worker-side sleep) but
+    answers correctly — the brownout shape, distinct from failure."""
+    async def go():
+        e = Engine()
+        srv = EngineServer(e, allow_chaos=True)
+        port = await srv.start()
+        remote = RemoteEngine("127.0.0.1", port, retries=0,
+                              retry_policy=NO_BACKOFF)
+        await asyncio.to_thread(remote.chaos_arm, FaultSchedule(
+            0, [FaultSpec("engine.dispatch", "delay:80",
+                          budget=1)]).encode())
+        t0 = time.monotonic()
+        assert await asyncio.to_thread(lambda: remote.revision) \
+            == e.revision
+        assert time.monotonic() - t0 >= 0.06
+        remote.close()
+        await srv.stop()
+
+    asyncio.run(go())
+
+
+# -- RetryBudget: units + the layered amplification bound ---------------------
+
+
+def test_retry_budget_units():
+    b = RetryBudget("dep-x", ratio=0.5, burst=2.0)
+    assert b.tokens == 2.0
+    assert b.allow() and b.allow()  # burst spends down
+    assert not b.allow()  # dry: refused and counted
+    assert metrics.counter("resilience_retry_budget_exhausted_total",
+                           dependency="dep-x").value == 1.0
+    b.on_attempt()  # +0.5
+    assert not b.allow()  # still < 1 token
+    b.on_attempt()  # 1.0
+    assert b.allow()
+    for _ in range(100):
+        b.on_attempt()
+    assert b.tokens == 2.0  # capped at burst
+    with pytest.raises(ValueError):
+        RetryBudget(ratio=-1)
+    with pytest.raises(ValueError):
+        RetryBudget(burst=0)
+
+
+def test_transport_retries_counter_verified_within_budget_bound():
+    """Hammer a dead endpoint through a budgeted client: TOTAL retries
+    observed stay within burst + ratio × attempts even though each call
+    carries retries=5."""
+    async def go():
+        e = Engine()
+        srv = EngineServer(e)
+        port = await srv.start()
+        await srv.stop()  # connections now refused
+        budget = RetryBudget("engine-stack", ratio=0.1, burst=3.0)
+        remote = RemoteEngine(
+            "127.0.0.1", port, retries=5, retry_policy=NO_BACKOFF,
+            breaker=CircuitBreaker(f"engine:127.0.0.1:{port}",
+                                   failure_threshold=10**6),
+            retry_budget=budget)
+        attempts = 40
+        for _ in range(attempts):
+            with pytest.raises(OSError):
+                await asyncio.to_thread(lambda: remote.revision)
+        retries = metrics.counter(
+            "proxy_dependency_retries_total",
+            dependency=f"engine:127.0.0.1:{port}").value
+        bound = retry_amplification_bound(0.1, 3.0, attempts)
+        assert retries <= bound, (retries, bound)
+        # without the budget, the same hammering would have retried
+        # 5 × attempts = 200 times
+        assert retries < attempts * 5 / 2
+        assert metrics.counter(
+            "resilience_retry_budget_exhausted_total",
+            dependency="engine-stack").value > 0
+        remote.close()
+
+    asyncio.run(go())
+
+
+def test_failover_reaim_draws_from_shared_budget():
+    """The failover layer's re-issue is a retry too: with the shared
+    budget dry, a dead primary surfaces the budget refusal immediately
+    instead of parking in an election-window resolve loop."""
+    from spicedb_kubeapi_proxy_tpu.engine.remote import FailoverEngine
+    from spicedb_kubeapi_proxy_tpu.utils.resilience import (
+        DependencyUnavailable,
+    )
+
+    budget = RetryBudget("engine-stack", ratio=0.0, burst=1.0)
+    assert budget.allow()  # drain the burst
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    fe = FailoverEngine(
+        [("127.0.0.1", port)], probe_timeout=0.5, resolve_deadline=5.0,
+        connect_timeout=0.5, timeout=0.5, retries=0,
+        retry_policy=NO_BACKOFF, retry_budget=budget)
+    t0 = time.monotonic()
+    with pytest.raises(DependencyUnavailable, match="retry budget"):
+        fe.check_bulk([CheckItem("namespace", "dev", "view", "user",
+                                 "alice")])
+    # no resolve-loop wait: the refusal is immediate (well under the
+    # 5s resolve deadline)
+    assert time.monotonic() - t0 < 2.0
+    assert metrics.counter("resilience_retry_budget_exhausted_total",
+                           dependency="engine-stack").value >= 1
+    fe.close()
+
+
+class _FlakyOnce:
+    """Engine-surface wrapper whose read ops die once (transport) then
+    recover — the planner's scatter-leg re-issue shape."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.deaths = 0
+
+    def __getattr__(self, name):
+        val = getattr(self._inner, name)
+        if name in ("lookup_resources", "check_bulk"):
+            def hooked(*a, _fn=val, **kw):
+                if self.deaths == 0:
+                    self.deaths += 1
+                    raise ConnectionResetError("flaky leg")
+                return _fn(*a, **kw)
+
+            return hooked
+        return val
+
+    @property
+    def revision(self):
+        return self._inner.revision
+
+    @property
+    def store(self):
+        return self._inner.store
+
+
+SHARD_SCHEMA = """\
+schema: |-
+  definition user {}
+
+  definition namespace {
+    relation viewer: user
+    permission view = viewer
+  }
+
+  definition pod {
+    relation namespace: namespace
+    relation viewer: user
+    permission view = viewer + namespace->view
+  }
+relationships: ""
+"""
+
+
+def _shard_planner(flaky_budget):
+    from spicedb_kubeapi_proxy_tpu.scaleout import ShardedEngine, ShardMap
+
+    engines = [Engine(bootstrap=SHARD_SCHEMA) for _ in range(2)]
+    flaky = _FlakyOnce(engines[1])
+    smap = ShardMap(version=1, groups=(
+        (("127.0.0.1", 1),), (("127.0.0.1", 2),)))
+    planner = ShardedEngine(smap, [engines[0], flaky],
+                            retry_budget=flaky_budget)
+    # one pod on EACH group's slice, whatever the hash layout
+    ns = {g: next(f"ns{i}" for i in range(64)
+                  if smap.shard_of("pod", f"ns{i}/p") == g)
+          for g in range(2)}
+    planner.write_relationships([
+        WriteOp("create", Relationship("pod", f"{ns[0]}/p0", "viewer",
+                                       "user", "al", None)),
+        WriteOp("create", Relationship("pod", f"{ns[1]}/p0", "viewer",
+                                       "user", "al", None)),
+    ])
+    flaky.deaths = 0  # the seeding write is not under test
+    return planner, flaky, ns
+
+
+def test_planner_scatter_leg_reissue_is_budget_gated():
+    # WITH budget: the dead leg re-issues once and the gather is exact
+    planner, flaky, ns = _shard_planner(RetryBudget("engine-stack",
+                                                    ratio=0.0, burst=4.0))
+    ids = planner.lookup_resources("pod", "view", "user", "al")
+    assert sorted(ids) == sorted([f"{ns[0]}/p0", f"{ns[1]}/p0"])
+    assert flaky.deaths == 1
+    assert sum(metrics.counter("scaleout_scatter_retries_total",
+                               group=str(g)).value
+               for g in range(2)) == 1.0
+    planner.close()
+
+    # WITHOUT budget (or a dry one): the leg's death propagates —
+    # fail closed, never a half union
+    metrics.reset()
+    dry = RetryBudget("engine-stack", ratio=0.0, burst=1.0)
+    assert dry.allow()
+    planner2, _, _ = _shard_planner(dry)
+    with pytest.raises(ConnectionResetError):
+        planner2.lookup_resources("pod", "view", "user", "al")
+    planner2.close()
+
+
+# -- satellite: every fail-closed 503 carries a bounded Retry-After -----------
+
+
+CHECK_RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata:
+  name: ns-list
+match:
+  - apiVersion: v1
+    resource: namespaces
+    verbs: [list]
+check:
+  - tpl: "namespace:ns0#view@user:{{user.name}}"
+"""
+
+
+class _RaisingEngine:
+    """The sliver of the engine surface the check path touches, raising
+    a configured fail-closed family on dispatch."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+    def check_bulk(self, items, now=None, context=None):
+        raise self.exc
+
+
+def _request(method="GET", path="/api/v1/namespaces"):
+    return ProxyRequest(
+        method=method, path=path, query={},
+        headers={"Content-Type": "application/json"}, body=b"",
+        user=UserInfo(name="alice"),
+        request_info=parse_request_info(method, path, {}))
+
+
+@pytest.mark.parametrize("name,exc,want", [
+    ("breaker-open",
+     BreakerOpen("engine:h:1", "circuit open", retry_after=7.0), 7),
+    ("admission-shed",
+     AdmissionRejected("check", "queue full", retry_after=2.0,
+                       dependency="proxy-admission"), 2),
+    ("shard-partial-shed",
+     AdmissionRejected("lookup-prefilter", "1/2 shards shed",
+                       retry_after=3.0, dependency="shard-admission"), 3),
+    ("not-leader", NotLeaderError(), 1),
+    ("overlay-backpressure", OverlayBackpressure(0.4, 4096, 4096), 1),
+    ("deadline", DeadlineExceeded("engine:h:1", "deadline spent"), 1),
+    # an engine host ANSWERING kind="internal" (e.g. a chaos-armed
+    # server-side fault) is a dependency failure too: 503, never a raw
+    # 500 panic without Retry-After (found by the campaign's verify
+    # drive, fixed in this PR). Scoped to the internal kind — the
+    # RemoteEngineError BASE (auth/proto/frame misconfigurations) must
+    # stay a loud 500, not an endlessly-retried 503 (tested below).
+    ("engine-internal",
+     EngineInternalError("failpoint 'engine.dispatch' triggered"), 1),
+    # stragglers the cap exists for: a source forgetting to bound its
+    # hint (or emitting garbage) still yields a BOUNDED header
+    ("unbounded-hint",
+     BreakerOpen("engine:h:1", "open", retry_after=1e9), 60),
+    ("zero-hint",
+     BreakerOpen("engine:h:1", "open", retry_after=0.0), 1),
+])
+def test_every_fail_closed_503_carries_bounded_retry_after(name, exc,
+                                                           want):
+    """ONE parametrized audit across the fail-closed families: every
+    DependencyUnavailable source maps to a 503 whose Retry-After is
+    present, >= 1, <= 60, and equal to the (rounded, clamped) hint."""
+    deps = AuthzDeps(matcher=MapMatcher.from_yaml(CHECK_RULES),
+                     engine=_RaisingEngine(exc), upstream=None)
+    resp = asyncio.run(authorize(_request(), deps))
+    assert resp.status == 503, (name, resp.status, resp.body)
+    ra = resp.headers.get("Retry-After")
+    assert ra is not None, f"{name}: Retry-After missing"
+    assert 1 <= int(ra) <= 60, (name, ra)
+    assert int(ra) == want, (name, ra)
+
+
+def test_permanent_remote_errors_stay_loud_not_retryable_503():
+    """A wrong token / protocol error surfaces as the RemoteEngineError
+    BASE — a permanent misconfiguration. It must NOT be converted into
+    the retryable 503 family (a polite client would hot-loop a request
+    that can never succeed while the breaker stays closed)."""
+    from spicedb_kubeapi_proxy_tpu.engine.remote import RemoteEngineError
+
+    deps = AuthzDeps(matcher=MapMatcher.from_yaml(CHECK_RULES),
+                     engine=_RaisingEngine(
+                         RemoteEngineError("invalid token")),
+                     upstream=None)
+    with pytest.raises(RemoteEngineError):
+        asyncio.run(authorize(_request(), deps))
+
+
+# -- invariant checker units --------------------------------------------------
+
+
+def test_invariant_never_fail_open_catches_seeded_violation():
+    records = [
+        OpRecord(KIND_CHECK, OUTCOME_OK, seq=1, key="a", verdict=False,
+                 expected=False),
+        OpRecord(KIND_CHECK, OUTCOME_OK, seq=2, key="b", verdict=True,
+                 expected=True),
+        OpRecord(KIND_CHECK, OUTCOME_OK, seq=3, key="evil", verdict=True,
+                 expected=False),  # the fail-open
+        OpRecord(KIND_CHECK, OUTCOME_SHED, seq=4, retry_after=None),
+    ]
+    got = check_never_fail_open(records)
+    assert len(got) == 2
+    assert "evil" in got[0].detail
+    assert "Retry-After" in got[1].detail
+    assert check_never_fail_open(records[:2]) == []
+
+
+def test_invariant_acked_write_loss():
+    records = [OpRecord(KIND_WRITE, OUTCOME_OK, seq=1, rel="r1"),
+               OpRecord(KIND_WRITE, OUTCOME_OK, seq=2, rel="r2"),
+               # an errored write carries NO obligation
+               OpRecord(KIND_WRITE, "error", seq=3, rel="r3")]
+    assert check_zero_acked_write_loss(
+        records, {"r1": True, "r2": True}) == []
+    got = check_zero_acked_write_loss(records, {"r1": True, "r2": False})
+    assert len(got) == 1 and "r2" in got[0].detail
+    # a missing read-back is a campaign bug, surfaced loudly
+    assert len(check_zero_acked_write_loss(records, {"r1": True})) == 1
+
+
+def test_invariant_no_stale_verdict():
+    def probe(seq, v):
+        return OpRecord(KIND_CHECK, OUTCOME_OK, seq=seq, key="k",
+                        verdict=v, expected=None)
+
+    base = [probe(1, True),
+            OpRecord(KIND_DELETE, OUTCOME_OK, seq=2, key="k"),
+            probe(3, True),  # pre-deny allow: replication lag, tolerated
+            probe(4, False)]
+    assert check_no_stale_verdict(base) == []
+    stale = base + [probe(5, True)]  # allow AFTER a post-revocation deny
+    got = check_no_stale_verdict(stale)
+    assert len(got) == 1 and "stale" in got[0].invariant
+
+
+def test_invariant_retry_amplification_and_check_all():
+    assert check_retry_amplification(10.0, 0.1, 20.0, 100) == []
+    got = check_retry_amplification(500.0, 0.1, 20.0, 100)
+    assert len(got) == 1
+    ev = EpisodeEvidence(
+        name="unit",
+        records=[OpRecord(KIND_CHECK, OUTCOME_OK, seq=1, key="x",
+                          verdict=True, expected=False)],
+        readback={}, pending_splits=2,
+        retries_observed=999.0, budget_ratio=0.1, budget_burst=5.0,
+        attempts=10)
+    names = {v.invariant for v in check_all(ev)}
+    assert names == {"never-fail-open", "split-journal-completion",
+                     "retry-amplification"}
+    assert isinstance(str(InvariantViolation("x", "y")), str)
+
+
+# -- the in-process campaign smoke (tier-1) -----------------------------------
+
+
+def test_inproc_campaign_one_seed_zero_violations(tmp_path):
+    """The campaign machinery end-to-end without subprocesses: seeded
+    load + a wire-shaped brownout schedule against 2 in-process shard
+    groups, every invariant green, and the per-seed fault digest equal
+    to the schedule's own (the reproducibility contract)."""
+    cfg = CampaignConfig(seeds=(0,), episodes="short", inproc=True,
+                         workdir=str(tmp_path))
+    result = Campaign(cfg).run()
+    assert result["ok"], result["violations"]
+    assert result["violations"] == []
+    assert result["seeds"]["0"]["fault_digest"] == BROWNOUT_SEED0_DIGEST
+    episodes = {e["episode"] for e in result["episodes"]}
+    assert episodes == {"seed0/baseline", "seed0/brownout"}
+    # records actually flowed (checks, writes, lookups all exercised)
+    assert all(e["records"] > 20 for e in result["episodes"])
+
+
+# -- slow compositions (the CI chaos job) -------------------------------------
+
+
+@pytest.mark.slow
+def test_subprocess_campaign_one_seed(tmp_path):
+    """The campaign's pytest regression home: one full seed against the
+    real 2-group × 2-peer subprocess topology — brownout wire-armed
+    through chaos_arm, SIGKILL/restart of a group leader, zero
+    violations."""
+    cfg = CampaignConfig(seeds=(0,), episodes="short",
+                         workdir=str(tmp_path))
+    result = Campaign(cfg).run()
+    assert result["ok"], result["violations"]
+    names = [e["episode"] for e in result["episodes"]]
+    assert names == ["seed0/baseline", "seed0/brownout", "seed0/crash"]
+    crash = result["episodes"][2]
+    assert crash["killed"], "the crash episode never killed a leader"
+    brown = result["episodes"][1]
+    assert brown["retries_at_faulted_group"] is not None
+
+
+@pytest.mark.slow
+def test_sharded_watch_resumes_across_group_leader_sigkill(tmp_path):
+    """ISSUE 12 satellite: ShardedWatchStream resumption COMPOSED with
+    failover. Vector-stamped events resume with no gap and no duplicate
+    after the observed group's leader is SIGKILLed and its failover
+    peer takes over (PR 11 tested resumption and failover separately)."""
+    topo = SubprocessTopology(workdir=str(tmp_path))
+    try:
+        topo.wait_ready()
+        planner = topo.make_planner()
+        smap = topo.map
+
+        def ns_of(group):
+            return next(f"ns{i}" for i in range(64)
+                        if smap.shard_of("pod", f"ns{i}/p") == group)
+
+        ns = {g: ns_of(g) for g in range(2)}
+
+        def write(name, group):
+            """Acked write of one unique watchable tuple; retries until
+            acked (fail-closed windows are expected mid-election)."""
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    planner.write_relationships([WriteOp(
+                        "touch",
+                        Relationship("pod", f"{ns[group]}/{name}",
+                                     "viewer", "user", name, None))])
+                    return
+                except Exception:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.3)
+
+        start_vec = planner.revision_vector(refresh=True)
+        stream = planner.watch_push_stream(start_vec)
+        acked_a = []
+        for i in range(4):
+            g = i % 2
+            write(f"wa{i}", g)
+            acked_a.append(f"wa{i}")
+
+        def drain(s, want, budget=30.0):
+            """Collect event subject-ids until ``want`` are all seen or
+            an error surfaces; returns (names seen in order, error)."""
+            seen = []
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                try:
+                    for ev in s.next_batch():
+                        seen.append(ev.relationship.subject_id)
+                except Exception as e:  # noqa: BLE001 - the kill signal
+                    return seen, e
+                if want <= set(seen):
+                    return seen, None
+            return seen, None
+
+        seen_a, err = drain(stream, set(acked_a))
+        assert err is None and set(acked_a) <= set(seen_a), \
+            (seen_a, err)
+        resume_vec = stream.revision  # the consumer's resumption token
+
+        # SIGKILL the watched group's leader; the stream surfaces the
+        # death (or goes quiet) — the consumer closes and resumes
+        g, p = topo.kill_group_leader(0)
+        seen_gap, _err = drain(stream, {"__nothing__"}, budget=4.0)
+        stream.close()
+        for name in seen_gap:
+            resume_vec = stream.revision
+        topo.wait_group_leader(0)
+        topo.restart(g, p)
+
+        acked_b = []
+        for i in range(4):
+            write(f"wb{i}", i % 2)
+            acked_b.append(f"wb{i}")
+
+        stream2 = planner.watch_push_stream(resume_vec)
+        try:
+            seen_b, err = drain(stream2, set(acked_b), budget=45.0)
+        finally:
+            stream2.close()
+        assert err is None, err
+
+        # NO GAP: every post-kill acked write's event arrived
+        assert set(acked_b) <= set(seen_b), (acked_b, seen_b)
+        # NO DUPLICATE: nothing observed before the kill reappears, and
+        # nothing is delivered twice within either stream
+        all_seen = seen_a + seen_gap + seen_b
+        dups = {n for n in all_seen if all_seen.count(n) > 1}
+        assert not dups, f"duplicated events across resumption: {dups}"
+        # events carry monotone VECTOR stamps on the resumed stream too
+        assert isinstance(stream2.revision, type(resume_vec))
+        assert stream2.revision.dominates(resume_vec)
+    finally:
+        topo.close()

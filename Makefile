@@ -5,7 +5,7 @@ PY ?= python
 # `verify` uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: test test-quick chaos chaos-campaign bench bench-quick bench-smoke bench-macro serve-dev demo native lint verify image clean
+.PHONY: test test-quick chaos chaos-campaign bench bench-quick bench-smoke bench-macro serve-dev demo native lint analyze verify image clean
 
 # full suite on the virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -103,12 +103,21 @@ lint:
 	  $(PY) -m compileall -q spicedb_kubeapi_proxy_tpu tests bench.py; \
 	fi
 
+# the invariant lint suite (tools/analysis/): five AST passes encoding
+# the bug classes earlier review rounds fixed by hand — loop-blocking,
+# lock-discipline, fail-closed, jit-stability, metrics-contract — as a
+# hard gate. Zero unallowlisted findings or the build fails; intent is
+# recorded per finding in tools/analysis/allowlist.txt. See
+# docs/development.md.
+analyze:
+	$(PY) tools/analysis/run.py --strict
+
 # the one command matching the harness: lint + the tier-1 pytest line
 # from ROADMAP.md (same flags, same timeout, same pass-count echo).
 # CHAOS=1 additionally runs the failpoint chaos suite first (a superset
 # of what tier-1 already selects, but isolated: chaos failures surface
 # on their own before the big run).
-verify: lint
+verify: lint analyze
 	@if [ "$(CHAOS)" = "1" ]; then $(MAKE) chaos; fi
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_caveats.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_scaleout.py

@@ -119,6 +119,18 @@ class WorkflowEngine:
         if workflow not in self.workflows:
             raise KeyError(f"unknown workflow {workflow!r}")
         iid = instance_id or uuid.uuid4().hex
+        # sqlite commit fsyncs when db_path is a real file (<data-dir>/
+        # dtx.sqlite): keep it off the event loop, or every in-flight
+        # request stalls behind this write's disk latency while
+        # _db_lock is held (check_same_thread=False + _db_lock make the
+        # connection safe to drive from a worker thread)
+        await asyncio.to_thread(self._insert_instance, iid, workflow,
+                                input)
+        self._spawn(iid)
+        return iid
+
+    def _insert_instance(self, iid: str, workflow: str,
+                         input: Any) -> None:
         with self._db_lock:
             self._db.execute(
                 "INSERT INTO instances (id, workflow, input, status, created) "
@@ -126,14 +138,12 @@ class WorkflowEngine:
                 (iid, workflow, json.dumps(input), time.time()),
             )
             self._db.commit()
-        self._spawn(iid)
-        return iid
 
     async def get_result(self, instance_id: str, timeout: float = 30.0) -> Any:
         """Wait for completion (reference dualWrite waits ≤30s,
         update.go:146-195 / workflow.go:31)."""
         ev = self._done_events.setdefault(instance_id, asyncio.Event())
-        row = self._instance_row(instance_id)
+        row = await asyncio.to_thread(self._instance_row, instance_id)
         if row is None:
             raise KeyError(f"unknown workflow instance {instance_id}")
         if row["status"] in ("completed", "failed"):
@@ -147,14 +157,17 @@ class WorkflowEngine:
         finally:
             # bound _done_events: the result lives in the DB from here on
             self._done_events.pop(instance_id, None)
-        return self._result_of(self._instance_row(instance_id))
+        return self._result_of(
+            await asyncio.to_thread(self._instance_row, instance_id))
 
     async def resume_pending(self) -> list[str]:
         """Start every incomplete instance (crash recovery on boot)."""
-        with self._db_lock:
-            rows = self._db.execute(
-                "SELECT id FROM instances WHERE status = 'running'"
-            ).fetchall()
+        def select_running():
+            with self._db_lock:
+                return self._db.execute(
+                    "SELECT id FROM instances WHERE status = 'running'"
+                ).fetchall()
+        rows = await asyncio.to_thread(select_running)
         ids = [r[0] for r in rows]
         for iid in ids:
             self._spawn(iid)
@@ -175,7 +188,15 @@ class WorkflowEngine:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
-        self._db.close()
+
+        # close under _db_lock on a worker thread: client coroutines
+        # (create_instance/get_result) run their DB ops via
+        # asyncio.to_thread and are not in self._tasks — closing
+        # unlocked could interleave mid-execute on the shared connection
+        def close_db():
+            with self._db_lock:
+                self._db.close()
+        await asyncio.to_thread(close_db)
 
     # -- internals ----------------------------------------------------------
 
@@ -221,8 +242,8 @@ class WorkflowEngine:
             )
             self._db.commit()
 
-    def _finish(self, iid: str, result: Any = None,
-                error: Optional[str] = None) -> None:
+    def _finish_db(self, iid: str, result: Any = None,
+                   error: Optional[str] = None) -> None:
         with self._db_lock:
             self._db.execute(
                 "UPDATE instances SET status = ?, result = ?, error = ? "
@@ -231,6 +252,12 @@ class WorkflowEngine:
                  json.dumps(result) if error is None else None, error, iid),
             )
             self._db.commit()
+
+    async def _finish(self, iid: str, result: Any = None,
+                      error: Optional[str] = None) -> None:
+        # DB commit off-loop; the asyncio.Event is NOT thread-safe, so
+        # signal waiters back on the loop after the write is durable
+        await asyncio.to_thread(self._finish_db, iid, result, error)
         ev = self._done_events.setdefault(iid, asyncio.Event())
         ev.set()
         # waiters hold their own reference; drop ours so fire-and-forget
@@ -238,13 +265,16 @@ class WorkflowEngine:
         self._done_events.pop(iid, None)
 
     async def _run_instance(self, iid: str) -> None:
-        row = self._instance_row(iid)
+        # every event-log read/append goes through asyncio.to_thread:
+        # sqlite commits fsync on real files, and a loop-side commit
+        # under _db_lock would stall every concurrent request/workflow
+        row = await asyncio.to_thread(self._instance_row, iid)
         if row is None or row["status"] != "running":
             return
         wf = self.workflows[row["workflow"]]
         ctx = WorkflowContext(iid)
         gen = wf(ctx, json.loads(row["input"]))
-        events = self._events_for(iid)
+        events = await asyncio.to_thread(self._events_for, iid)
         seq = 0
         to_send: Any = None
         to_throw: Optional[BaseException] = None
@@ -257,7 +287,7 @@ class WorkflowEngine:
                     else:
                         call = gen.send(to_send)
                 except StopIteration as stop:
-                    self._finish(iid, result=stop.value)
+                    await self._finish(iid, result=stop.value)
                     return
                 if not isinstance(call, _Call):
                     raise RuntimeError(
@@ -279,7 +309,8 @@ class WorkflowEngine:
                 # live execution
                 if call.kind == "sleep":
                     await asyncio.sleep(call.args["seconds"])
-                    self._record_event(iid, seq, call, result=None)
+                    await asyncio.to_thread(self._record_event, iid, seq,
+                                            call)
                     to_send = None
                     seq += 1
                     continue
@@ -301,14 +332,16 @@ class WorkflowEngine:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001 - activity boundary
-                    self._record_event(iid, seq, call, error=str(e))
+                    await asyncio.to_thread(self._record_event, iid, seq,
+                                            call, None, str(e))
                     to_send, to_throw = None, ActivityError(str(e))
                     seq += 1
                     continue
-                self._record_event(iid, seq, call, result=out)
+                await asyncio.to_thread(self._record_event, iid, seq,
+                                        call, out)
                 to_send = out
                 seq += 1
         except asyncio.CancelledError:
             raise
         except Exception as e:  # workflow-level failure
-            self._finish(iid, error=f"{type(e).__name__}: {e}")
+            await self._finish(iid, error=f"{type(e).__name__}: {e}")

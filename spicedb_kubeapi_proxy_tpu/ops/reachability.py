@@ -1403,7 +1403,10 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
             leaf_off: dict = {}
             arrow_seen = 0
 
-            def resolve(e):
+            # loop vars bound as defaults: the closure is invoked within
+            # this iteration, but the explicit binding keeps it correct
+            # even if it ever escapes (flake8-bugbear B023)
+            def resolve(e, tname=tname, pname=pname):
                 nonlocal arrow_seen
                 if isinstance(e, RelationRef):
                     leaf_off[e] = slot_offset[(tname, e.name)]

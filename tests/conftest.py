@@ -19,6 +19,17 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Opt-in runtime concurrency sanitizer (PROXY_SANITIZE=1): swap the lock
+# factories BEFORE any package module imports, so every named lock in
+# the codebase is created instrumented and the whole suite doubles as a
+# lock-order / loop-blocking race detector (utils/sanitizer.py). The
+# session fixture below fails the run on enforced violations.
+_SANITIZE = os.environ.get("PROXY_SANITIZE", "") == "1"
+if _SANITIZE:
+    from spicedb_kubeapi_proxy_tpu.utils import sanitizer as _sanitizer
+
+    _sanitizer.install()
+
 # The axon TPU plugin (sitecustomize on this image) overrides platform
 # selection to "axon,cpu" when jax registers, which makes the first backend
 # use initialize the TPU tunnel — slow, single-tenant, and hang-prone from
@@ -27,3 +38,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _proxy_sanitize_gate():
+    """With PROXY_SANITIZE=1: after the whole session, report advisory
+    findings (hold-time, loop contention) and FAIL on enforced ones
+    (lock-order cycles, loop-thread blocking calls) — the acceptance
+    bar for the sanitizer-enabled tier-1 run in CI's chaos job."""
+    yield
+    if not _SANITIZE:
+        return
+    advisory = [v for v in _sanitizer.report()
+                if v.kind not in _sanitizer.ENFORCED_KINDS]
+    if advisory:
+        print(f"\n[sanitizer] {len(advisory)} advisory finding(s):",
+              file=sys.stderr)
+        for v in advisory[:40]:
+            print(f"[sanitizer]   {v.render()}", file=sys.stderr)
+    bad = _sanitizer.enforced_violations()
+    assert not bad, (
+        "concurrency sanitizer violations:\n"
+        + "\n".join(v.render() for v in bad))

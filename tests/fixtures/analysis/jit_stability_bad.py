@@ -1,0 +1,33 @@
+"""BAD: every construct here must produce a jit-stability finding."""
+import threading
+from functools import partial
+
+import jax
+import numpy as np
+
+
+def _kernel(meta, x, n):
+    if n > 4:  # finding: Python branch on traced arg n
+        x = x + 1
+    for _ in range(n):  # finding: range() over traced arg n
+        x = x * 2
+    y = np.sum(x)  # finding: numpy on traced arg x
+    z = x.item()  # finding: .item() inside a jitted body
+    return y + z
+
+
+def build(meta):
+    return jax.jit(partial(_kernel, meta))
+
+
+_lock = threading.Lock()
+
+
+def host_sync_under_lock(arr):
+    with _lock:
+        return arr.item()  # finding: host sync while holding a lock
+
+
+def device_get_under_lock(arr):
+    with _lock:
+        return jax.device_get(arr)  # finding: host sync under lock

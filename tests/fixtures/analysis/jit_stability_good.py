@@ -1,0 +1,32 @@
+"""GOOD: the jit-stability pass must stay quiet on all of this."""
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _kernel(meta, x, n):
+    # meta is partial-bound (static); n is declared static at the jit
+    # site below — both may drive Python control flow
+    if meta.levels > 1:
+        x = x + 1
+    for _ in range(n):
+        x = x * 2
+    probe = np.zeros(meta.pad)  # numpy on STATIC meta traces fine
+    return jnp.sum(x) + lax.stop_gradient(x)[0] + probe.shape[0]
+
+
+def build(meta):
+    return jax.jit(partial(_kernel, meta), static_argnames=("n",))
+
+
+_lock = threading.Lock()
+
+
+def snapshot_under_lock_sync_outside(arr):
+    with _lock:
+        dev = arr  # snapshot the reference under the lock
+    return dev.item()  # host sync OUTSIDE the critical section

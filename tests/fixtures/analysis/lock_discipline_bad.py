@@ -1,0 +1,37 @@
+"""BAD: every construct here must produce a lock-discipline finding."""
+import os
+import threading
+import time
+
+import jax
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.host_lock = threading.Lock()
+        self._tenants = {}
+        self._subs = {}
+
+    def sleeps_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)  # finding: sleep while held
+
+    def fsync_under_lock(self, fd):
+        with self.host_lock:
+            os.fsync(fd)  # finding: fsync while held
+
+    def device_put_under_lock(self, x):
+        with self.host_lock:
+            return jax.device_put(x)  # finding: device transfer held
+
+    async def awaits_under_lock(self, fut):
+        with self._lock:
+            await fut  # finding: await under a sync lock
+
+    def unlocked_iteration(self):
+        for k, v in self._tenants.items():  # finding: unlocked iter
+            print(k, v)
+
+    def unlocked_snapshot(self):
+        return list(self._subs)  # finding: unlocked snapshot

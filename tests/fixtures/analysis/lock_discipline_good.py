@@ -1,0 +1,33 @@
+"""GOOD: the lock-discipline pass must stay quiet on all of this."""
+import asyncio
+import threading
+import time
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._tenants = {}
+        self._subs = {}
+
+    def sleep_outside_lock(self):
+        with self._lock:
+            n = len(self._tenants)
+        time.sleep(0.1)  # lock already released
+        return n
+
+    async def awaits_under_async_lock(self, fut):
+        async with self._alock:  # asyncio lock: awaiting is its design
+            await fut
+
+    def locked_iteration(self):
+        with self._lock:
+            for k, v in self._tenants.items():
+                print(k, v)
+
+    def snapshot_under_lock_iterate_outside(self):
+        with self._lock:
+            subs = list(self._subs)
+        for s in subs:  # iterating the COPY needs no lock
+            print(s)

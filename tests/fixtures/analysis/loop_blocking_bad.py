@@ -1,0 +1,33 @@
+"""BAD: every construct here must produce a loop-blocking finding."""
+import queue
+import sqlite3
+import time
+
+
+work_q = queue.Queue()
+
+
+async def sleeps_on_loop():
+    time.sleep(0.5)  # finding: blocking sleep
+
+
+async def blocking_queue_get():
+    item = work_q.get()  # finding: non-awaited queue get
+    return item
+
+
+async def blocking_queue_put(item):
+    work_q.put(item)  # finding: non-awaited queue put
+
+
+async def blocking_sqlite(db):
+    db.execute("INSERT INTO t VALUES (1)")  # finding: sqlite execute
+    db.commit()  # finding: sqlite commit
+
+
+async def opens_sqlite():
+    return sqlite3.connect("x.db")  # finding: blocking sqlite open
+
+
+async def device_sync(arr):
+    arr.block_until_ready()  # finding: device sync on the loop

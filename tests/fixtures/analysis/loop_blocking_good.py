@@ -1,0 +1,36 @@
+"""GOOD: the loop-blocking pass must stay quiet on all of this."""
+import asyncio
+import queue
+import time
+
+
+work_q = queue.Queue()
+
+
+async def sleeps_async():
+    await asyncio.sleep(0.5)  # asyncio sleep is the point
+
+
+async def threads_the_blocking_call():
+    await asyncio.to_thread(time.sleep, 0.5)  # reference, not a call
+
+
+async def awaited_asyncio_queue(aq: "asyncio.Queue"):
+    item = await aq.get()  # awaited: asyncio.Queue
+    more = await asyncio.wait_for(aq.get(), timeout=1.0)  # wrapped await
+    return item, more
+
+
+async def nonblocking_queue_probe():
+    return work_q.get(block=False)  # explicit non-blocking
+
+
+async def db_via_thread(db):
+    def commit():
+        db.execute("INSERT INTO t VALUES (1)")  # sync helper: executor
+        db.commit()
+    await asyncio.to_thread(commit)
+
+
+def sync_function_may_block():
+    time.sleep(0.5)  # not an async body

@@ -1,0 +1,14 @@
+"""BAD metrics fixture: kind conflict, label conflict, dynamic name,
+undocumented family; the paired docs table adds a stale row, a kind
+mismatch, and a label mismatch."""
+
+
+def use(metrics, name):
+    metrics.counter("app_requests_total", verb="get").inc()
+    metrics.gauge("app_requests_total", verb="get").set(1)  # kind conflict
+    metrics.counter("app_sheds_total", reason="full").inc()
+    metrics.counter("app_sheds_total", tenant="t1").inc()  # label conflict
+    metrics.counter(name).inc()  # dynamic name
+    metrics.histogram("app_undocumented_seconds").observe(0.1)
+    metrics.gauge("app_mismatched_kind").set(2.0)
+    metrics.counter("app_mismatched_labels_total", op="check").inc()

@@ -1,0 +1,10 @@
+"""GOOD metrics fixture: one kind per family, consistent label keys,
+literal names, and a docs table agreeing in both directions."""
+
+
+def use(metrics):
+    metrics.counter("app_requests_total", verb="get").inc()
+    metrics.counter("app_requests_total", verb="list").inc(2)
+    metrics.histogram("app_request_seconds", buckets=[0.1, 1]).observe(0.2)
+    metrics.gauge("app_inflight").set(3)
+    metrics.counter("app_sheds_total", **{"class": "watch"}).inc()

@@ -1,0 +1,28 @@
+"""BAD scoped fixture (path ends authz/middleware.py so the fail-closed
+pass applies): every handler/producer here must produce a finding."""
+
+RETRY_AFTER_CAP_S = 60
+
+
+def swallows_silently(engine):
+    try:
+        return engine.check()
+    except Exception:
+        pass  # finding: swallowed on the decision path
+
+
+def logs_and_falls_through(engine, log):
+    try:
+        return engine.check()
+    except ValueError as e:
+        log.warning("check failed: %s", e)  # finding: log is not disposal
+
+
+def unclamped_retry_after(resp, e):
+    resp.headers["Retry-After"] = str(e.retry_after)  # finding: producer
+    return resp
+
+
+def _fail_closed_503(e, resp):
+    resp.headers["Retry-After"] = str(e.retry_after)  # finding: builder
+    return resp                                       # lost its clamp

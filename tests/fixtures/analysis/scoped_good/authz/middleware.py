@@ -1,0 +1,48 @@
+"""GOOD scoped fixture: the fail-closed pass must stay quiet."""
+
+RETRY_AFTER_CAP_S = 60
+
+
+class DependencyUnavailable(Exception):
+    retry_after = 1.0
+
+
+def _fail_closed_503(e, resp):
+    resp.headers["Retry-After"] = str(
+        min(RETRY_AFTER_CAP_S, max(1, int(e.retry_after + 0.5))))
+    return resp
+
+
+def reraises(engine):
+    try:
+        return engine.check()
+    except ValueError:
+        raise
+
+
+def raises_domain_error(engine):
+    try:
+        return engine.check()
+    except OSError as e:
+        raise DependencyUnavailable(str(e)) from e
+
+
+def routes_through_builder(engine, resp):
+    try:
+        return engine.check()
+    except DependencyUnavailable as e:
+        return _fail_closed_503(e, resp)
+
+
+def explicit_fallback(engine):
+    try:
+        return engine.check()
+    except KeyError:
+        return None  # explicit fallback value: visible disposal
+
+
+def justified_cleanup(writer):
+    try:
+        writer.close()
+    except Exception:  # noqa: BLE001 - teardown best effort
+        pass

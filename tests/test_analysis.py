@@ -1,0 +1,220 @@
+"""Fixture tests for the invariant lint suite (tools/analysis/): every
+pass must FIRE on its bad snippet and stay QUIET on its good one, the
+allowlist grammar must hold, and `--strict` must gate. The real-tree
+acceptance (`run.py --strict` over the package with the checked-in
+allowlist) runs as a test too, so CI cannot drift from `make analyze`.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.analysis import (core, fail_closed, jit_stability,  # noqa: E402
+                            lock_discipline, loop_blocking,
+                            metrics_contract)
+from tools.analysis.run import main as run_main  # noqa: E402
+
+FIX = os.path.join("tests", "fixtures", "analysis")
+
+
+def _mods(*relpaths):
+    return core.load_modules(REPO, [os.path.join(FIX, p)
+                                    for p in relpaths])
+
+
+def _tokens(findings):
+    return sorted(f.token for f in findings)
+
+
+# ---------------------------------------------------------------- passes
+
+def test_loop_blocking_fires_on_bad():
+    fs = loop_blocking.run(_mods("loop_blocking_bad.py"))
+    toks = _tokens(fs)
+    assert "time.sleep" in toks
+    assert "queue.get" in toks and "queue.put" in toks
+    assert "sqlite.execute" in toks and "sqlite.commit" in toks
+    assert "sqlite3.connect" in toks
+    assert "block_until_ready" in toks
+    assert len(fs) == 7
+
+
+def test_loop_blocking_quiet_on_good():
+    assert loop_blocking.run(_mods("loop_blocking_good.py")) == []
+
+
+def test_lock_discipline_fires_on_bad():
+    fs = lock_discipline.run(_mods("lock_discipline_bad.py"))
+    toks = _tokens(fs)
+    assert "time.sleep-under-_lock" in toks
+    assert "os.fsync-under-host_lock" in toks
+    assert "jax.device_put-under-host_lock" in toks
+    assert "await-under-_lock" in toks
+    assert "unlocked-iter-_tenants" in toks
+    assert "unlocked-snapshot-_subs" in toks
+    assert len(fs) == 6
+
+
+def test_lock_discipline_quiet_on_good():
+    assert lock_discipline.run(_mods("lock_discipline_good.py")) == []
+
+
+def test_fail_closed_fires_on_bad_scoped():
+    fs = fail_closed.run(_mods("scoped"))
+    toks = _tokens(fs)
+    assert "swallowed-Exception" in toks
+    assert "swallowed-ValueError" in toks
+    assert "retry-after-producer" in toks
+    assert "builder-unclamped" in toks
+    assert len(fs) == 4
+
+
+def test_fail_closed_quiet_on_good_scoped():
+    # re-raise, domain raise, builder route, explicit fallback, and the
+    # REASONED noqa suppression all count as disposal
+    assert fail_closed.run(_mods("scoped_good")) == []
+
+
+def test_fail_closed_ignores_out_of_scope_files():
+    # the same swallowed handlers outside the decision-path files are
+    # not findings (the lock/loop passes own generic hygiene)
+    fs = fail_closed.run(_mods("loop_blocking_bad.py"))
+    assert fs == []
+
+
+def test_jit_stability_fires_on_bad():
+    fs = jit_stability.run(_mods("jit_stability_bad.py"))
+    toks = _tokens(fs)
+    assert "py-branch-n" in toks
+    assert "py-range-n" in toks
+    assert "np-on-traced-x" in toks
+    assert "item-in-jit" in toks
+    assert "host-sync-under-_lock" in toks
+    assert len(fs) == 6
+
+
+def test_jit_stability_quiet_on_good():
+    assert jit_stability.run(_mods("jit_stability_good.py")) == []
+
+
+def test_metrics_contract_fires_on_bad():
+    root = os.path.join(REPO, FIX, "metrics_bad_root")
+    fs = metrics_contract.run(core.load_modules(root, ["code.py"]), root)
+    toks = _tokens(fs)
+    assert "kind-conflict-app_requests_total" in toks
+    assert "label-conflict-app_sheds_total" in toks
+    assert "dynamic-name" in toks
+    assert "undocumented-app_undocumented_seconds" in toks
+    assert "doc-kind-app_mismatched_kind" in toks
+    assert "doc-labels-app_mismatched_labels_total" in toks
+    assert "stale-doc-app_removed_total" in toks
+    assert len(fs) == 7
+
+
+def test_metrics_contract_quiet_on_good():
+    root = os.path.join(REPO, FIX, "metrics_good_root")
+    fs = metrics_contract.run(core.load_modules(root, ["code.py"]), root)
+    assert fs == []
+
+
+def test_metrics_contract_missing_section_is_a_finding(tmp_path):
+    code = tmp_path / "code.py"
+    code.write_text("def f(m):\n    m.counter('x_total').inc()\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text("# no table\n")
+    fs = metrics_contract.run(
+        core.load_modules(str(tmp_path), ["code.py"]), str(tmp_path))
+    assert _tokens(fs) == ["missing-reference-section"]
+
+
+# ------------------------------------------------------------- allowlist
+
+def test_allowlist_fingerprints_are_line_number_free():
+    fs = loop_blocking.run(_mods("loop_blocking_bad.py"))
+    fp = fs[0].fingerprint
+    assert str(fs[0].line) not in fp.split("|")
+    assert fp.count("|") == 3
+
+
+def test_allowlist_match_and_stale(tmp_path):
+    fs = loop_blocking.run(_mods("loop_blocking_bad.py"))
+    listed, unlisted = fs[0], fs[-1]
+    al = tmp_path / "allow.txt"
+    al.write_text(
+        f"{listed.fingerprint}  # known, justified\n"
+        "loop-blocking|gone.py|<module>|time.sleep  # stale entry\n")
+    allow = core.Allowlist.load(str(al))
+    assert allow.match(listed)
+    assert not allow.match(unlisted)
+    assert allow.stale() == [
+        "loop-blocking|gone.py|<module>|time.sleep"]
+
+
+def test_allowlist_requires_justification(tmp_path):
+    al = tmp_path / "allow.txt"
+    al.write_text("loop-blocking|a.py|f|time.sleep\n"      # no comment
+                  "loop-blocking|a.py|f|time.sleep  #\n"   # empty reason
+                  "not-a-fingerprint  # why\n")
+    allow = core.Allowlist.load(str(al))
+    assert len(allow.malformed) == 3
+    assert allow.entries == {}
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_run_strict_fails_on_new_findings(capsys):
+    rc = run_main(["--root", REPO, "--strict", "--allowlist", "",
+                   os.path.join(FIX, "loop_blocking_bad.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "loop-blocking:" in out and "time.sleep" in out
+
+
+def test_run_strict_passes_on_clean_tree(capsys):
+    rc = run_main(["--root", REPO, "--strict", "--allowlist", "",
+                   "--select", "loop-blocking,lock-discipline",
+                   os.path.join(FIX, "loop_blocking_good.py")])
+    assert rc == 0
+    assert "0 new" in capsys.readouterr().out
+
+
+def test_run_unknown_pass_is_an_error():
+    assert run_main(["--select", "nope", "--root", REPO]) == 2
+
+
+def test_syntax_error_is_a_finding(tmp_path, capsys):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    rc = run_main(["--root", str(tmp_path), "--strict",
+                   "--allowlist", "", "broken.py"])
+    assert rc == 1
+    assert "does not parse" in capsys.readouterr().out
+
+
+# --------------------------------------------------- the real-tree gate
+
+def test_real_tree_strict_gate_passes():
+    """`make analyze` must be green: zero unallowlisted findings over
+    the package with the checked-in allowlist. Runs the CLI exactly as
+    CI does (subprocess, so argv/exit-code handling is covered too)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "analysis", "run.py"),
+         "--strict"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_docs_metric_table_matches_code_both_directions():
+    """The metrics-contract acceptance in-process: no undocumented-,
+    stale-doc-, doc-kind- or doc-labels- findings on the real tree."""
+    mods = core.load_modules(REPO, ["spicedb_kubeapi_proxy_tpu"])
+    fs = metrics_contract.run(mods, REPO)
+    allow = core.Allowlist.load(
+        os.path.join(REPO, "tools", "analysis", "allowlist.txt"))
+    fs = [f for f in fs if not allow.match(f)]
+    assert fs == [], [f.render() for f in fs]

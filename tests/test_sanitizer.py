@@ -1,0 +1,236 @@
+"""Unit tests for the runtime concurrency sanitizer
+(utils/sanitizer.py): seeded lock-order cycle detected, clean ordering
+clean, loop-thread sleep detection, hold-time ceiling, Condition
+integration, and factory scoping. Tests swap in a private _State so a
+PROXY_SANITIZE=1 outer session's accumulated graph is never polluted.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.utils import sanitizer
+
+
+@pytest.fixture
+def fresh_state(monkeypatch):
+    """Swap in a private _State so these tests never pollute (or read)
+    a PROXY_SANITIZE=1 outer session's accumulated graph. record_all
+    stays False by default: with the factories globally installed,
+    attributing every non-package frame would instrument pytest's own
+    stdlib locks into the private state."""
+    st = sanitizer._State()
+    monkeypatch.setattr(sanitizer, "_state", st)
+    return st
+
+
+@pytest.fixture
+def reinstall_guard():
+    """Restore the session's installation state after a test that
+    installs/uninstalls — under PROXY_SANITIZE=1 the factories are
+    already live and must stay live for the rest of the session."""
+    was = sanitizer.installed()
+    yield
+    if was and not sanitizer.installed():
+        sanitizer.install()
+    elif not was and sanitizer.installed():
+        sanitizer.uninstall()
+
+
+def _lock(site):
+    # _real_lock: never double-wrap under an outer installed sanitizer
+    return sanitizer.SanitizedLock(sanitizer._real_lock(), site, False)
+
+
+def _rlock(site):
+    return sanitizer.SanitizedLock(sanitizer._real_rlock(), site, True)
+
+
+def _kinds(st):
+    return sorted(v.kind for v in st.violations)
+
+
+def test_seeded_lock_order_cycle_detected(fresh_state):
+    a, b = _lock("mod.py:1"), _lock("mod.py:2")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):  # sequential: no real deadlock, just the order
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    kinds = _kinds(fresh_state)
+    assert kinds.count("lock-order-cycle") == 1
+    v = [x for x in fresh_state.violations
+         if x.kind == "lock-order-cycle"][0]
+    assert "mod.py:1" in v.render() and "mod.py:2" in v.render()
+
+
+def test_consistent_order_is_clean(fresh_state):
+    a, b = _lock("mod.py:1"), _lock("mod.py:2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert fresh_state.violations == []
+
+
+def test_three_lock_transitive_cycle(fresh_state):
+    a, b, c = _lock("m.py:1"), _lock("m.py:2"), _lock("m.py:3")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:  # closes a->b->c->a transitively
+            pass
+    assert "lock-order-cycle" in _kinds(fresh_state)
+
+
+def test_reentrant_rlock_no_self_edge(fresh_state):
+    r = _rlock("m.py:9")
+    with r:
+        with r:  # reentrant: not an order edge, not a cycle
+            pass
+    assert fresh_state.violations == []
+
+
+def test_trylock_never_participates_in_cycles(fresh_state):
+    a, b = _lock("m.py:1"), _lock("m.py:2")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(blocking=False)  # trylock cannot deadlock
+        a.release()
+    assert fresh_state.violations == []
+
+
+def test_hold_time_ceiling_records(fresh_state):
+    fresh_state.hold_ms = 10.0
+    lk = _lock("m.py:5")
+    with lk:
+        sanitizer._real_sleep(0.05)
+    assert _kinds(fresh_state) == ["hold-time"]
+    # advisory, never enforced
+    assert sanitizer.enforced_violations() == []
+
+
+def test_loop_thread_sleep_detected(fresh_state, reinstall_guard):
+    fresh_state.record_all = True  # attribute this test file's frames
+    sanitizer.install()
+
+    async def bad():
+        time.sleep(0.005)
+
+    asyncio.run(bad())
+    kinds = _kinds(fresh_state)
+    assert "loop-blocking-call" in kinds
+    assert any(v.kind == "loop-blocking-call"
+               for v in sanitizer.enforced_violations())
+
+
+def test_worker_thread_sleep_is_fine(fresh_state, reinstall_guard):
+    fresh_state.record_all = True
+    sanitizer.install()
+    t = threading.Thread(target=time.sleep, args=(0.005,))
+    t.start()
+    t.join()
+    assert [v for v in fresh_state.violations
+            if v.kind == "loop-blocking-call"] == []
+
+
+def test_loop_lock_contention_recorded_but_advisory(fresh_state):
+    lk = _lock("m.py:7")
+    release = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(2)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    held.wait(2)
+
+    async def contend():
+        loop = asyncio.get_running_loop()
+        loop.call_later(0.05, release.set)
+        # a blocking acquire on the loop thread that actually contends
+        with lk:
+            pass
+
+    asyncio.run(contend())
+    t.join()
+    assert "loop-lock-contention" in _kinds(fresh_state)
+    assert sanitizer.enforced_violations() == []
+
+
+def test_condition_wait_does_not_read_as_held(fresh_state):
+    fresh_state.hold_ms = 30.0
+    inner = sanitizer._real_rlock()
+    lk = sanitizer.SanitizedLock(inner, "m.py:11", True)
+    cond = threading.Condition(lk)
+    woke = []
+
+    def waiter():
+        with cond:
+            woke.append(cond.wait(1.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    sanitizer._real_sleep(0.15)  # waiter parked well past hold_ms
+    with cond:
+        cond.notify_all()
+    t.join()
+    assert woke == [True]
+    # the wait released the lock: no hold-time for the parked window
+    holds = [v for v in fresh_state.violations if v.kind == "hold-time"]
+    assert holds == [], [v.render() for v in holds]
+
+
+def test_factory_scopes_to_package_frames(fresh_state, reinstall_guard):
+    sanitizer.install()
+    # created from a test frame (not package code): raw primitive
+    raw = threading.Lock()
+    assert not isinstance(raw, sanitizer.SanitizedLock)
+    # created from package code: instrumented
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    assert isinstance(reg._lock, sanitizer.SanitizedLock)
+
+
+def test_install_uninstall_restores(fresh_state, reinstall_guard):
+    sanitizer.uninstall()  # reach the raw state whatever the session is
+    sanitizer.install()
+    sanitizer.install()  # idempotent
+    assert threading.Lock is not sanitizer._real_lock
+    sanitizer.uninstall()
+    assert threading.Lock is sanitizer._real_lock
+    assert threading.RLock is sanitizer._real_rlock
+    assert time.sleep is sanitizer._real_sleep
+
+
+def test_reset_clears_graph_and_violations(fresh_state):
+    a, b = _lock("m.py:1"), _lock("m.py:2")
+    with a:
+        with b:
+            pass
+    assert fresh_state.edges
+    # reset() acts on the swapped-in state via the module surface
+    sanitizer.reset()
+    assert fresh_state.edges == {} and fresh_state.violations == []

@@ -1,0 +1,4 @@
+"""Invariant lint suite: AST passes encoding the concurrency / fail-closed
+/ jit-stability / metrics contracts this codebase already paid to learn
+(see docs/development.md). Run via ``tools/analysis/run.py`` or
+``make analyze``."""

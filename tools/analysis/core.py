@@ -1,0 +1,234 @@
+"""Shared infrastructure for the invariant lint passes.
+
+Every pass consumes parsed ``Module`` objects (source + AST with parent
+links) and emits ``Finding``s. A finding's *fingerprint* deliberately
+excludes the line number — ``rule|relpath|scope|token`` — so the
+checked-in allowlist survives unrelated edits to the same file; ``scope``
+is the enclosing function's qualified name (or ``<module>``) and
+``token`` is a short, stable detail such as the offending call name.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative, forward slashes
+    line: int
+    scope: str      # enclosing function qualname or <module>
+    token: str      # short stable detail (e.g. the blocked call name)
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.token}"
+
+    def render(self) -> str:
+        return (f"{self.rule}: {self.path}:{self.line} [{self.scope}] "
+                f"{self.message}")
+
+
+class Module:
+    """One parsed source file: AST with parent links plus lookup helpers."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=relpath)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualified name of the innermost enclosing function/class."""
+        names: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(anc.name)
+        return ".".join(reversed(names)) if names else "<module>"
+
+    def finding(self, rule: str, node: ast.AST, token: str,
+                message: str) -> Finding:
+        return Finding(rule=rule, path=self.path,
+                       line=getattr(node, "lineno", 0),
+                       scope=self.scope_of(node), token=token,
+                       message=message)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def terminal_attr(node: ast.AST) -> Optional[str]:
+    """Last attribute/name segment of an expression (``self.x.y`` -> y)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return terminal_attr(node.func)
+    return None
+
+
+def body_nodes(func: ast.AST, *, skip_nested: bool = True):
+    """Walk a function body; nested function defs (of either flavor) are
+    skipped — they execute in their own context (executor thunks, jit
+    closures, callbacks) and are analyzed on their own when relevant."""
+    stack = list(getattr(func, "body", []))
+    while stack:
+        node = stack.pop()
+        if skip_nested and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# substring matches, plus "mu" as an EXACT name (the Go idiom) — a
+# substring 'mu' would swallow names like "emulator"
+LOCKISH_PARTS = ("lock", "guard", "mutex")
+LOCKISH_EXACT = ("mu",)
+
+
+def is_lockish(expr: ast.AST) -> bool:
+    """Does a ``with`` context expression look like a named lock?
+    Matches names/attrs containing lock/guard/mutex (``self._lock``,
+    ``host_lock``, ``cg._host_guard()``) or exactly named ``mu``."""
+    name = terminal_attr(expr)
+    if name is None:
+        return False
+    low = name.lower()
+    return any(p in low for p in LOCKISH_PARTS) \
+        or low.lstrip("_") in LOCKISH_EXACT
+
+
+def with_lock_items(node: ast.With) -> List[ast.AST]:
+    return [item.context_expr for item in node.items
+            if is_lockish(item.context_expr)]
+
+
+def held_lock_names(mod: Module, node: ast.AST) -> List[str]:
+    """Terminal names of lockish ``with`` contexts enclosing *node*
+    (sync ``with`` only — ``async with`` guards an asyncio lock, which
+    is await-safe by construction)."""
+    held: List[str] = []
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.With):
+            for expr in with_lock_items(anc):
+                held.append(terminal_attr(expr) or "?")
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break  # a lock held by a caller is out of static scope
+    return held
+
+
+# ---------------------------------------------------------------- files
+
+DEFAULT_EXCLUDES = ("__pycache__", ".git", "tests/fixtures")
+
+
+def iter_py_files(root: str, paths: Iterable[str],
+                  excludes: Tuple[str, ...] = DEFAULT_EXCLUDES):
+    """Yield (abspath, relpath-to-root) for every .py under *paths*."""
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            yield ap, os.path.relpath(ap, root)
+            continue
+        # an exclude already contained in the REQUESTED path doesn't
+        # apply below it (asking for tests/fixtures/... means it)
+        norm = os.path.relpath(ap, root).replace(os.sep, "/")
+        eff = tuple(x for x in excludes if x not in norm)
+        for dirpath, dirnames, filenames in os.walk(ap):
+            rel = os.path.relpath(dirpath, root)
+            dirnames[:] = [d for d in dirnames
+                           if not any(x in os.path.join(rel, d)
+                                      for x in eff)]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    yield fp, os.path.relpath(fp, root)
+
+
+def load_modules(root: str, paths: Iterable[str]) -> List[Module]:
+    mods = []
+    for ap, rp in iter_py_files(root, paths):
+        with open(ap, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            mods.append(Module(ap, rp, src))
+        except SyntaxError as e:  # surfaced as a finding, not a crash
+            m = Module.__new__(Module)
+            m.abspath, m.path, m.source = ap, rp.replace(os.sep, "/"), src
+            m.tree = None
+            m.syntax_error = e
+            mods.append(m)
+    return mods
+
+
+# ------------------------------------------------------------ allowlist
+
+@dataclass
+class Allowlist:
+    entries: Dict[str, str] = field(default_factory=dict)  # fp -> why
+    used: set = field(default_factory=set)
+    malformed: List[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        al = cls()
+        if not os.path.exists(path):
+            return al
+        with open(path, "r", encoding="utf-8") as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "#" not in line:
+                    al.malformed.append(line)
+                    continue
+                fp, why = line.split("#", 1)
+                fp, why = fp.strip(), why.strip()
+                if not fp or not why or fp.count("|") != 3:
+                    al.malformed.append(line)
+                    continue
+                al.entries[fp] = why
+        return al
+
+    def match(self, finding: Finding) -> bool:
+        if finding.fingerprint in self.entries:
+            self.used.add(finding.fingerprint)
+            return True
+        return False
+
+    def stale(self) -> List[str]:
+        return sorted(set(self.entries) - self.used)

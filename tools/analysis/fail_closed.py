@@ -1,0 +1,172 @@
+"""fail-closed: the authorization chain may never swallow a failure.
+
+Scope is the decision path — ``authz/middleware.py`` plus the engine
+dispatch surfaces (``engine/remote.py``, ``engine/engine.py``,
+``scaleout/planner.py``): the modules where an eaten exception is a
+fail-open verdict or a silent half-answer (the chaos campaign's
+never-fail-open invariant, PR 12).
+
+Two checks:
+
+1. every ``except`` handler in scope must visibly dispose of the
+   failure — re-``raise``, raise *something* (the DependencyUnavailable
+   family feeds the shared 503 builder), call/return through
+   ``_fail_closed_503``, or ``return``/``continue``/``break`` an
+   explicit fallback value. Handlers that fall through with only
+   logging/metrics are findings (allowlist the intentional best-effort
+   cleanup paths with a justification).
+An ``except`` line (or its first body line) carrying a REASONED
+suppression comment — ``# noqa: BLE001 - <why>`` — is an in-code
+justification and is honored (a bare ``noqa`` without a reason is not).
+``parser.error(...)`` / ``sys.exit(...)`` count as disposal: both raise.
+
+2. every Retry-After producer must clamp: a ``headers["Retry-After"]``
+   assignment outside the shared ``_fail_closed_503`` builder, or one
+   whose value expression doesn't clamp via ``min(RETRY_AFTER_CAP_S``,
+   is a finding — an unbounded hint parks polite clients forever
+   (PR 12 satellite).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Module, call_name
+
+RULE = "fail-closed"
+
+SCOPE_FILES = (
+    "authz/middleware.py",
+    "engine/remote.py",
+    "engine/engine.py",
+    "scaleout/planner.py",
+)
+
+BUILDER = "_fail_closed_503"
+CLAMP_NAME = "RETRY_AFTER_CAP_S"
+
+# calls that never return: argparse's .error() and sys.exit both raise.
+# .error is recognized ONLY on parser-shaped receivers — log.error is
+# logging, not disposal
+TERMINAL_CALLS = ("sys.exit", "os._exit", "ap.error", "parser.error",
+                  "argparser.error", "self.parser.error")
+
+_REASONED_NOQA = re.compile(r"#\s*noqa:\s*BLE001\s*-\s*\S")
+
+
+def _in_scope(mod: Module) -> bool:
+    return any(mod.path.endswith(sf) for sf in SCOPE_FILES)
+
+
+def _handler_disposes(handler: ast.ExceptHandler) -> bool:
+    """True when the handler visibly routes the failure somewhere:
+    raises, returns, breaks/continues out, or calls the shared 503
+    builder."""
+    stack = list(handler.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Raise, ast.Return, ast.Continue, ast.Break)):
+            return True
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            if name is not None:
+                if name.split(".")[-1] == BUILDER:
+                    return True
+                if name in TERMINAL_CALLS:
+                    # parser.error()/ap.error() raises SystemExit
+                    return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _reasoned_suppression(mod: Module,
+                          handler: ast.ExceptHandler) -> bool:
+    lines = mod.source.splitlines()
+    check = [handler.lineno]
+    if handler.body:
+        check.append(handler.body[0].lineno)
+    for ln in check:
+        if 0 < ln <= len(lines) and _REASONED_NOQA.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def _exc_token(handler: ast.ExceptHandler) -> str:
+    t = handler.type
+    if t is None:
+        return "bare-except"
+    if isinstance(t, ast.Tuple):
+        parts = []
+        for e in t.elts:
+            parts.append(getattr(e, "attr", getattr(e, "id", "?")))
+        return "+".join(parts)
+    return getattr(t, "attr", getattr(t, "id", "?"))
+
+
+def _clamped(value: ast.AST) -> bool:
+    """Does the assigned value expression clamp through the shared cap?
+    Accepts any expression that mentions both ``min(`` and the cap
+    constant (``min(RETRY_AFTER_CAP_S, max(1, ...))``)."""
+    has_min = False
+    has_cap = False
+    for n in ast.walk(value):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "min":
+            has_min = True
+        if isinstance(n, ast.Name) and CLAMP_NAME in n.id:
+            has_cap = True
+        if isinstance(n, ast.Attribute) and CLAMP_NAME in n.attr:
+            has_cap = True
+    return has_min and has_cap
+
+
+def _check_retry_after(mod: Module, findings: list) -> None:
+    """Repo-wide: ``...headers["Retry-After"] = <expr>`` must clamp
+    unless it lives inside the shared builder itself."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and tgt.slice.value == "Retry-After"):
+                continue
+            scope = mod.scope_of(node)
+            if scope.split(".")[-1] == BUILDER:
+                if not _clamped(node.value):
+                    findings.append(mod.finding(
+                        RULE, node, "builder-unclamped",
+                        f"the shared {BUILDER} builder no longer clamps "
+                        f"Retry-After via min({CLAMP_NAME}, ...)"))
+                continue
+            findings.append(mod.finding(
+                RULE, node, "retry-after-producer",
+                f"Retry-After set outside the shared {BUILDER} builder "
+                f"— route the DependencyUnavailable through it so the "
+                f"[1, {CLAMP_NAME}] clamp cannot be missed"))
+
+
+def run(modules) -> list:
+    findings = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        _check_retry_after(mod, findings)
+        if not _in_scope(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _handler_disposes(node) or _reasoned_suppression(mod, node):
+                continue
+            findings.append(mod.finding(
+                RULE, node, f"swallowed-{_exc_token(node)}",
+                f"except {_exc_token(node)} falls through without "
+                f"raising, returning, or routing through {BUILDER} — "
+                f"on the decision path a swallowed failure is a "
+                f"fail-open verdict"))
+    return findings

@@ -1,0 +1,206 @@
+"""jit-stability: the zero-recompile / no-host-sync contracts from the
+delta-overlay and caveat PRs (8/9), as static checks.
+
+A jitted function re-specializes on every new *static* argument value
+and every Python-level branch on a traced value is a trace error (or a
+silent constant). The write path's contract is ZERO recompiles under
+steady churn — so the traced functions must keep Python out of the hot
+signature:
+
+- traced parameters (not partial-bound, not in ``static_argnums`` /
+  ``static_argnames``) must not drive Python control flow: used as an
+  ``if``/``while`` test, compared in one, or passed to ``range()`` —
+  each is either a TracerBoolConversionError at runtime or a hidden
+  re-specialization
+- no ``numpy`` (``np.*``) calls applied directly to traced parameters —
+  numpy eagerly concretizes, forcing a device sync per call (use
+  ``jnp``/``lax``)
+- no ``.item()`` inside a jitted body (concretization error on tracers)
+- no host synchronization while holding a lock, anywhere in the repo:
+  ``.item()`` / ``jax.device_get`` under a ``with <lock>:`` serializes
+  every other thread behind a device round-trip (the PR 8 host_lock
+  rule: snapshot under the lock, sync outside it)
+
+Jitted functions are found by name: ``jax.jit(f)``, ``jax.jit(
+partial(f, bound...))`` (the bound prefix is static), ``pjit`` same,
+and ``@jax.jit``-style decorators.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Module, call_name, dotted_name, held_lock_names
+
+RULE = "jit-stability"
+
+JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit")
+
+
+def _jit_call_target(call: ast.Call) -> Optional[Tuple[str, int]]:
+    """(function name, number of partial-bound leading args) when *call*
+    is ``jax.jit(f)`` / ``jax.jit(partial(f, a, b))``."""
+    name = call_name(call)
+    if name not in JIT_NAMES or not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Name):
+        return target.id, 0
+    if isinstance(target, ast.Call):
+        tname = call_name(target)
+        if tname in ("partial", "functools.partial") and target.args \
+                and isinstance(target.args[0], ast.Name):
+            return target.args[0].id, len(target.args) - 1
+    return None
+
+
+def _static_names(call: ast.Call, func: ast.FunctionDef,
+                  bound: int) -> Set[str]:
+    """Parameter names jit treats as static: partial-bound prefix plus
+    static_argnums/static_argnames keywords."""
+    params = [a.arg for a in func.args.args]
+    static = set(params[:bound])
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    static.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    idx = n.value
+                    if 0 <= idx < len(params):
+                        static.add(params[idx])
+    return static
+
+
+def _decorated_jit(func: ast.FunctionDef) -> Optional[ast.Call]:
+    """A synthetic call node carrying static_arg* kwargs when *func* is
+    decorated with jit; bare ``@jax.jit`` yields an empty one."""
+    for dec in func.decorator_list:
+        if isinstance(dec, ast.Call):
+            dname = call_name(dec)
+            if dname in JIT_NAMES:
+                return dec
+            if dname in ("partial", "functools.partial") and dec.args \
+                    and call_name(dec.args[0]) in JIT_NAMES:
+                synth = ast.Call(func=dec.args[0], args=[],
+                                 keywords=dec.keywords)
+                return synth
+        elif dotted_name(dec) in JIT_NAMES:
+            return ast.Call(func=dec, args=[], keywords=[])
+    return None
+
+
+def _name_refs(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _check_jitted(mod: Module, func, static: Set[str],
+                  findings: list) -> None:
+    params = {a.arg for a in func.args.args} | \
+        {a.arg for a in func.args.kwonlyargs}
+    traced = params - static
+    stack = list(func.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # inner defs are traced closures; checked via walk
+        if isinstance(n, (ast.If, ast.While)):
+            used = _name_refs(n.test) & traced
+            for u in sorted(used):
+                findings.append(mod.finding(
+                    RULE, n, f"py-branch-{u}",
+                    f"jitted `{func.name}` branches in Python on traced "
+                    f"arg `{u}` — a trace error or per-value "
+                    f"re-specialization; use lax.cond/select or declare "
+                    f"it static"))
+        if isinstance(n, ast.Call):
+            cname = call_name(n)
+            if cname == "range":
+                used = set()
+                for a in n.args:
+                    used |= _name_refs(a) & traced
+                for u in sorted(used):
+                    findings.append(mod.finding(
+                        RULE, n, f"py-range-{u}",
+                        f"jitted `{func.name}` drives range() with "
+                        f"traced arg `{u}` — the loop length "
+                        f"re-specializes per value; use lax.fori_loop "
+                        f"or make it static"))
+            elif cname is not None and (cname.startswith("np.")
+                                        or cname.startswith("numpy.")):
+                used = set()
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    if isinstance(a, ast.Name) and a.id in traced:
+                        used.add(a.id)
+                for u in sorted(used):
+                    findings.append(mod.finding(
+                        RULE, n, f"np-on-traced-{u}",
+                        f"jitted `{func.name}` applies `{cname}` to "
+                        f"traced arg `{u}` — numpy concretizes (device "
+                        f"sync / trace error); use jnp"))
+            if isinstance(n.func, ast.Attribute) and n.func.attr == "item" \
+                    and not n.args:
+                findings.append(mod.finding(
+                    RULE, n, "item-in-jit",
+                    f"`.item()` inside jitted `{func.name}` — "
+                    f"concretization of a tracer"))
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _check_host_sync_under_lock(mod: Module, findings: list) -> None:
+    for n in ast.walk(mod.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        token = None
+        if isinstance(n.func, ast.Attribute) and n.func.attr == "item" \
+                and not n.args:
+            token = ".item()"
+        else:
+            cname = call_name(n)
+            if cname is not None and cname.endswith("device_get"):
+                token = "device_get"
+        if token is None:
+            continue
+        held = held_lock_names(mod, n)
+        if held:
+            findings.append(mod.finding(
+                RULE, n, f"host-sync-under-{held[0]}",
+                f"host sync `{token}` while holding `{held[0]}` — every "
+                f"other thread serializes behind a device round-trip; "
+                f"snapshot under the lock, sync outside"))
+
+
+def run(modules) -> list:
+    findings = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        funcs: Dict[str, ast.FunctionDef] = {}
+        jit_sites: List[Tuple[ast.Call, str, int]] = []
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.FunctionDef):
+                funcs.setdefault(n.name, n)
+            if isinstance(n, ast.Call):
+                tgt = _jit_call_target(n)
+                if tgt is not None:
+                    jit_sites.append((n, tgt[0], tgt[1]))
+        seen: Set[str] = set()
+        for call, fname, bound in jit_sites:
+            func = funcs.get(fname)
+            if func is None or fname in seen:
+                continue
+            seen.add(fname)
+            _check_jitted(mod, func, _static_names(call, func, bound),
+                          findings)
+        for fname, func in funcs.items():
+            if fname in seen:
+                continue
+            dec = _decorated_jit(func)
+            if dec is not None:
+                _check_jitted(mod, func, _static_names(dec, func, 0),
+                              findings)
+        _check_host_sync_under_lock(mod, findings)
+    return findings

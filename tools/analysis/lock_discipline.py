@@ -1,0 +1,155 @@
+"""lock-discipline: what may happen while a named lock is held, and
+which shared structures may only be iterated under one.
+
+The PR 5 review class: an unlocked iteration over the admission
+controller's tenant dict raced concurrent releases; a double-release
+needed an idempotence gate under the lock. Statically enforced here:
+
+- no ``await`` inside a sync ``with <lock>:`` body — the lock spans an
+  arbitrary number of loop turns and every other acquirer (including
+  worker threads feeding the loop) deadlocks behind it
+- no blocking IO (sleep, fsync, subprocess, blocking connect) or device
+  synchronization (``block_until_ready``, ``jax.device_put``) while a
+  named lock is held — hold times bound every other thread's tail
+  latency (the sanitizer's hold-time ceiling is the runtime twin)
+- iteration over shared registries (tenant/peer/subscriber/stream
+  dicts) must happen inside a lockish ``with`` in the same function, or
+  over an explicit snapshot (``list(...)``/``tuple(...)``/``.copy()``
+  taken under one — snapshots taken outside any lock are still flagged)
+
+Lockish = a ``with`` context whose terminal name contains lock/guard/
+mutex (``self._lock``, ``host_lock``, ``cg._host_guard()``).
+``async with`` (asyncio locks) is exempt: awaiting under one is its
+design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Module, call_name, terminal_attr, with_lock_items,
+                   held_lock_names)
+
+RULE = "lock-discipline"
+
+BLOCKING_UNDER_LOCK = {
+    "time.sleep": "blocking sleep",
+    "os.fsync": "blocking fsync",
+    "os.fdatasync": "blocking fsync",
+    "socket.create_connection": "blocking connect",
+    "subprocess.run": "subprocess wait",
+    "subprocess.check_output": "subprocess wait",
+    "subprocess.check_call": "subprocess wait",
+    "jax.device_put": "device transfer",
+}
+
+BLOCKING_METHODS = {
+    "block_until_ready": "device sync",
+    "fsync": "blocking fsync",
+}
+
+# shared registries the review rounds locked by hand: iterating them
+# unlocked races concurrent insert/delete (RuntimeError: dict changed
+# size) or observes torn state
+SHARED_DICTS = ("_tenants", "_peers", "_subs", "_subscribers",
+                "_streams", "_waiters", "_flights", "_sessions",
+                "_followers", "_watchers")
+
+SNAPSHOT_CALLS = ("list", "tuple", "dict", "set", "sorted")
+
+
+def _in_lock_body(mod: Module, node: ast.AST) -> bool:
+    return bool(held_lock_names(mod, node))
+
+
+def _check_with_lock(mod: Module, with_node: ast.With, findings: list):
+    lock_names = [terminal_attr(e) or "?"
+                  for e in with_lock_items(with_node)]
+    if not lock_names:
+        return
+    lock = lock_names[0]
+    stack = list(with_node.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            findings.append(mod.finding(
+                RULE, n, f"await-under-{lock}",
+                f"await while holding `{lock}` — the lock spans loop "
+                f"turns; every other acquirer (threads included) stalls "
+                f"behind it"))
+            continue
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            matched = False
+            if name is not None:
+                for pat, why in BLOCKING_UNDER_LOCK.items():
+                    if name == pat or name.endswith("." + pat):
+                        findings.append(mod.finding(
+                            RULE, n, f"{pat}-under-{lock}",
+                            f"{why} `{name}(...)` while holding "
+                            f"`{lock}` — move it outside the critical "
+                            f"section"))
+                        matched = True
+                        break
+            if not matched and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in BLOCKING_METHODS:
+                findings.append(mod.finding(
+                    RULE, n, f"{n.func.attr}-under-{lock}",
+                    f"{BLOCKING_METHODS[n.func.attr]} `.{n.func.attr}()` "
+                    f"while holding `{lock}`"))
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _shared_dict_name(expr: ast.AST):
+    """The shared-registry name if *expr* reads one: ``self._tenants``,
+    ``self._tenants.items()``, etc."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr in ("items", "keys", "values"):
+        expr = expr.func.value
+    name = terminal_attr(expr)
+    return name if name in SHARED_DICTS else None
+
+
+def _check_shared_iteration(mod: Module, findings: list):
+    for node in ast.walk(mod.tree):
+        iters = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters = [node.iter]
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters = [g.iter for g in node.generators]
+        for it in iters:
+            name = _shared_dict_name(it)
+            if name is None:
+                continue
+            if _in_lock_body(mod, it):
+                continue
+            findings.append(mod.finding(
+                RULE, it, f"unlocked-iter-{name}",
+                f"iteration over shared `{name}` outside any lock — "
+                f"a concurrent insert/delete tears it (snapshot under "
+                f"the lock, iterate the copy)"))
+        # snapshot calls over shared dicts outside any lock
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in SNAPSHOT_CALLS and node.args:
+            name = _shared_dict_name(node.args[0])
+            if name is not None and not _in_lock_body(mod, node):
+                findings.append(mod.finding(
+                    RULE, node, f"unlocked-snapshot-{name}",
+                    f"snapshot of shared `{name}` outside any lock — "
+                    f"the copy itself can observe a resize"))
+
+
+def run(modules) -> list:
+    findings = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With):
+                _check_with_lock(mod, node, findings)
+        _check_shared_iteration(mod, findings)
+    return findings

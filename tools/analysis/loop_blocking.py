@@ -1,0 +1,138 @@
+"""loop-blocking: no blocking calls inside ``async def`` bodies.
+
+The event loop serves every in-flight request, heartbeat, and watch
+stream; one blocking call stalls them all (the PR 12 class: a chaos
+delay armed at a loop-side failpoint turned a brownout into spurious
+elections). Flagged inside async bodies (nested sync defs are skipped —
+they run in executors via ``asyncio.to_thread``/``run_in_executor``):
+
+- ``time.sleep`` and friends (the canonical offender)
+- blocking sqlite (``sqlite3.connect``), ``os.fsync``, subprocess waits,
+  blocking socket construction
+- non-awaited ``.get()``/``.put()`` on queue-shaped receivers (a
+  ``queue.Queue`` on the loop parks the whole process; ``asyncio.Queue``
+  calls are awaited and therefore exempt)
+- device-dispatch synchronization (``.block_until_ready()``) — a device
+  round-trip on the loop thread is a hidden multi-ms stall
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Module, call_name, terminal_attr
+
+RULE = "loop-blocking"
+
+BLOCKING_CALLS = {
+    "time.sleep": "blocking sleep",
+    "os.fsync": "blocking fsync",
+    "os.fdatasync": "blocking fsync",
+    "sqlite3.connect": "blocking sqlite open",
+    "socket.create_connection": "blocking connect",
+    "subprocess.run": "subprocess wait",
+    "subprocess.check_output": "subprocess wait",
+    "subprocess.check_call": "subprocess wait",
+    "subprocess.call": "subprocess wait",
+}
+
+BLOCKING_METHODS = {
+    "block_until_ready": "device sync",
+    "fsync": "blocking fsync",
+}
+
+# sqlite on db-shaped receivers: commit fsyncs on real files (the dtx
+# event log under --data-dir), and even reads serialize on the
+# connection lock
+SQLITE_METHODS = ("execute", "executemany", "executescript", "commit")
+DBISH = ("db", "_db", "conn", "_conn", "cur", "cursor", "_cursor",
+         "dbconn")
+
+QUEUEISH = ("queue", "_q")
+
+
+def _dbish(recv: ast.AST) -> bool:
+    name = terminal_attr(recv)
+    return name is not None and name.lower() in DBISH
+
+
+def _queueish(recv: ast.AST) -> bool:
+    name = terminal_attr(recv)
+    if name is None:
+        return False
+    low = name.lower()
+    return "queue" in low or low == "q" or low.endswith("_q")
+
+
+def _is_awaited(mod: Module, call: ast.Call) -> bool:
+    """Awaited directly, or wrapped in an awaited expression such as
+    ``await asyncio.wait_for(q.get(), ...)`` — an asyncio.Queue
+    coroutine, not a blocking call."""
+    for anc in mod.ancestors(call):
+        if isinstance(anc, ast.Await):
+            return True
+        if isinstance(anc, ast.stmt):
+            return False
+    return False
+
+
+def _check_call(mod: Module, call: ast.Call, out: list) -> None:
+    name = call_name(call)
+    if name is not None:
+        # match both "time.sleep" and "sleep" imported bare won't match —
+        # bare `sleep(...)` is caught by the suffix check below
+        for pat, why in BLOCKING_CALLS.items():
+            if name == pat or name.endswith("." + pat):
+                out.append(mod.finding(
+                    RULE, call, pat,
+                    f"{why} `{name}(...)` on the event loop — use "
+                    f"asyncio.to_thread / loop.run_in_executor"))
+                return
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        if meth in SQLITE_METHODS and _dbish(call.func.value):
+            out.append(mod.finding(
+                RULE, call, f"sqlite.{meth}",
+                f"blocking sqlite `.{meth}()` in an async body — a "
+                f"commit fsyncs on real files; run the DB op via "
+                f"asyncio.to_thread (the connection must be "
+                f"check_same_thread=False and lock-serialized)"))
+            return
+        if meth in BLOCKING_METHODS:
+            out.append(mod.finding(
+                RULE, call, meth,
+                f"{BLOCKING_METHODS[meth]} `.{meth}()` on the event "
+                f"loop — dispatch from a worker thread"))
+            return
+        if meth in ("get", "put") and _queueish(call.func.value) \
+                and not _is_awaited(mod, call):
+            # block=False / get_nowait-style kwargs make it non-blocking
+            for kw in call.keywords:
+                if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    return
+            out.append(mod.finding(
+                RULE, call, f"queue.{meth}",
+                f"non-awaited queue `.{meth}()` in an async body can "
+                f"park the event loop — await an asyncio.Queue or move "
+                f"to a worker thread"))
+
+
+def run(modules) -> list:
+    findings = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            stack = list(node.body)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue  # separate execution context
+                if isinstance(n, ast.Call):
+                    _check_call(mod, n, findings)
+                stack.extend(ast.iter_child_nodes(n))
+    return findings

@@ -1,0 +1,194 @@
+"""metrics-contract: every metric family registered consistently and
+documented — in both directions.
+
+The registry is get-or-create, so nothing at runtime stops two call
+sites registering ``engine_checks_total`` once as a counter and once as
+a gauge, or with different label keys — the scrape either breaks or
+silently splits a family. And docs/operations.md is how operators find
+families: an undocumented metric is invisible, a documented-but-removed
+one is a broken dashboard.
+
+Checks (code side = every ``*.counter/gauge/histogram("name", k=v…)``
+call on a metrics-shaped receiver):
+
+- literal names only — a computed name defeats this whole contract
+- one kind per name across the repo
+- one label-KEY set per name across the repo (values vary, keys must
+  not: a label key present on some increments and absent on others
+  splits the family into disjoint series)
+- both directions vs the ``## Metrics reference`` table in
+  docs/operations.md: every registered family has a row; every row
+  names a registered family; row kind and label columns agree with code
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Set
+
+from .core import Finding, Module, terminal_attr
+
+RULE = "metrics-contract"
+
+KINDS = ("counter", "gauge", "histogram")
+NON_LABEL_KWARGS = {"buckets"}
+DOCS_REL = "docs/operations.md"
+SECTION = "## Metrics reference"
+
+
+def _metrics_receiver(expr: ast.AST) -> bool:
+    name = terminal_attr(expr)
+    if name is None:
+        return False
+    low = name.lower()
+    return "metric" in low or "registry" in low or low == "reg"
+
+
+def _label_keys(call: ast.Call):
+    """Label-key set for a registration call; handles ``**{"class": v}``
+    splats with constant keys (``class`` is a Python keyword, so that's
+    the only way to pass it)."""
+    keys = set()
+    for kw in call.keywords:
+        if kw.arg is not None:
+            if kw.arg not in NON_LABEL_KWARGS:
+                keys.add(kw.arg)
+        elif isinstance(kw.value, ast.Dict) and all(
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                for k in kw.value.keys):
+            keys.update(k.value for k in kw.value.keys)
+        else:
+            return None  # opaque **splat: label set unknowable
+    return frozenset(keys)
+
+
+def _collect_code(modules):
+    """name -> {kinds, labelsets, sites:[(mod,node)]}; plus dynamic
+    sites."""
+    fam: Dict[str, dict] = {}
+    dynamic = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        for n in ast.walk(mod.tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in KINDS
+                    and _metrics_receiver(n.func.value)):
+                continue
+            if not n.args or not (isinstance(n.args[0], ast.Constant)
+                                  and isinstance(n.args[0].value, str)):
+                dynamic.append((mod, n))
+                continue
+            name = n.args[0].value
+            labels = _label_keys(n)
+            ent = fam.setdefault(name, {"kinds": set(), "labelsets": set(),
+                                        "sites": []})
+            ent["kinds"].add(n.func.attr)
+            if labels is not None:
+                ent["labelsets"].add(labels)
+            ent["sites"].append((mod, n))
+    return fam, dynamic
+
+
+_ROW_RE = re.compile(
+    r"^\|\s*`(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)`\s*\|\s*(?P<kind>\w+)\s*\|"
+    r"\s*(?P<labels>[^|]*)\|")
+
+
+def _parse_docs(root: str):
+    """rows: name -> (kind, labelkeys, lineno); None when the section is
+    missing entirely."""
+    path = os.path.join(root, DOCS_REL)
+    if not os.path.exists(path):
+        return None
+    rows: Dict[str, tuple] = {}
+    in_section = False
+    with open(path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if line.startswith("## "):
+                in_section = line.strip() == SECTION
+                continue
+            if not in_section:
+                continue
+            m = _ROW_RE.match(line)
+            if not m:
+                continue
+            labels = {t.strip().strip("`") for t in
+                      m.group("labels").split(",")}
+            labels = {x for x in labels if x and x not in ("—", "-")}
+            rows[m.group("name")] = (m.group("kind").lower(),
+                                     frozenset(labels), i)
+    return rows if rows else None
+
+
+def _doc_finding(line: int, token: str, msg: str) -> Finding:
+    return Finding(rule=RULE, path=DOCS_REL, line=line, scope="<doc>",
+                   token=token, message=msg)
+
+
+def run(modules, root: str) -> list:
+    findings = []
+    fam, dynamic = _collect_code(modules)
+    for mod, n in dynamic:
+        findings.append(mod.finding(
+            RULE, n, "dynamic-name",
+            "metric registered with a non-literal name — the "
+            "kind/label/docs contract can't be checked; use a literal "
+            "per family"))
+    for name in sorted(fam):
+        ent = fam[name]
+        mod, node = ent["sites"][0]
+        if len(ent["kinds"]) > 1:
+            findings.append(mod.finding(
+                RULE, node, f"kind-conflict-{name}",
+                f"`{name}` registered as multiple kinds "
+                f"({', '.join(sorted(ent['kinds']))}) — one family, one "
+                f"kind"))
+        if len(ent["labelsets"]) > 1:
+            pretty = " vs ".join(
+                "{" + ",".join(sorted(ls)) + "}"
+                for ls in sorted(ent["labelsets"], key=sorted))
+            findings.append(mod.finding(
+                RULE, node, f"label-conflict-{name}",
+                f"`{name}` registered with differing label-key sets "
+                f"({pretty}) — a key present on some increments and "
+                f"absent on others splits the family"))
+
+    docs = _parse_docs(root)
+    if docs is None:
+        findings.append(_doc_finding(
+            0, "missing-reference-section",
+            f"{DOCS_REL} has no populated `{SECTION}` table — the "
+            f"doc<->code family contract can't be checked"))
+        return findings
+    for name in sorted(fam):
+        ent = fam[name]
+        mod, node = ent["sites"][0]
+        if name not in docs:
+            findings.append(mod.finding(
+                RULE, node, f"undocumented-{name}",
+                f"`{name}` is registered but missing from the "
+                f"`{SECTION}` table in {DOCS_REL}"))
+            continue
+        dkind, dlabels, dline = docs[name]
+        kinds = ent["kinds"]
+        if len(kinds) == 1 and dkind not in kinds:
+            findings.append(_doc_finding(
+                dline, f"doc-kind-{name}",
+                f"docs say `{name}` is a {dkind}; code registers a "
+                f"{next(iter(kinds))}"))
+        code_labels = set().union(*ent["labelsets"])
+        if len(ent["labelsets"]) == 1 and dlabels != code_labels:
+            findings.append(_doc_finding(
+                dline, f"doc-labels-{name}",
+                f"docs label set {{{','.join(sorted(dlabels))}}} for "
+                f"`{name}` disagrees with code "
+                f"{{{','.join(sorted(code_labels))}}}"))
+    for name in sorted(set(docs) - set(fam)):
+        findings.append(_doc_finding(
+            docs[name][2], f"stale-doc-{name}",
+            f"docs table names `{name}` but no code registers it"))
+    return findings

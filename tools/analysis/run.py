@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Invariant lint suite runner.
+
+    python tools/analysis/run.py [--strict] [--select RULE,...] [paths]
+
+Runs the five AST passes (loop-blocking, lock-discipline, fail-closed,
+jit-stability, metrics-contract) over the package (default:
+``spicedb_kubeapi_proxy_tpu``). Findings matching
+``tools/analysis/allowlist.txt`` — fingerprints with a mandatory
+one-line justification — are reported as allowlisted; everything else
+is new. ``--strict`` (the CI gate, ``make analyze``) exits non-zero on
+any new finding or malformed allowlist entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.analysis import (core, fail_closed, jit_stability,  # noqa: E402
+                            lock_discipline, loop_blocking,
+                            metrics_contract)
+
+PASSES = {
+    loop_blocking.RULE: lambda mods, root: loop_blocking.run(mods),
+    lock_discipline.RULE: lambda mods, root: lock_discipline.run(mods),
+    fail_closed.RULE: lambda mods, root: fail_closed.run(mods),
+    jit_stability.RULE: lambda mods, root: jit_stability.run(mods),
+    metrics_contract.RULE:
+        lambda mods, root: metrics_contract.run(mods, root),
+}
+
+DEFAULT_PATHS = ("spicedb_kubeapi_proxy_tpu",)
+DEFAULT_ALLOWLIST = os.path.join("tools", "analysis", "allowlist.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
+    ap.add_argument("--root", default=_ROOT)
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any unallowlisted finding")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated pass names (default: all)")
+    ap.add_argument("--allowlist", default=None,
+                    help=f"allowlist path (default {DEFAULT_ALLOWLIST} "
+                         f"under --root; empty string disables)")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name in PASSES:
+            print(name)
+        return 0
+
+    selected = list(PASSES)
+    if args.select:
+        selected = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in selected if s not in PASSES]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}; "
+                  f"available: {', '.join(PASSES)}", file=sys.stderr)
+            return 2
+
+    al_path = args.allowlist
+    if al_path is None:
+        al_path = os.path.join(args.root, DEFAULT_ALLOWLIST)
+    allow = (core.Allowlist() if al_path == ""
+             else core.Allowlist.load(al_path))
+
+    paths = args.paths or list(DEFAULT_PATHS)
+    modules = core.load_modules(args.root, paths)
+    findings = []
+    for mod in modules:
+        if mod.tree is None:
+            findings.append(core.Finding(
+                rule="parse", path=mod.path,
+                line=mod.syntax_error.lineno or 0, scope="<module>",
+                token="syntax-error",
+                message=f"does not parse: {mod.syntax_error.msg}"))
+    for name in selected:
+        findings.extend(PASSES[name](modules, args.root))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.token))
+    new, allowed = [], []
+    for f in findings:
+        (allowed if allow.match(f) else new).append(f)
+
+    for f in new:
+        print(f.render())
+    if allowed:
+        print(f"-- {len(allowed)} allowlisted finding(s) "
+              f"(tools/analysis/allowlist.txt)")
+    for entry in allow.malformed:
+        print(f"allowlist: malformed entry (needs "
+              f"`rule|path|scope|token  # justification`): {entry}",
+              file=sys.stderr)
+    stale = allow.stale()
+    if stale:
+        print(f"-- {len(stale)} stale allowlist entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (no longer "
+              f"matched — prune when convenient):")
+        for fp in stale:
+            print(f"   {fp}")
+
+    print(f"analysis: {len(modules)} files, "
+          f"{len(new)} new / {len(allowed)} allowlisted finding(s), "
+          f"passes: {', '.join(selected)}")
+    if args.strict and (new or allow.malformed):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,7 @@ verify: lint analyze
 	@if [ "$(CHAOS)" = "1" ]; then $(MAKE) chaos; fi
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_caveats.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_scaleout.py
+	$(PY) -m pytest -q -p no:cacheprovider tests/test_rebalance.py
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

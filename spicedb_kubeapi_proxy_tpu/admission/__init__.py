@@ -34,6 +34,7 @@ from .classes import (  # noqa: F401
     CHECK,
     CLASSES,
     LOOKUP_PREFILTER,
+    REBALANCE,
     WATCH_RECOMPUTE,
     WRITE_DTX,
     CostClass,

@@ -48,9 +48,14 @@ BULK_CHECK = CostClass("bulk-check", 2.0, 2)
 LOOKUP_PREFILTER = CostClass("lookup-prefilter", 4.0, 1)
 WATCH_RECOMPUTE = CostClass("watch-recompute", 4.0, 0)
 WRITE_DTX = CostClass("write-dtx", 2.0, 3)
+# shard-rebalance mover traffic (scaleout/rebalance.py slice ops):
+# cost-accounted like any tenant's bulk work, and the FIRST class shed
+# under saturation — a live migration yields to serving traffic by
+# design (the mover backs off by the shed's Retry-After and resumes)
+REBALANCE = CostClass("rebalance", 2.0, -1)
 
 CLASSES = {c.name: c for c in (CHECK, BULK_CHECK, LOOKUP_PREFILTER,
-                               WATCH_RECOMPUTE, WRITE_DTX)}
+                               WATCH_RECOMPUTE, WRITE_DTX, REBALANCE)}
 
 # engine-host wire ops that pass through admission (engine/remote.py
 # EngineServer._dispatch); everything else — auth, failover_state,
@@ -65,6 +70,13 @@ _OP_CLASSES = {
     "watch_since": WATCH_RECOMPUTE,
     "write_relationships": WRITE_DTX,
     "delete_relationships": WRITE_DTX,
+    # the live tuple mover's data plane: slice export, idempotent
+    # import, catch-up replay, and GC — all sheddable migration traffic
+    "slice_read": REBALANCE,
+    "slice_load": REBALANCE,
+    "slice_apply": REBALANCE,
+    "slice_drop": REBALANCE,
+    "slice_watch": REBALANCE,
 }
 
 

@@ -95,6 +95,10 @@ class EpisodeEvidence:
     budget_ratio: Optional[float] = None
     budget_burst: Optional[float] = None
     attempts: Optional[int] = None
+    # the journal's persisted rebalance-transition record AFTER the
+    # episode's recovery completed (None when cleared — or when the
+    # episode ran no rebalance and the field carries no obligation)
+    rebalance_transition: Optional[dict] = None
 
 
 def check_never_fail_open(records: list) -> list[InvariantViolation]:
@@ -188,6 +192,28 @@ def check_split_journal_complete(pending_splits: Optional[int]
     return []
 
 
+def check_rebalance_converged(transition_doc: Optional[dict]
+                              ) -> list[InvariantViolation]:
+    """A crash-interrupted rebalance must land COMPLETED (every slice
+    cut, map committed — recorded as the durable phase-"done" marker a
+    stale-flag restart boots the committed map from) or CLEANLY
+    ABORTED (record cleared with routing never having left V). Any
+    other record still persisted after the episode's recovery finished
+    means the placement is parked half-routed — cut slices served from
+    the new map, uncut ones from the old, with nobody driving it
+    forward."""
+    if transition_doc is None or transition_doc.get("phase") == "done":
+        return []
+    slices = transition_doc.get("slices", [])
+    cut = sum(1 for s in slices if s.get("state") == "cut")
+    return [InvariantViolation(
+        "rebalance-converged",
+        f"rebalance transition (phase "
+        f"{transition_doc.get('phase')!r}, {cut}/{len(slices)} slices "
+        "cut) still persisted after recovery — neither completed nor "
+        "cleanly aborted")]
+
+
 def retry_amplification_bound(ratio: float, burst: float,
                               attempts: int, slack: float = 2.0) -> float:
     """The budget's worst-case total-retry bound for ``attempts``
@@ -225,6 +251,7 @@ def check_all(ev: EpisodeEvidence) -> list[InvariantViolation]:
     out += check_split_journal_complete(ev.pending_splits)
     out += check_retry_amplification(ev.retries_observed, ev.budget_ratio,
                                      ev.budget_burst, ev.attempts)
+    out += check_rebalance_converged(ev.rebalance_transition)
     return out
 
 
@@ -233,6 +260,7 @@ __all__ = [
     "KIND_CHECK", "KIND_DELETE", "KIND_LOOKUP", "KIND_WRITE",
     "OUTCOME_ERROR", "OUTCOME_OK", "OUTCOME_SHED",
     "check_all", "check_never_fail_open", "check_no_stale_verdict",
-    "check_retry_amplification", "check_split_journal_complete",
-    "check_zero_acked_write_loss", "retry_amplification_bound",
+    "check_rebalance_converged", "check_retry_amplification",
+    "check_split_journal_complete", "check_zero_acked_write_loss",
+    "retry_amplification_bound",
 ]

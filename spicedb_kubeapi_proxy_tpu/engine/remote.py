@@ -151,6 +151,14 @@ _IDEMPOTENT_OPS = frozenset({
     "check_bulk", "lookup_resources", "lookup_mask", "lookup_subjects",
     "object_ids", "revision", "exists", "watch_since", "watch_gate",
     "read_relationships", "traces",
+    # the rebalance mover's slice ops are idempotent BY CONSTRUCTION
+    # (slice_read is a pure read; slice_load/slice_apply replay as
+    # TOUCH/last-per-key effects; slice_drop deletes are idempotent),
+    # so unlike ordinary writes they are safe to re-send after an
+    # ambiguous transport death — exactly what a mid-copy SIGKILL of a
+    # group leader produces
+    "slice_read", "slice_load", "slice_apply", "slice_drop",
+    "slice_watch",
 })
 
 # "the transport failed" (vs the engine answering with an error): socket
@@ -181,6 +189,125 @@ def _rel_from_dict(d: dict) -> Relationship:
 
 def _filter_from_dict(d: dict) -> RelationshipFilter:
     return RelationshipFilter(**d)
+
+
+def po2_chunks(n: int, cap: int = 2048):
+    """Split ``n`` rows into descending power-of-two chunk sizes
+    (capped): the overlay's device scatter specializes per CHUNK SHAPE,
+    so arbitrary mover batch sizes would each pay an XLA compile while
+    holding the engine write path — with po2 bucketing at most
+    ``log2(cap)`` shapes ever exist, compiled once and reused across
+    every slice, round, and transition."""
+    sizes = []
+    c = 1
+    while c < cap:
+        c <<= 1
+    while n > 0:
+        while c > n:
+            c >>= 1
+        sizes.append(c)
+        n -= c
+    return sizes
+
+
+def _apply_po2(engine, rows, op: "str | None") -> int:
+    """Apply mover rows through the ordinary write path in power-of-two
+    chunks (see :func:`po2_chunks` — shape-stable overlay scatters, no
+    per-batch-size XLA compile on the write lock). ``op`` of None means
+    ``rows`` are WriteOps already. Module-level on purpose: op handlers
+    run against the role-gate's slim ``_EngineView`` pin, not the
+    server object."""
+    rev = engine.revision
+    i = 0
+    for c in po2_chunks(len(rows)):
+        chunk = rows[i:i + c]
+        rev = engine.write_relationships(
+            chunk if op is None else [WriteOp(op, r) for r in chunk])
+        i += c
+    return rev
+
+
+def _watch_events_wire(engine, revision) -> list:
+    """watch_since -> wire form (shared by the tenant watch op and the
+    mover's rebalance-classed twin; module-level because op handlers
+    run against the role-gate's slim ``_EngineView`` pin)."""
+    return [
+        {"revision": e.revision, "operation": e.operation,
+         "rel": _rel_to_dict(e.relationship)}
+        for e in engine.watch_since(revision)
+    ]
+
+
+def _slice_rows(engine, ranges, want_globals: bool) -> list:
+    """Live relationships in the requested partition-key hash ranges
+    (or the replicated global tuples) — the slice_read/slice_drop row
+    scan, shared with the in-process fallback in scaleout/rebalance."""
+    # function-level import: scaleout imports this module at load time
+    from ..scaleout.shardmap import hash_key, split_resource
+
+    rows = []
+    for rel in engine.read_relationships(RelationshipFilter()):
+        ns, namespaced = split_resource(rel.resource_id)
+        if want_globals:
+            if not namespaced:
+                rows.append(rel)
+            continue
+        if not namespaced:
+            continue
+        h = hash_key(ns, rel.resource_type)
+        if any(lo <= h < hi for lo, hi in ranges):
+            rows.append(rel)
+    return rows
+
+
+def _rels_to_cols(rels: list) -> dict:
+    """Relationship rows -> the columnar bulk form the PR 3 npz codec
+    carries (None expirations become NaN; optional strings become
+    empty — ``_cols_to_rels`` is the inverse)."""
+    cols = {k: [] for k in (
+        "resource_type", "resource_id", "relation", "subject_type",
+        "subject_id", "subject_relation", "expiration", "caveat",
+        "caveat_context")}
+    for r in rels:
+        cols["resource_type"].append(r.resource_type)
+        cols["resource_id"].append(r.resource_id)
+        cols["relation"].append(r.relation)
+        cols["subject_type"].append(r.subject_type)
+        cols["subject_id"].append(r.subject_id)
+        cols["subject_relation"].append(r.subject_relation or "")
+        cols["expiration"].append(r.expiration)
+        cols["caveat"].append(r.caveat or "")
+        cols["caveat_context"].append(r.caveat_context or "")
+    return cols
+
+
+def _cols_to_rels(cols: dict) -> list:
+    import math
+
+    n = len(cols.get("resource_id", ()))
+    srl = cols.get("subject_relation")
+    exp = cols.get("expiration")
+    cav = cols.get("caveat")
+    ctx = cols.get("caveat_context")
+
+    def opt(col, i):
+        if col is None:
+            return None
+        v = str(col[i])
+        return v or None
+
+    out = []
+    for i in range(n):
+        e = None
+        if exp is not None:
+            ev = float(exp[i])
+            e = None if (math.isnan(ev) or math.isinf(ev)) else ev
+        out.append(Relationship(
+            str(cols["resource_type"][i]), str(cols["resource_id"][i]),
+            str(cols["relation"][i]), str(cols["subject_type"][i]),
+            str(cols["subject_id"][i]), opt(srl, i), e,
+            opt(cav, i), opt(ctx, i)))
+    return out
 
 
 # -- framing -----------------------------------------------------------------
@@ -783,11 +910,15 @@ class EngineServer:
             engine.unsubscribe(q)
 
     def _op_watch_since(self, req: dict):
-        return [
-            {"revision": e.revision, "operation": e.operation,
-             "rel": _rel_to_dict(e.relationship)}
-            for e in self.engine.watch_since(req["revision"])
-        ]
+        return _watch_events_wire(self.engine, req["revision"])
+
+    def _op_slice_watch(self, req: dict):
+        """watch_since for the rebalance mover's catch-up polls: the
+        same answer, but admission-classed `rebalance` (lowest shed
+        priority) — the mover's recurring polls must yield to tenant
+        watch recomputes under saturation, per the migration-traffic
+        contract."""
+        return _watch_events_wire(self.engine, req["revision"])
 
     def _op_watch_gate(self, req: dict):
         types, use_exp = self.engine.watch_gate(
@@ -811,6 +942,62 @@ class EngineServer:
 
     def _op_exists(self, req: dict):
         return self.engine.store.exists(_filter_from_dict(req["filter"]))
+
+    # -- rebalance slice ops (scaleout/rebalance.py data plane) --------------
+    # All idempotent, all admission-classed `rebalance` (lowest shed
+    # priority): a live migration is cost-accounted and sheddable like
+    # any tenant's bulk traffic.
+
+    def _op_slice_read(self, req: dict):
+        """Export the live namespaced tuples whose partition-key hash
+        falls in the requested ``[lo, hi)`` ranges (or the replicated
+        GLOBAL tuples with ``globals``), riding the npz codec as one
+        binary frame. The revision is read BEFORE the row scan so the
+        caller's catch-up replay covers any write that raced the scan
+        (touch replays are idempotent: at-least-once)."""
+        from ..persistence.codec import encode_bulk_cols
+
+        ranges = [(int(lo), int(hi))
+                  for lo, hi in (req.get("ranges") or ())]
+        rev = int(self.engine.revision)
+        rows = _slice_rows(self.engine, ranges,
+                           bool(req.get("globals")))
+        return BinaryResult({"slice": True, "revision": rev,
+                             "n": len(rows)},
+                            encode_bulk_cols(_rels_to_cols(rows)))
+
+    def _op_slice_load(self, req: dict):
+        """Idempotent slice import: the npz payload's rows apply as
+        TOUCHes through the ordinary write path (validated, journaled,
+        replicated, watch-logged — the merged sharded streams suppress
+        these below the slice's cut revision)."""
+        import base64
+
+        from ..persistence.codec import decode_bulk_cols
+
+        rels = _cols_to_rels(decode_bulk_cols(
+            base64.b64decode(req["payload_b64"])))
+        return {"revision": _apply_po2(self.engine, rels, "touch"),
+                "rows": len(rels)}
+
+    def _op_slice_apply(self, req: dict):
+        """Catch-up replay: concrete touch/delete effects (already
+        last-per-key deduped by the mover) through the ordinary write
+        path."""
+        ops = [WriteOp(o["op"], _rel_from_dict(o["rel"]))
+               for o in req["ops"]]
+        return {"revision": _apply_po2(self.engine, ops, None),
+                "rows": len(ops)}
+
+    def _op_slice_drop(self, req: dict):
+        """GC after cutover: delete the moved rows — ordinary journaled
+        deletes, idempotent, suppressed by the merged streams past the
+        slice's cut revision."""
+        ranges = [(int(lo), int(hi))
+                  for lo, hi in (req.get("ranges") or ())]
+        rows = _slice_rows(self.engine, ranges, False)
+        return {"revision": _apply_po2(self.engine, rows, "delete"),
+                "rows": len(rows)}
 
     def _op_traces(self, req: dict):
         """This host's recent kept-trace ring (diagnostics, never
@@ -1361,6 +1548,65 @@ class RemoteEngine:
         except RemoteEngineError:
             return None, True
 
+    # -- rebalance slice ops (idempotent mover data plane) -------------------
+
+    def slice_read(self, ranges, want_globals: bool = False):
+        """(src_revision, [Relationship...]) for the hash ranges — one
+        npz binary frame, not a JSON row list."""
+        from ..persistence.codec import decode_bulk_cols
+
+        r = self._call_any("slice_read",
+                           ranges=[[int(lo), int(hi)]
+                                   for lo, hi in ranges],
+                           **{"globals": bool(want_globals)})
+        if not isinstance(r, tuple):
+            raise RemoteEngineError(
+                f"slice_read answered a non-binary frame: {r!r}")
+        meta, payload = r
+        return int(meta["revision"]), _cols_to_rels(
+            decode_bulk_cols(payload))
+
+    def slice_load(self, rels) -> int:
+        """Idempotent TOUCH import of exported rows; returns the
+        destination revision after the load."""
+        import base64
+
+        from ..persistence.codec import encode_bulk_cols
+
+        r = self._call("slice_load", payload_b64=base64.b64encode(
+            encode_bulk_cols(_rels_to_cols(list(rels)))).decode())
+        return int(r["revision"])
+
+    def slice_apply(self, ops) -> int:
+        """Catch-up replay of concrete touch/delete effects."""
+        r = self._call("slice_apply",
+                       ops=[{"op": o.op, "rel": _rel_to_dict(o.rel)}
+                            for o in ops])
+        return int(r["revision"])
+
+    def slice_drop(self, ranges) -> int:
+        """Post-cutover GC of the moved rows; returns rows dropped."""
+        r = self._call("slice_drop",
+                       ranges=[[int(lo), int(hi)]
+                               for lo, hi in ranges])
+        return int(r["rows"])
+
+    def slice_watch_since(self, revision: int) -> list:
+        """The mover's catch-up poll: ``watch_since`` under the
+        rebalance admission class; falls back to the tenant op against
+        hosts predating it (same answer, old cost class)."""
+        try:
+            frames = self._call("slice_watch", revision=revision)
+        except EngineInternalError:
+            raise
+        except RemoteEngineError:
+            return self.watch_since(revision)
+        return [
+            WatchEvent(d["revision"], d["operation"],
+                       _rel_from_dict(d["rel"]))
+            for d in frames
+        ]
+
     @property
     def revision(self) -> int:
         return self._call("revision")
@@ -1684,6 +1930,26 @@ class FailoverEngine:
 
     def watch_gate(self, resource_type: str, name: str):
         return self._invoke(lambda c: c.watch_gate(resource_type, name))
+
+    # rebalance slice ops: idempotent by construction, so they follow
+    # the READ re-issue discipline — after a transport death or a
+    # not_leader rejection (a SIGKILL'd group leader mid-copy), the
+    # re-aimed re-issue converges instead of double-applying
+    def slice_read(self, ranges, want_globals: bool = False):
+        return self._invoke(
+            lambda c: c.slice_read(ranges, want_globals=want_globals))
+
+    def slice_load(self, rels) -> int:
+        return self._invoke(lambda c: c.slice_load(rels))
+
+    def slice_apply(self, ops) -> int:
+        return self._invoke(lambda c: c.slice_apply(ops))
+
+    def slice_drop(self, ranges) -> int:
+        return self._invoke(lambda c: c.slice_drop(ranges))
+
+    def slice_watch_since(self, revision: int) -> list:
+        return self._invoke(lambda c: c.slice_watch_since(revision))
 
     def fetch_traces(self, limit: int = 64) -> list:
         """Trace fragments from EVERY reachable endpoint (a re-aimed

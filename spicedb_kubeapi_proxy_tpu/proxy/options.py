@@ -223,6 +223,13 @@ class Options:
     # proxy replica (the per-group host-side caches stay exact
     # regardless).
     shard_cache: bool = False
+    # online rebalance (scaleout/rebalance.py): a TARGET shard map
+    # (inline JSON or path, same grammar as --shard-map) with a HIGHER
+    # version. On boot the planner starts the live tuple mover — plan /
+    # copy / catch-up / dual-write / per-slice cutover / GC — taking
+    # the fleet from the current map to this one with no drain;
+    # progress rides /readyz as `rebalance: moving=K copied=J lag=...`.
+    rebalance_to: Optional[str] = None
     # >0 probes the device backend in a SUBPROCESS with this timeout
     # before building an in-process engine: the remotely-attached TPU
     # plugin HANGS (not errors) when its tunnel is down, which would
@@ -364,9 +371,30 @@ class Options:
             from ..scaleout import ShardMapError, load_shard_map
 
             try:
-                load_shard_map(self.shard_map)
+                smap = load_shard_map(self.shard_map)
             except ShardMapError as e:
                 raise OptionsError(str(e)) from None
+            if self.rebalance_to:
+                try:
+                    target = load_shard_map(self.rebalance_to)
+                except ShardMapError as e:
+                    raise OptionsError(
+                        f"rebalance-to: {e}") from None
+                if target.version <= smap.version:
+                    raise OptionsError(
+                        f"rebalance-to map version {target.version} "
+                        f"must exceed the current shard-map version "
+                        f"{smap.version}")
+                if target.n_groups < smap.n_groups:
+                    raise OptionsError(
+                        "rebalance-to cannot REMOVE groups yet: group "
+                        "indices are identity across a transition "
+                        "(move the slices first, then retire the "
+                        "empty group in a later map)")
+        elif self.rebalance_to:
+            raise OptionsError(
+                "rebalance-to requires --shard-map (it is a transition "
+                "between two shard maps)")
         if remote is None and self.engine_endpoint not in (EMBEDDED_ENDPOINT,
                                                            TPU_ENDPOINT):
             raise OptionsError(
@@ -642,16 +670,16 @@ class Options:
                     smap = load_shard_map(self.shard_map)
                 except ShardMapError as e:
                     raise OptionsError(str(e)) from None
-                groups = []
-                for eps in smap.groups:
+                def group_client(eps):
                     if len(eps) == 1:
-                        groups.append(RemoteEngine(
-                            *eps[0], token=self.engine_token,
-                            **client_kw))
-                    else:
-                        groups.append(FailoverEngine(
-                            list(eps), token=self.engine_token,
-                            **client_kw))
+                        return RemoteEngine(*eps[0],
+                                            token=self.engine_token,
+                                            **client_kw)
+                    return FailoverEngine(list(eps),
+                                          token=self.engine_token,
+                                          **client_kw)
+
+                groups = [group_client(eps) for eps in smap.groups]
                 journal_path = self.shard_journal_path
                 if journal_path is None:
                     import os as _osj
@@ -665,7 +693,53 @@ class Options:
                     smap, groups, journal=SplitJournal(journal_path),
                     cache=(ShardVectorCache() if self.shard_cache
                            else None),
-                    retry_budget=engine_budget)
+                    retry_budget=engine_budget,
+                    # lets a persisted mid-rebalance transition
+                    # reconstruct clients for groups the target map
+                    # ADDED beyond --shard-map at the next boot
+                    client_factory=group_client)
+                if self.rebalance_to:
+                    from ..scaleout import (
+                        RebalanceError,
+                        ShardMapError as _SME,
+                        load_shard_map as _load_target,
+                    )
+
+                    try:
+                        # validate() parsed this already, but the file
+                        # can change between the two reads — the second
+                        # load must fail as cleanly as the first
+                        target = _load_target(self.rebalance_to)
+                    except _SME as e:
+                        raise OptionsError(
+                            f"rebalance-to: {e}") from None
+                    active = engine._active_transition
+                    if active is not None:
+                        # a persisted transition already resumed at
+                        # recovery; the flag must agree with it
+                        if active.new_map.version != target.version:
+                            raise OptionsError(
+                                "rebalance-to names map version "
+                                f"{target.version} but a transition to "
+                                f"version {active.new_map.version} is "
+                                "already in flight")
+                    elif target.version <= engine.map.version:
+                        # the move already completed (the journal's
+                        # durable "done" record made the target map
+                        # authoritative at recovery) — re-running it
+                        # against the GC'd sources would route the
+                        # moved slices to empty groups
+                        import logging as _logging
+
+                        _logging.getLogger("sdbkp.options").info(
+                            "rebalance-to v%d already completed; "
+                            "serving it (update --shard-map and drop "
+                            "the flag)", target.version)
+                    else:
+                        try:
+                            engine.begin_rebalance(target)
+                        except RebalanceError as e:
+                            raise OptionsError(str(e)) from None
             elif len(remote) == 1:
                 engine = RemoteEngine(*remote[0],
                                       token=self.engine_token,
@@ -914,6 +988,7 @@ class Options:
         "delta_capacity", "compact_threshold",
         "caveat_context", "caveat_ip_header",
         "shard_map", "shard_journal_path", "shard_cache",
+        "rebalance_to",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
@@ -1150,6 +1225,16 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "attributes (default off; per-group "
                              "host-side caches stay exact and context-"
                              "digested regardless)")
+    parser.add_argument("--rebalance-to",
+                        help="online shard rebalance: a TARGET shard "
+                             "map (same grammar as --shard-map, HIGHER "
+                             "version). Boot starts the live tuple "
+                             "mover — copy / catch-up / dual-write / "
+                             "per-slice cutover / GC — migrating to "
+                             "the new placement with no drain; "
+                             "progress on /readyz as 'rebalance: "
+                             "moving=K copied=J lag=...' (see "
+                             "docs/operations.md 'Rebalancing')")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
     parser.add_argument("--enable-debug-config", action="store_true",
@@ -1360,6 +1445,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         shard_map=args.shard_map,
         shard_journal_path=args.shard_journal_path,
         shard_cache=args.shard_cache,
+        rebalance_to=args.rebalance_to,
         engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,

@@ -196,6 +196,17 @@ class Server:
                         f"sharding: groups={len(st['groups'])} "
                         f"map_version={st['version']} {per_group} "
                         f"pending_splits={st['pending_splits']}")
+                    reb = st.get("rebalance")
+                    if reb:
+                        # a live tuple move in flight: informational
+                        # like the sharding line (migration is the
+                        # system working, not unreadiness)
+                        info_lines.append(
+                            f"rebalance: to_version="
+                            f"{reb['to_version']} "
+                            f"moving={reb['moving']} "
+                            f"copied={reb['copied']} "
+                            f"cut={reb['cut']} lag={reb['lag']}")
                 except Exception:  # noqa: BLE001 - readyz must answer
                     info_lines.append("sharding: status unavailable")
             # admission shed/queue state is INFORMATIONAL: shedding is

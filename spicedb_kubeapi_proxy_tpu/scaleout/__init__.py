@@ -19,7 +19,12 @@ subsystem partitions the relationship space itself:
 - ``journal.py`` — the dtx-style :class:`SplitJournal`: cross-shard
   writes are journaled durably before the first shard applies, so a
   mid-split crash replays to completion instead of leaving a silent
-  half-write.
+  half-write; also the durable home of the live-rebalance transition
+  record.
+- ``rebalance.py`` — the online tuple mover: map V -> V+1 without a
+  drain, via plan / copy / catch-up / dual-write / per-slice cutover /
+  GC (:class:`RebalanceCoordinator`), with read-owner-only watch
+  delivery keeping merged streams exact across the flip.
 """
 
 from .journal import SplitJournal  # noqa: F401
@@ -28,11 +33,21 @@ from .planner import (  # noqa: F401
     ShardedWatchStream,
     ShardVectorCache,
 )
+from .rebalance import (  # noqa: F401
+    MapTransition,
+    MovingSlice,
+    RebalanceCoordinator,
+    RebalanceError,
+    abort_transition,
+    plan_moves,
+)
 from .shardmap import (  # noqa: F401
     RevisionVector,
     ShardMap,
     ShardMapError,
+    hash_key,
     load_shard_map,
+    map_to_doc,
     parse_shard_map,
     split_resource,
 )

@@ -49,22 +49,51 @@ class SplitJournal:
             " map_version INTEGER NOT NULL,"
             " plan TEXT NOT NULL,"       # JSON {shard: [op dicts...]}
             " preconditions TEXT NOT NULL,"
-            " applied TEXT NOT NULL)")   # JSON [shard, ...]
+            " applied TEXT NOT NULL,"    # JSON [shard, ...]
+            # rebalance dual-writes are journaled under BOTH versions:
+            # the map the split routed by and the transition's target
+            # (NULL outside a rebalance window) — so replay after a
+            # mid-window crash knows the recorded owners are already
+            # the union of both placements, not a stale single-map plan
+            " map_version_to INTEGER)")
+        self._migrate()
+        # the live-rebalance transition record: at most ONE row — the
+        # tuple mover persists every slice-state change here before it
+        # takes routing effect (the crash matrix's source of truth)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rebalance_transition ("
+            " id INTEGER PRIMARY KEY CHECK (id = 0),"
+            " updated REAL NOT NULL,"
+            " doc TEXT NOT NULL)")
         self._db.commit()
+
+    def _migrate(self) -> None:
+        """Journals created before the rebalance PR lack the
+        ``map_version_to`` column; add it in place (NULL for every
+        pre-existing entry — exactly the "no transition" meaning)."""
+        cols = {r[1] for r in self._db.execute(
+            "PRAGMA table_info(split_writes)").fetchall()}
+        if "map_version_to" not in cols:
+            self._db.execute("ALTER TABLE split_writes "
+                             "ADD COLUMN map_version_to INTEGER")
 
     # -- write path ----------------------------------------------------------
 
     def begin(self, plan: dict, preconditions: list,
-              map_version: int) -> str:
+              map_version: int,
+              map_version_to: "int | None" = None) -> str:
         """Durably record the split BEFORE any shard applies; returns
-        the entry id. ``plan`` maps shard index -> serialized op list."""
+        the entry id. ``plan`` maps shard index -> serialized op list.
+        ``map_version_to`` tags splits planned inside a rebalance
+        window with the transition's target version."""
         sid = uuid.uuid4().hex
         with self._lock:
             self._db.execute(
-                "INSERT INTO split_writes VALUES (?,?,?,?,?,?)",
+                "INSERT INTO split_writes VALUES (?,?,?,?,?,?,?)",
                 (sid, time.time(), map_version,
                  json.dumps({str(k): v for k, v in plan.items()}),
-                 json.dumps(preconditions), json.dumps([])))
+                 json.dumps(preconditions), json.dumps([]),
+                 map_version_to))
             self._db.commit()
         metrics.counter("scaleout_split_writes_total").inc()
         return sid
@@ -93,16 +122,20 @@ class SplitJournal:
 
     def pending(self) -> list[dict]:
         """Every unfinished split, oldest first: ``{id, map_version,
-        plan: {shard int: [op dicts]}, preconditions, applied: set}``."""
+        map_version_to, plan: {shard int: [op dicts]}, preconditions,
+        applied: set}``."""
         with self._lock:
             rows = self._db.execute(
-                "SELECT id, map_version, plan, preconditions, applied "
-                "FROM split_writes ORDER BY created").fetchall()
+                "SELECT id, map_version, plan, preconditions, applied, "
+                "map_version_to FROM split_writes "
+                "ORDER BY created").fetchall()
         out = []
-        for sid, ver, plan, pcs, applied in rows:
+        for sid, ver, plan, pcs, applied, ver_to in rows:
             out.append({
                 "id": sid,
                 "map_version": int(ver),
+                "map_version_to": (None if ver_to is None
+                                   else int(ver_to)),
                 "plan": {int(k): v
                          for k, v in json.loads(plan).items()},
                 "preconditions": json.loads(pcs),
@@ -115,6 +148,32 @@ class SplitJournal:
             (n,) = self._db.execute(
                 "SELECT COUNT(*) FROM split_writes").fetchone()
         return int(n)
+
+    # -- rebalance transition record -----------------------------------------
+
+    def save_transition(self, doc: dict) -> None:
+        """Upsert THE transition record (one live transition at a
+        time); called before every slice-state change takes routing
+        effect, so a crash recovers to the exact persisted phase."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO rebalance_transition (id, updated, doc) "
+                "VALUES (0, ?, ?) ON CONFLICT(id) DO UPDATE SET "
+                "updated=excluded.updated, doc=excluded.doc",
+                (time.time(), json.dumps(doc)))
+            self._db.commit()
+
+    def load_transition(self) -> "dict | None":
+        with self._lock:
+            row = self._db.execute(
+                "SELECT doc FROM rebalance_transition WHERE id=0"
+            ).fetchone()
+        return None if row is None else json.loads(row[0])
+
+    def clear_transition(self) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM rebalance_transition")
+            self._db.commit()
 
     def close(self) -> None:
         with self._lock:

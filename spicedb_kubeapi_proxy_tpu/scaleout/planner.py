@@ -59,7 +59,20 @@ from ..models.tuples import Relationship
 from ..obs.trace import tracer
 from ..utils.metrics import metrics
 from .journal import SplitJournal
-from .shardmap import RevisionVector, ShardMap
+from .rebalance import (
+    CUT as CUT_STATE,
+    MapTransition,
+    RebalanceCoordinator,
+    RebalanceError,
+    abort_transition,
+    plan_moves,
+)
+from .shardmap import (
+    RevisionVector,
+    ShardMap,
+    ShardMapError,
+    split_resource,
+)
 
 import logging
 
@@ -180,14 +193,32 @@ class ShardedWatchStream:
         self._streams_lock = threading.Lock()
         self._threads: list = []
         self._vec_lock = threading.Lock()
+        from_vector = from_vector.extend(len(planner.groups))
         self.revision = from_vector
+        self._n_pumps = 0
         for gi, client in enumerate(planner.groups):
-            t = threading.Thread(
-                target=self._pump, args=(gi, client,
-                                         int(from_vector[gi])),
-                name=f"shard-watch-g{gi}", daemon=True)
-            self._threads.append(t)
-            t.start()
+            self._start_pump(gi, client, int(from_vector[gi]))
+
+    def _start_pump(self, gi: int, client, from_rev: int) -> None:
+        t = threading.Thread(
+            target=self._pump, args=(gi, client, from_rev),
+            name=f"shard-watch-g{gi}", daemon=True)
+        self._threads.append(t)
+        self._n_pumps = max(self._n_pumps, gi + 1)
+        t.start()
+
+    def _ensure_pumps(self) -> None:
+        """A rebalance may ADD groups after this stream opened: start a
+        pump for each (from revision 0 — a new group's history is
+        nothing but moved tuples, and the delivery filter suppresses
+        everything below its slices' cut revisions)."""
+        groups = self._p.groups
+        if len(groups) <= self._n_pumps:
+            return
+        with self._vec_lock:
+            self.revision = self.revision.extend(len(groups))
+        for gi in range(self._n_pumps, len(groups)):
+            self._start_pump(gi, groups[gi], 0)
 
     def _register_stream(self, s) -> bool:
         """Track an opened per-group stream; closes it immediately if
@@ -240,7 +271,11 @@ class ShardedWatchStream:
 
     def next_batch(self) -> list:
         """Blocks for the next batch from ANY group; ``[]`` means the
-        wait timed out (liveness heartbeat semantics)."""
+        wait timed out (liveness heartbeat semantics). Events pass the
+        planner's rebalance delivery filter — the resumption token
+        still advances past suppressed mover echoes, so a consumer
+        resuming from ``self.revision`` never sees them either."""
+        self._ensure_pumps()
         try:
             gi, events, err = self._q.get(timeout=self._p.PUSH_WAIT)
         except _queue.Empty:
@@ -248,11 +283,15 @@ class ShardedWatchStream:
         if err is not None:
             raise err
         with self._vec_lock:
+            if gi >= len(self.revision):
+                self.revision = self.revision.extend(gi + 1)
             out = []
             for e in events:
                 self.revision = self.revision.bump(gi, e.revision)
-                out.append(WatchEvent(self.revision, e.operation,
-                                      e.relationship))
+                if self._p._deliver_event(gi, e.relationship,
+                                          e.revision):
+                    out.append(WatchEvent(self.revision, e.operation,
+                                          e.relationship))
             self._p._observe_revision(gi, max(
                 e.revision for e in events))
         return out
@@ -278,7 +317,8 @@ class ShardedEngine:
     def __init__(self, shard_map: ShardMap, groups: list,
                  journal: Optional[SplitJournal] = None,
                  cache: Optional[ShardVectorCache] = None,
-                 recover: bool = True, retry_budget=None):
+                 recover: bool = True, retry_budget=None,
+                 client_factory=None):
         if len(groups) != shard_map.n_groups:
             raise ValueError(
                 f"shard map names {shard_map.n_groups} groups, got "
@@ -292,6 +332,10 @@ class ShardedEngine:
         # draw from it too, so a browned-out shard sees one bounded
         # retry stream instead of per-layer multiplication
         self.retry_budget = retry_budget
+        # builds an engine client for a group's endpoint list — how a
+        # restarted planner reconstructs clients for groups a persisted
+        # rebalance transition ADDED beyond the booted map
+        self.client_factory = client_factory
         self.store = _ShardedStoreShim(self)
         self.dependency = "engine-shards"
         self._pool = ThreadPoolExecutor(
@@ -299,8 +343,19 @@ class ShardedEngine:
             thread_name_prefix="shard-scatter")
         self._vec_lock = threading.Lock()
         self._vector = shard_map.zero_vector()
+        # live tuple mover (rebalance.py): at most one ACTIVE map
+        # transition routes reads/writes/watches; completed ones stay
+        # archived for token translation and watch-event filtering
+        self._active_transition: Optional[MapTransition] = None
+        self._archived_transitions: list = []
+        self._coordinator: Optional[RebalanceCoordinator] = None
         metrics.gauge("scaleout_groups").set(shard_map.n_groups)
         metrics.gauge("scaleout_map_version").set(shard_map.version)
+        if journal is not None:
+            # BEFORE split recovery and before any request: a persisted
+            # transition with cut slices changes routing — serving
+            # without it would misroute the cut slices' tuples
+            self._recover_transition()
         if recover and journal is not None:
             try:
                 self.recover_splits()
@@ -326,6 +381,9 @@ class ShardedEngine:
         except (TypeError, ValueError):
             return
         with self._vec_lock:
+            if shard >= len(self._vector):
+                # a rebalance-added group: grow the tracked vector
+                self._vector = self._vector.extend(shard + 1)
             self._vector = self._vector.bump(shard, revision)
         # no eager cache sweep: dominated entries are already
         # unreachable (get() matches the exact vector) and the TTL
@@ -362,6 +420,317 @@ class ShardedEngine:
         instead of replaying every shard's history."""
         return self.revision_vector(refresh=not any(self.vector))
 
+    # -- online rebalance (scaleout/rebalance.py) ----------------------------
+
+    def begin_rebalance(self, new_map: ShardMap,
+                        new_clients: Optional[dict] = None,
+                        **coordinator_cfg) -> RebalanceCoordinator:
+        """Start a live map transition V -> ``new_map.version`` on a
+        background mover thread (``--rebalance-to``). ``new_clients``
+        maps ADDED group indices to their engine clients (or a
+        ``client_factory`` builds them from the map's endpoints).
+        Returns the coordinator; routing changes take effect per slice
+        as the protocol advances — no drain, ever."""
+        if self._active_transition is not None:
+            raise RebalanceError(
+                "a rebalance is already in flight (to map version "
+                f"{self._active_transition.new_map.version})")
+        t = MapTransition(self.map, new_map,
+                          plan_moves(self.map, new_map))
+        self._install_transition(t, new_clients)
+        coord = RebalanceCoordinator(self, t, **coordinator_cfg)
+        self._coordinator = coord
+        return coord.start()
+
+    def _install_transition(self, t: MapTransition,
+                            new_clients: Optional[dict] = None,
+                            persist: bool = True) -> None:
+        """Extend the group/vector space with the transition's added
+        groups and make the transition route — persisted before any
+        data moves."""
+        for gi in t.new_groups:
+            if gi < len(self.groups):
+                continue
+            if gi != len(self.groups):
+                raise RebalanceError(
+                    f"transition adds group {gi} but only "
+                    f"{len(self.groups)} groups exist")
+            client = (new_clients or {}).get(gi)
+            if client is None and self.client_factory is not None:
+                client = self.client_factory(t.new_map.groups[gi])
+            if client is None:
+                raise RebalanceError(
+                    f"no client for rebalance-added group {gi}; pass "
+                    "new_clients or a client_factory")
+            with self._vec_lock:
+                self.groups.append(client)
+                self._vector = self._vector.extend(len(self.groups))
+        self._active_transition = t
+        if persist and self.journal is not None:
+            self.journal.save_transition(t.to_doc())
+
+    def commit_rebalance(self, t: MapTransition) -> None:
+        """Every slice cut: map V+1 becomes THE map (atomic swap); the
+        transition is archived — its cut table keeps filtering watch
+        replays and translating V-minted resumption tokens."""
+        if not t.all_cut():
+            raise RebalanceError(
+                "commit before every slice cut would misroute the "
+                "uncut slices")
+        with self._vec_lock:
+            self.map = t.new_map
+        self._active_transition = None
+        self._archived_transitions.append(t)
+        # bound the era-walk/translation memory: resumption tokens old
+        # enough to predate the 8 most recent transitions get re-list
+        # semantics (their groups' watch logs have long been trimmed
+        # past those cut revisions anyway)
+        del self._archived_transitions[:-8]
+        metrics.gauge("scaleout_groups").set(t.new_map.n_groups)
+        metrics.gauge("scaleout_map_version").set(t.new_map.version)
+
+    def _recover_transition(self) -> None:
+        """Boot-time crash matrix (see rebalance.py): committed or
+        all-cut -> finish the commit; some-cut -> install + RESUME (the
+        flip already moved data authoritatively); none-cut -> clean
+        abort (routing never left V)."""
+        doc = self.journal.load_transition()
+        if doc is None:
+            return
+        if doc.get("phase") == "done":
+            # a COMPLETED transition's durable marker (see
+            # run_to_completion): the doc's target map is authoritative
+            done_ver = int(doc["new_map"]["version"])
+            if done_ver == self.map.version:
+                # the operator rolled --shard-map to the new version:
+                # the marker has served its purpose
+                self.journal.clear_transition()
+                return
+            t = MapTransition.from_doc(doc, self.map)
+            t.gc_complete = True
+            # persist=False: installing must not clobber the durable
+            # "done" marker with a "running" record
+            self._install_transition(t, persist=False)
+            self.commit_rebalance(t)
+            log.warning(
+                "booted with --shard-map v%d but rebalance to v%d "
+                "already completed — serving the completed map (update "
+                "the flag to clear this)", doc["old_version"], done_ver)
+            return
+        t = MapTransition.from_doc(doc, self.map)
+        if doc.get("phase") == "committed" or t.all_cut():
+            # raises if rebalance-added groups have no clients: serving
+            # without them would misroute every cut slice (fail closed)
+            self._install_transition(t)
+            self.commit_rebalance(t)
+            coord = RebalanceCoordinator(self, t)
+
+            def _finish_gc():
+                # OFF the boot path: the GC is a full source scan plus
+                # batched deletes — leftover copies are inert until it
+                # lands (the scatter-merge owner filter guards them)
+                try:
+                    coord._gc()
+                    t.gc_complete = True
+                    self.journal.save_transition(t.to_doc("done"))
+                except Exception as e:  # noqa: BLE001 - re-runnable
+                    log.warning(
+                        "rebalance GC after recovered commit "
+                        "incomplete (leftover source copies are inert "
+                        "and re-dropped at the next boot): %s", e)
+
+            threading.Thread(target=_finish_gc, daemon=True,
+                             name="rebalance-gc").start()
+            metrics.counter("scaleout_rebalance_transitions_total",
+                            outcome="recovered").inc()
+        elif t.any_cut():
+            log.warning(
+                "resuming interrupted rebalance to map v%d (%d/%d "
+                "slices already cut)", t.new_map.version,
+                sum(1 for s in t.slices if s.state == "cut"),
+                len(t.slices))
+            self._install_transition(t)
+            self._coordinator = RebalanceCoordinator(self, t).start()
+            metrics.counter("scaleout_rebalance_transitions_total",
+                            outcome="resumed").inc()
+        else:
+            log.warning("aborting interrupted rebalance to map v%d "
+                        "(no slice had cut — routing never left "
+                        "v%d)", t.new_map.version, self.map.version)
+            try:
+                # drain pending dual-write splits FIRST: their
+                # destination mirror legs would otherwise be re-created
+                # AFTER the abort's copy drop and linger as stale rows
+                self.recover_splits()
+            except Exception as e:  # noqa: BLE001 - deferred like boot
+                log.warning("split replay before rebalance abort "
+                            "deferred: %s", e)
+            abort_transition(self, t)
+
+    def _read_anchor(self, resource_type: str, resource_id: str) -> int:
+        """The ONE group answering reads anchored at this object right
+        now: the moving-slice read owner during a transition (src until
+        the slice's cut, dst after), the map owner otherwise. Global
+        anchors keep the CURRENT map's deterministic anchor — every
+        group in it holds the replicated globals throughout."""
+        t = self._active_transition
+        if t is not None:
+            sl = t.slice_for(resource_type, resource_id)
+            if sl is not None:
+                return t.read_owner(sl)
+        return self.map.anchor_shard(resource_type, resource_id)
+
+    def _copies_may_linger(self) -> bool:
+        """True while ANY transition's mover copies can still exist
+        off-owner: an active transition, or an archived one whose GC
+        has not finished. Once every transition is GC-complete the
+        per-row owner filters have nothing to guard and the scatter
+        fast paths return."""
+        if self._active_transition is not None:
+            return True
+        return any(not t.gc_complete
+                   for t in self._archived_transitions)
+
+    def _admit_gathered(self, gi: int, resource_type: str,
+                        resource_id: str) -> bool:
+        """Scatter-merge filter while moved copies exist anywhere: a
+        namespaced row is accepted only from its current read owner —
+        a destination's not-yet-caught-up copy (or a source's
+        not-yet-GC'd leftover) can never leak a stale grant into the
+        union (fail-open)."""
+        if not self._copies_may_linger():
+            return True
+        _, namespaced = split_resource(resource_id)
+        if not namespaced:
+            return True
+        return self._read_anchor(resource_type, resource_id) == gi
+
+    def _transitions(self) -> list:
+        """Archived transitions in completion order, plus the active
+        one last."""
+        ts = list(self._archived_transitions)
+        if self._active_transition is not None:
+            ts.append(self._active_transition)
+        return ts
+
+    def _deliver_event(self, gi: int, rel, revision) -> bool:
+        """Watch-event filter: read-owner-only delivery keeps merged
+        streams gap- and duplicate-free across cutovers. Evaluated as
+        an ERA WALK over the whole transition sequence: a key's
+        ownership history is a chain of (owner, revision-window) eras
+        bounded by each transition's cut revisions, and an event is
+        delivered iff it falls inside one of ITS group's eras — which
+        silences copy/catch-up touches and dual-write mirrors on a
+        destination (below its cut), GC deletes on a source (above its
+        cut), and still delivers a group's events again when a LATER
+        transition moves the slice back to it."""
+        ts = self._transitions()
+        if not ts:
+            return True
+        try:
+            rev = int(revision)
+        except (TypeError, ValueError):
+            return True
+        ns, namespaced = split_resource(rel.resource_id)
+        if not namespaced:
+            for t in ts:
+                if not t.deliver_global(gi, rev):
+                    return False
+            return True
+        affecting = []
+        for t in ts:
+            sl = t.slice_for_key(ns, rel.resource_type)
+            if sl is not None:
+                affecting.append((t, sl))
+        if not affecting:
+            return True
+        # walk the eras: cur = the owner of the open era, low = the
+        # era's lower revision bound IN cur's OWN revision space
+        ok = False
+        cur = affecting[0][1].src
+        low = None
+        for t, sl in affecting:
+            state, src_cut, dst_cut = t.cut_info(sl)
+            if state != CUT_STATE:
+                # the era is still open at the source; pre-cut copies
+                # and mirrors on the destination are echoes
+                break
+            if gi == sl.src and src_cut is not None \
+                    and (low is None or rev > low) and rev <= src_cut:
+                ok = True
+            cur, low = sl.dst, dst_cut
+        if gi == cur and (low is None or rev > low):
+            ok = True
+        return ok
+
+    def _known_map_versions(self) -> set:
+        out = {self.map.version}
+        t = self._active_transition
+        if t is not None:
+            out.add(t.old_map.version)
+            out.add(t.new_map.version)
+        for past in self._archived_transitions:
+            out.add(past.old_map.version)
+            out.add(past.new_map.version)
+        return out
+
+    def _resolve_token(self, revision) -> RevisionVector:
+        """Watch resumption token -> a vector over TODAY's group space.
+        A token minted under a smaller map that a recorded transition
+        grew from is TRANSLATED (new components start at zero — the
+        rebalance event filter suppresses the pre-cut records there); a
+        token from an unknown map version, or with a component count no
+        transition explains, is REJECTED instead of misindexed."""
+        if isinstance(revision, RevisionVector):
+            vec, ver = revision, None
+        elif isinstance(revision, int):
+            vec, ver = RevisionVector(
+                (int(revision),) * len(self.groups)), None
+        else:
+            vec, ver = RevisionVector.parse_versioned(revision)
+        if ver is not None and ver not in self._known_map_versions():
+            raise ShardMapError(
+                f"watch token was minted under shard-map version {ver},"
+                f" which this planner has no transition for (current: "
+                f"{self.map.version}); re-list and re-watch")
+        n = len(self.groups)
+        if len(vec) == n:
+            return vec
+        if len(vec) < n:
+            grew = any(
+                t.old_map.n_groups == len(vec)
+                for t in ([self._active_transition]
+                          if self._active_transition is not None else [])
+                + self._archived_transitions)
+            if grew:
+                return vec.extend(n)
+        raise ShardMapError(
+            f"watch token has {len(vec)} components but the planner "
+            f"routes {n} groups and no recorded transition maps "
+            "between them; re-list and re-watch")
+
+    def _enter_write_gates(self, ops) -> tuple:
+        """Cutover gates for every moving slice a write touches (sid
+        order — no lock-order inversions); non-moving writes never
+        wait. The cutover freeze drains these before the atomic flip."""
+        t = self._active_transition
+        if t is None:
+            return ()
+        slices = {}
+        for op in ops:
+            sl = t.slice_for(op.rel.resource_type, op.rel.resource_id)
+            if sl is not None:
+                slices[sl.sid] = sl
+        gates = []
+        for sid in sorted(slices):
+            slices[sid].gate.enter()
+            gates.append(slices[sid].gate)
+        return tuple(gates)
+
+    def rebalance_status(self) -> Optional[dict]:
+        t = self._active_transition
+        return None if t is None else t.progress()
+
     # -- scatter machinery ---------------------------------------------------
 
     def n_shards(self) -> int:
@@ -372,7 +741,9 @@ class ShardedEngine:
         admission multiplier (a scatter is charged once per touched
         shard)."""
         if cls is not None and cls.name in _SCATTER_CLASSES:
-            return self.map.n_groups
+            # during a rebalance the scatter width includes the
+            # transition-added groups
+            return len(self.groups)
         return 1
 
     # scatter ops whose legs are PURE READS: a failed leg may be
@@ -494,7 +865,7 @@ class ShardedEngine:
             return []
         by_shard: dict[int, list] = {}
         for idx, it in enumerate(items):
-            gi = self.map.anchor_shard(it.resource_type, it.resource_id)
+            gi = self._read_anchor(it.resource_type, it.resource_id)
             by_shard.setdefault(gi, []).append(idx)
         cache_key = None
         if self.cache is not None and now is None:
@@ -545,6 +916,8 @@ class ShardedEngine:
             out = []
             for gi in sorted(results):
                 for rid in results[gi]:
+                    if not self._admit_gathered(gi, resource_type, rid):
+                        continue  # a mover copy, not the read owner
                     if rid not in seen:
                         seen.add(rid)
                         out.append(rid)
@@ -582,8 +955,11 @@ class ShardedEngine:
         covers its own namespaced slice, and a permitted subject must
         hold global tuples (visible to every shard), so the union is
         exact and mostly deduplicates."""
-        owner = self.map.shard_of(resource_type, resource_id)
-        if owner is not None:
+        _, namespaced = split_resource(resource_id)
+        if namespaced:
+            # owning shard under the CURRENT placement — a moving
+            # slice's anchor follows the rebalance read owner
+            owner = self._read_anchor(resource_type, resource_id)
             return self._single(
                 owner, "lookup_subjects",
                 lambda c: c.lookup_subjects(
@@ -605,12 +981,9 @@ class ShardedEngine:
     def _filter_shards(self, f: RelationshipFilter) -> Optional[list]:
         """Owning shards of a filter, or None for "all" (scatter)."""
         if f.resource_type and f.resource_id:
-            gi = self.map.shard_of(f.resource_type, f.resource_id)
-            if gi is not None:
-                return [gi]
-            # global object: replicated — ONE deterministic group
-            return [self.map.anchor_shard(f.resource_type,
-                                          f.resource_id)]
+            # namespaced: the current read owner (rebalance-aware);
+            # global: replicated — ONE deterministic group
+            return [self._read_anchor(f.resource_type, f.resource_id)]
         return None
 
     def read_relationships(self, f: RelationshipFilter) -> list:
@@ -626,6 +999,9 @@ class ShardedEngine:
             out = []
             for gi in sorted(results):
                 for rel in results[gi]:
+                    if not self._admit_gathered(gi, rel.resource_type,
+                                                rel.resource_id):
+                        continue  # a mover copy, not the read owner
                     k = rel.key()
                     if k not in seen:
                         seen.add(k)
@@ -637,6 +1013,13 @@ class ShardedEngine:
         if shards is not None and len(shards) == 1:
             return self._single(shards[0], "exists",
                                 lambda c: c.store.exists(f))
+        if self._copies_may_linger():
+            # an UNANCHORED probe during/after a move: a bare boolean
+            # from a group holding not-yet-caught-up (or not-yet-GC'd)
+            # copies could answer True for a tuple its read owner
+            # already deleted — gather the rows instead, so the
+            # per-row owner filter applies (fail-closed, never stale)
+            return bool(self.read_relationships(f))
         results = self._scatter("exists",
                                 lambda gi, c: c.store.exists(f),
                                 shards=shards)
@@ -646,16 +1029,32 @@ class ShardedEngine:
 
     def _plan_write(self, ops: list) -> dict[int, list]:
         """shard -> [WriteOp...]: namespaced tuples go to their owner,
-        global tuples replicate to EVERY group."""
+        global tuples replicate to EVERY group (including rebalance-
+        added ones — their global replica stays complete from the
+        moment the transition installs). A moving slice in its
+        dual-write window MIRRORS to both owners; a cut slice routes
+        to the new owner only."""
+        t = self._active_transition
         plan: dict[int, list] = {}
         for op in ops:
             gi = self.map.shard_of(op.rel.resource_type,
                                    op.rel.resource_id)
             if gi is None:
-                for g in range(self.map.n_groups):
+                for g in range(len(self.groups)):
                     plan.setdefault(g, []).append(op)
-            else:
-                plan.setdefault(gi, []).append(op)
+                continue
+            owners = (gi,)
+            if t is not None:
+                sl = t.slice_for(op.rel.resource_type,
+                                 op.rel.resource_id)
+                if sl is not None:
+                    owners = t.write_owners(sl)
+                    if len(owners) > 1:
+                        metrics.counter(
+                            "scaleout_rebalance_dual_writes_total"
+                        ).inc()
+            for g in owners:
+                plan.setdefault(g, []).append(op)
         return plan
 
     def _route_preconditions(self, pcs: list, plan_shards) -> dict:
@@ -680,8 +1079,11 @@ class ShardedEngine:
         for pc in pcs:
             f = pc.filter
             anchored = bool(f.resource_type and f.resource_id)
-            gi = self.map.shard_of(f.resource_type, f.resource_id) \
-                if anchored else None
+            gi = None
+            if anchored and split_resource(f.resource_id)[1]:
+                # namespaced anchor: the CURRENT read owner (a moving
+                # slice's pc binds where its data is served from)
+                gi = self._read_anchor(f.resource_type, f.resource_id)
             if gi is None and anchored:
                 out[first].append(pc)
             elif gi is not None and gi == first:
@@ -697,25 +1099,34 @@ class ShardedEngine:
 
     def write_relationships(self, ops: list,
                             preconditions: list = ()):
-        plan = self._plan_write(ops)
-        if not plan:
-            return self.vector
-        if len(plan) == 1:
-            gi = next(iter(plan))
-            # preconditions route like the split path: ones this shard
-            # can decide (its own slice, or a replicated global) bind
-            # atomically; a namespaced pc owned ELSEWHERE is probed
-            # through the planner — the target shard's store simply
-            # doesn't hold it (a must_exist would always fail, a
-            # must_not_exist would always pass: fail open)
-            pcs = self._route_preconditions(list(preconditions),
-                                            [gi]).get(gi, [])
-            rev = self._single(
-                gi, "write_relationships",
-                lambda c: c.write_relationships(plan[gi], pcs))
-            self._observe_revision(gi, rev)
-            return self.vector
-        return self._split_write(plan, list(preconditions))
+        # cutover gates for any moving slice this write touches: held
+        # across planning AND dispatch, so the flip's freeze observes
+        # a quiesced slice (non-moving writes never wait here)
+        gates = self._enter_write_gates(ops)
+        try:
+            plan = self._plan_write(ops)
+            if not plan:
+                return self.vector
+            if len(plan) == 1:
+                gi = next(iter(plan))
+                # preconditions route like the split path: ones this
+                # shard can decide (its own slice, or a replicated
+                # global) bind atomically; a namespaced pc owned
+                # ELSEWHERE is probed through the planner — the target
+                # shard's store simply doesn't hold it (a must_exist
+                # would always fail, a must_not_exist would always
+                # pass: fail open)
+                pcs = self._route_preconditions(list(preconditions),
+                                                [gi]).get(gi, [])
+                rev = self._single(
+                    gi, "write_relationships",
+                    lambda c: c.write_relationships(plan[gi], pcs))
+                self._observe_revision(gi, rev)
+                return self.vector
+            return self._split_write(plan, list(preconditions))
+        finally:
+            for g in gates:
+                g.exit()
 
     def _split_write(self, plan: dict, preconditions: list):
         """Cross-shard split: journal the full plan durably, apply
@@ -736,13 +1147,19 @@ class ShardedEngine:
                                                  list(plan))
         sid = None
         if self.journal is not None:
+            t = self._active_transition
             sid = self.journal.begin(
                 {gi: [{"op": o.op, "rel": _rel_to_dict(o.rel)}
                       for o in plan[gi]] for gi in plan},
                 [{"filter": asdict(p.filter),
                   "must_exist": p.must_exist}
                  for p in preconditions],
-                self.map.version)
+                self.map.version,
+                # a dual-write window split is tagged with BOTH
+                # versions: its recorded owners are already the union
+                # of the two placements, so replay must not re-route it
+                map_version_to=(t.new_map.version
+                                if t is not None else None))
         with tracer.span("shard_fanout", op="split_write",
                          shards=len(plan)):
             first = True
@@ -780,24 +1197,60 @@ class ShardedEngine:
 
     def delete_relationships(self, f: RelationshipFilter,
                              preconditions: list = ()) -> int:
-        from .shardmap import split_resource
-
         owner = None
         namespaced = False
         if f.resource_type and f.resource_id:
             _, namespaced = split_resource(f.resource_id)
             if namespaced:
                 owner = self.map.shard_of(f.resource_type, f.resource_id)
+        t = self._active_transition
+        gates: tuple = ()
+        if t is not None:
+            # cutover gates: an anchored delete gates its own slice; an
+            # unanchored/global delete may touch ANY moving slice, so it
+            # gates them all — a delete slipping between the flip's
+            # final drain and the cut record would vanish from the new
+            # owner (stale allow after cutover)
+            if namespaced:
+                sl = t.slice_for(f.resource_type, f.resource_id)
+                slices = [sl] if sl is not None else []
+            else:
+                slices = sorted(t.slices, key=lambda s: s.sid)
+            for sl in slices:
+                sl.gate.enter()
+            gates = tuple(sl.gate for sl in slices)
+        try:
+            return self._delete_routed(f, preconditions, owner, t,
+                                       namespaced)
+        finally:
+            for g in gates:
+                g.exit()
+
+    def _delete_routed(self, f: RelationshipFilter, preconditions,
+                       owner, t, namespaced: bool) -> int:
         if owner is not None:
-            # a namespaced anchor: the delete lives on ONE shard;
-            # preconditions it cannot decide locally probe through the
-            # planner (same routing rule as writes)
+            # a namespaced anchor: ONE owning slice — mirrored to both
+            # owners during its dual-write window; preconditions it
+            # cannot decide locally probe through the planner (same
+            # routing rule as writes). The first owner (= the read
+            # owner) decides preconditions and the reported count.
+            owners = (owner,)
+            if t is not None:
+                sl = t.slice_for(f.resource_type, f.resource_id)
+                if sl is not None:
+                    owners = t.write_owners(sl)
+            first = owners[0]
             pcs = self._route_preconditions(list(preconditions),
-                                            [owner]).get(owner, [])
+                                            [first]).get(first, [])
             n = self._single(
-                owner, "delete_relationships",
+                first, "delete_relationships",
                 lambda c: c.delete_relationships(f, pcs))
-            self._observe_revision(owner, self._group_revision(owner))
+            self._observe_revision(first, self._group_revision(first))
+            for gi in owners[1:]:
+                self._single(
+                    gi, "delete_relationships",
+                    lambda c: c.delete_relationships(f, []))
+                self._observe_revision(gi, self._group_revision(gi))
             return n
         # global anchor or unanchored filter: every group holds matching
         # rows (replicas, or disjoint namespaced slices). Preconditions
@@ -814,7 +1267,7 @@ class ShardedEngine:
             0, "delete_relationships",
             lambda c: c.delete_relationships(f, pcs0))}
         self._observe_revision(0, self._group_revision(0))
-        rest = [g for g in range(self.map.n_groups) if g != 0]
+        rest = [g for g in range(len(self.groups)) if g != 0]
         if rest:
             results.update(self._scatter(
                 "delete_relationships",
@@ -846,9 +1299,16 @@ class ShardedEngine:
         if self.journal is None:
             return 0
         done = 0
+        known_versions = self._known_map_versions()
         for ent in self.journal.pending():
-            rerouted = (ent["map_version"] != self.map.version
-                        or any(gi >= self.map.n_groups
+            # a dual-write window split carries BOTH versions; it is
+            # valid as long as EITHER names a placement this planner
+            # routes (the recorded owners are already the union), and
+            # its shard indices address the extended group space
+            rerouted = ((ent["map_version"] not in known_versions
+                         and ent.get("map_version_to")
+                         not in known_versions)
+                        or any(gi >= len(self.groups)
                                for gi in ent["plan"]))
             if rerouted:
                 # journaled under a DIFFERENT map (rebalance between
@@ -899,13 +1359,12 @@ class ShardedEngine:
     # -- watch ---------------------------------------------------------------
 
     def watch_since(self, revision) -> list:
-        """Events after a VECTOR resumption token, merged shard-by-shard
-        with monotone vector stamps."""
-        vec = revision if isinstance(revision, RevisionVector) \
-            else RevisionVector.parse(revision) \
-            if not isinstance(revision, int) \
-            else RevisionVector(
-                (int(revision),) * self.map.n_groups)
+        """Events after a VECTOR resumption token (translated through
+        recorded map transitions when minted under an older map),
+        merged shard-by-shard with monotone vector stamps. Moving-slice
+        events pass the rebalance delivery filter: read-owner-only, so
+        the replay is gap- and duplicate-free across a cutover."""
+        vec = self._resolve_token(revision)
         results = self._scatter(
             "watch_since",
             lambda gi, c: c.watch_since(int(vec[gi])))
@@ -914,18 +1373,19 @@ class ShardedEngine:
             cur = vec
             for gi in sorted(results):
                 for e in results[gi]:
+                    # the stamp always advances past the record — a
+                    # suppressed mover echo must move the resumption
+                    # token forward, never be re-delivered
                     cur = cur.bump(gi, e.revision)
-                    out.append(WatchEvent(cur, e.operation,
-                                          e.relationship))
+                    if self._deliver_event(gi, e.relationship,
+                                           e.revision):
+                        out.append(WatchEvent(cur, e.operation,
+                                              e.relationship))
         return out
 
     def watch_push_stream(self, from_revision) -> ShardedWatchStream:
-        vec = from_revision if isinstance(from_revision, RevisionVector) \
-            else RevisionVector((int(from_revision),)
-                                * self.map.n_groups) \
-            if isinstance(from_revision, int) \
-            else RevisionVector.parse(from_revision)
-        return ShardedWatchStream(self, vec)
+        return ShardedWatchStream(self,
+                                  self._resolve_token(from_revision))
 
     def watch_gate(self, resource_type: str, name: str):
         """Schema-derived, identical on every group: ask the anchor
@@ -965,9 +1425,14 @@ class ShardedEngine:
         return {
             "version": self.map.version,
             "groups": groups,
-            "vector": self.vector.encode(),
+            "vector": self.vector.encode(
+                map_version=self.map.version),
             "pending_splits": (self.journal.pending_count()
                                if self.journal is not None else 0),
+            # the live tuple mover's progress, or None outside a
+            # transition window (/readyz renders it as
+            # `rebalance: moving=K copied=J lag=...`)
+            "rebalance": self.rebalance_status(),
         }
 
     def fetch_traces(self, limit: int = 64) -> list:
@@ -983,6 +1448,10 @@ class ShardedEngine:
     def close(self, close_journal: bool = True) -> None:
         """``close_journal=False`` leaves a SHARED journal open (e.g. a
         crashed planner's journal that a successor will replay)."""
+        if self._coordinator is not None:
+            # park the mover; its persisted state resumes or aborts by
+            # the crash matrix at the next boot
+            self._coordinator.stop()
         self._pool.shutdown(wait=False, cancel_futures=True)
         for c in self.groups:
             try:

@@ -36,6 +36,7 @@ import json
 import os
 from bisect import bisect_right
 from dataclasses import dataclass
+from typing import Optional
 
 
 class ShardMapError(ValueError):
@@ -70,29 +71,77 @@ class RevisionVector(tuple):
         """True iff every component is >= ``other``'s."""
         return all(a >= b for a, b in zip(self, other))
 
-    def encode(self) -> str:
-        return "v" + ".".join(str(int(c)) for c in self)
+    def extend(self, n: int) -> "RevisionVector":
+        """This vector padded with zero components up to length ``n`` —
+        the grow-transition translation: a brand-new group's history
+        starts empty, so a token minted before the group existed resumes
+        it from revision 0 (the rebalance event filter suppresses the
+        copy/catch-up records below the cutover watermark)."""
+        if n <= len(self):
+            return self
+        return RevisionVector(tuple(self) + (0,) * (n - len(self)))
+
+    def encode(self, map_version: Optional[int] = None) -> str:
+        """``v1.2.3`` — or ``v1.2.3@m4`` when ``map_version`` is given:
+        the shard-map version the component INDICES were minted under.
+        Component *i* only names a group under one map; a token resumed
+        against a different map must be translated (rebalance) or
+        rejected, never silently re-bound to whatever group now sits at
+        index *i*."""
+        body = "v" + ".".join(str(int(c)) for c in self)
+        if map_version is not None:
+            return f"{body}@m{int(map_version)}"
+        return body
 
     @classmethod
-    def parse(cls, s) -> "RevisionVector":
-        """Accepts an ``encode()`` string, a sequence, or a plain int
-        (a scalar resumption token: every component starts there)."""
+    def parse_versioned(cls, s) -> tuple["RevisionVector", Optional[int]]:
+        """``(vector, minted_map_version-or-None)`` — the version a
+        string token carries (``@m<V>`` suffix); sequences and untagged
+        strings parse with version ``None`` (provenance unknown)."""
         if isinstance(s, RevisionVector):
-            return s
+            return s, None
         if isinstance(s, int):
             raise ShardMapError(
                 "a scalar revision needs a shard count; use "
                 "RevisionVector.zero(n).bump(...) or pass a vector")
         if isinstance(s, (list, tuple)):
-            return cls(int(c) for c in s)
+            return cls(int(c) for c in s), None
         t = str(s).strip()
+        ver = None
+        if "@m" in t:
+            t, _, vtext = t.partition("@m")
+            try:
+                ver = int(vtext)
+            except ValueError:
+                raise ShardMapError(
+                    f"invalid revision vector {s!r}") from None
         if not t.startswith("v"):
             raise ShardMapError(f"invalid revision vector {s!r}")
         try:
-            return cls(int(c) for c in t[1:].split("."))
+            return cls(int(c) for c in t[1:].split(".")), ver
         except ValueError:
             raise ShardMapError(
                 f"invalid revision vector {s!r}") from None
+
+    @classmethod
+    def parse(cls, s, map_version: Optional[int] = None
+              ) -> "RevisionVector":
+        """Accepts an ``encode()`` string, a sequence, or a plain int
+        (a scalar resumption token: every component starts there).
+        ``map_version`` is the consumer's CURRENT shard-map version:
+        a token tagged with a different version is REJECTED instead of
+        silently binding components to the wrong group index (a 2-group
+        vector resumed against a 3-group map would misindex — re-list,
+        or let the planner translate it through a known transition)."""
+        vec, ver = cls.parse_versioned(s)
+        if map_version is not None and ver is not None \
+                and ver != int(map_version):
+            raise ShardMapError(
+                f"revision vector {s!r} was minted under shard-map "
+                f"version {ver}, not the current version "
+                f"{int(map_version)}; its components would bind to the "
+                "wrong groups — re-list and re-watch")
+        return vec
 
 
 def split_resource(resource_id: str) -> tuple[str, bool]:
@@ -109,6 +158,16 @@ def _hash32(key: str) -> int:
     return int.from_bytes(
         hashlib.blake2s(key.encode("utf-8"), digest_size=4).digest(),
         "big")
+
+
+HASH_SPACE = 1 << 32
+
+
+def hash_key(namespace: str, resource_type: str) -> int:
+    """The partition-key hash the ring routes by — exported so the
+    rebalance planner and the engine-host slice ops agree byte-for-byte
+    on slice membership."""
+    return _hash32(f"{namespace}\x00{resource_type}")
 
 
 @dataclass(frozen=True)
@@ -153,12 +212,21 @@ class ShardMap:
     def shard_for(self, namespace: str, resource_type: str) -> int:
         """The owning group of a ``(namespace, resource-type)`` key —
         clockwise successor on the hash ring."""
-        h = _hash32(f"{namespace}\x00{resource_type}")
+        return self.owner_of_hash(hash_key(namespace, resource_type))
+
+    def owner_of_hash(self, h: int) -> int:
+        """Owning group of a raw partition-key hash (the rebalance
+        planner diffs two maps' assignments segment-by-segment)."""
         keys = self._ring_keys
         i = bisect_right(keys, h)
         if i == len(keys):
             i = 0
         return self._ring_groups[i]
+
+    def ring_points(self) -> tuple:
+        """The sorted ring-point hashes (segment boundaries for the
+        rebalance plan diff)."""
+        return self._ring_keys
 
     def shard_of(self, resource_type: str, resource_id: str):
         """Owning group index for one tuple/query anchor, or ``None``
@@ -189,6 +257,31 @@ class ShardMap:
                     enumerate(self.groups)))
 
 
+def map_to_doc(m: ShardMap) -> dict:
+    """The JSON document form of a map (``map_from_doc`` is the exact
+    inverse) — the rebalance transition persists its target map this
+    way so a restarted planner reconstructs the same ring. Endpoints
+    round-trip as raw ``[host, port]`` pairs, NOT the CLI ``host:port``
+    grammar: the record is internal, and in-process test topologies
+    legitimately carry port-0 placeholders the grammar rejects."""
+    return {"version": m.version,
+            "groups": [[[h, p] for h, p in g] for g in m.groups],
+            "virtual_nodes": m.virtual_nodes}
+
+
+def map_from_doc(doc: dict) -> ShardMap:
+    """Inverse of :func:`map_to_doc` (internal round-trip; see there)."""
+    try:
+        return ShardMap(
+            version=int(doc["version"]),
+            groups=tuple(tuple((str(h), int(p)) for h, p in g)
+                         for g in doc["groups"]),
+            virtual_nodes=int(doc.get("virtual_nodes", 64)))
+    except (KeyError, TypeError, ValueError):
+        raise ShardMapError(
+            f"malformed internal shard-map document: {doc!r}") from None
+
+
 def parse_shard_map(text: str) -> ShardMap:
     """Parse the JSON shard-map document::
 
@@ -201,6 +294,10 @@ def parse_shard_map(text: str) -> ShardMap:
         doc = json.loads(text)
     except ValueError as e:
         raise ShardMapError(f"shard map is not valid JSON: {e}") from None
+    return parse_shard_map_doc(doc)
+
+
+def parse_shard_map_doc(doc) -> ShardMap:
     if not isinstance(doc, dict):
         raise ShardMapError("shard map must be a JSON object")
     try:

@@ -67,6 +67,7 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     assert stages["device"]["p50_ms"] is not None
     _assert_caveat_schema(out["caveats"])
     _assert_shard_schema(out["shard"])
+    _assert_rebalance_schema(out["rebalance"])
     _assert_macro_schema(out["macro"])
 
 
@@ -74,7 +75,10 @@ def _assert_shard_schema(sh: dict) -> None:
     """The ISSUE 11 scale-out contract: the 1 vs 2 vs 4 group scaling
     curve is RECORDED (check p50, scatter-lookup p50, goodput per group
     count), and single-shard checks provably never scattered (per-shard
-    op counters)."""
+    op counters). Full (non-quick) runs additionally record a 10x
+    scale point (~20k namespaces / ~500k relationships) under the same
+    schema — pinned here whenever present (the tiny contract run
+    doesn't pay its bulk loads)."""
     assert sh["n_ns"] >= 1 and sh["n_rels"] >= 1
     assert sh["single_shard_no_scatter"] is True
     assert set(sh["groups"]) == {"1", "2", "4"}
@@ -85,6 +89,30 @@ def _assert_shard_schema(sh: dict) -> None:
             assert isinstance(v, (int, float)) and v == v and v > 0 \
                 and abs(v) != float("inf"), (k, key, v)
         assert g["single_shard_no_scatter"] is True
+    if "scale10x" in sh:
+        ten = sh["scale10x"]
+        assert ten["n_ns"] >= 10 * sh["n_ns"]
+        assert ten["n_rels"] >= 100_000
+        _assert_shard_schema({k: v for k, v in ten.items()
+                              if k != "scale10x"})
+
+
+def _assert_rebalance_schema(rb: dict) -> None:
+    """The ISSUE 14 live-move contract: a 3->4 group grow move is
+    MEASURED under load — rows/slices/duration, paused-vs-running
+    goodput windows (the mover-interference ratio), zero acked-write
+    loss, and zero fail-open probes."""
+    assert rb["n_ns"] >= 1 and rb["slices"] >= 1
+    assert rb["rows_moved"] >= 1
+    assert rb["move_seconds"] > 0
+    assert rb["zero_acked_write_loss"] is True
+    assert rb["fail_open_probes"] == 0
+    for key in ("goodput_paused_ops_s", "goodput_moving_ops_s",
+                "goodput_ratio_moving_over_paused"):
+        v = rb[key]
+        assert v is None or (isinstance(v, (int, float)) and v == v
+                             and v > 0 and abs(v) != float("inf")), \
+            (key, v)
 
 
 def _assert_caveat_schema(cav: dict) -> None:

@@ -794,3 +794,158 @@ def test_sharded_watch_resumes_across_group_leader_sigkill(tmp_path):
         assert stream2.revision.dominates(resume_vec)
     finally:
         topo.close()
+
+
+@pytest.mark.slow
+def test_watch_resumes_across_leader_sigkill_in_dual_write_window(
+        tmp_path):
+    """ISSUE 14 satellite: the composed resumption scenario fired
+    DURING a live rebalance's dual-write window. A SIGKILL of the
+    moving slice's SOURCE group leader mid-window must lose no acked
+    write (the mirrors ride the split journal), keep the merged watch
+    stream gap- and duplicate-free across failover AND the eventual
+    cutover, and leave the transition completed (chaos-invariant
+    checked)."""
+    from spicedb_kubeapi_proxy_tpu.chaos.invariants import (
+        check_rebalance_converged,
+    )
+    from spicedb_kubeapi_proxy_tpu.scaleout import (
+        MapTransition,
+        RebalanceCoordinator,
+        ShardMap,
+        plan_moves,
+    )
+    from spicedb_kubeapi_proxy_tpu.scaleout.rebalance import DUAL
+
+    topo = SubprocessTopology(workdir=str(tmp_path))
+    try:
+        topo.wait_ready()
+        planner = topo.make_planner()
+        smap = topo.map
+        new_map = ShardMap(version=2, groups=smap.groups,
+                           virtual_nodes=96)
+        t = MapTransition(smap, new_map, plan_moves(smap, new_map))
+        # the slice whose SOURCE is group 0 (the leader we will kill)
+        sl = next(s for s in t.slices if s.src == 0)
+        ns_dual = next(f"ns{i}" for i in range(128)
+                       if t.slice_for_key(f"ns{i}", "pod") is sl)
+        ns_calm = next(f"ns{i}" for i in range(128)
+                       if t.slice_for_key(f"ns{i}", "pod") is None
+                       and smap.shard_of("pod", f"ns{i}/p") == 1)
+
+        acked = []
+
+        def write(name, ns):
+            """One acked watchable tuple; every RETRY mints a fresh
+            subject so an ambiguous first attempt that actually landed
+            cannot double-count an acked name (only acked names are
+            asserted on)."""
+            deadline = time.monotonic() + 45.0
+            attempt = 0
+            while True:
+                sub = f"{name}a{attempt}"
+                try:
+                    planner.write_relationships([WriteOp(
+                        "touch",
+                        Relationship("pod", f"{ns}/p0", "viewer",
+                                     "user", sub, None))])
+                except Exception:  # noqa: BLE001 - fail-closed window
+                    if time.monotonic() >= deadline:
+                        raise
+                    attempt += 1
+                    time.sleep(0.3)
+                else:
+                    acked.append(sub)
+                    return sub
+
+        # phase A: pre-window traffic observed on a live stream
+        start_vec = planner.revision_vector(refresh=True)
+        stream = planner.watch_push_stream(start_vec)
+        a_names = [write(f"wa{i}", ns_dual if i % 2 == 0 else ns_calm)
+                   for i in range(4)]
+
+        def drain(s, want, budget=30.0):
+            seen = []
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                try:
+                    for ev in s.next_batch():
+                        seen.append(ev.relationship.subject_id)
+                except Exception as e:  # noqa: BLE001 - kill signal
+                    return seen, e
+                if want <= set(seen):
+                    return seen, None
+            return seen, None
+
+        seen_a, err = drain(stream, set(a_names))
+        assert err is None and set(a_names) <= set(seen_a), (seen_a,
+                                                            err)
+
+        # phase B: open the dual-write window on the slice (white-box
+        # phase driving — the window must be OPEN when the kill lands)
+        planner._install_transition(t)
+        coord = RebalanceCoordinator(planner, t)
+        copy_rev, rows = coord._slice_read(sl.src, sl.ranges)
+        coord._slice_load(sl.dst, rows)
+        t.set_state(sl, "catchup", copy_rev=int(copy_rev),
+                    replayed=int(copy_rev))
+        while coord._catch_up_once(sl) > 0:
+            pass
+        t.set_state(sl, DUAL)
+        coord._persist()
+
+        # SIGKILL the source group's leader MID-WINDOW
+        g, p = topo.kill_group_leader(0)
+        seen_gap, _err = drain(stream, {"__nothing__"}, budget=4.0)
+        resume_vec = stream.revision
+        stream.close()
+        # bring the killed peer back so the promoted survivor can meet
+        # its --min-sync-replicas floor again (writes fail CLOSED until
+        # then — the write() retry loop rides that window out)
+        topo.restart(g, p)
+        topo.wait_group_leader(0)
+
+        # acked writes THROUGH the window: the slice's dual writes ride
+        # the split journal; failover re-aims the source legs
+        b_names = [write(f"wb{i}", ns_dual if i % 2 == 0 else ns_calm)
+                   for i in range(4)]
+
+        # phase C: drive the interrupted transition to COMPLETION
+        # (re-copy is idempotent; the persisted state resumes forward)
+        planner.recover_splits()
+        coord.run_to_completion()
+        assert planner.map.version == 2
+        assert check_rebalance_converged(
+            planner.journal.load_transition()) == []
+        assert planner.journal.pending_count() == 0
+
+        # post-cutover traffic, then resume the stream across the
+        # whole history: failover + dual-write window + cutover
+        c_names = [write(f"wc{i}", ns_dual if i % 2 == 0 else ns_calm)
+                   for i in range(4)]
+        stream2 = planner.watch_push_stream(resume_vec)
+        try:
+            seen_bc, err = drain(stream2, set(b_names + c_names),
+                                 budget=60.0)
+        finally:
+            stream2.close()
+        assert err is None, err
+
+        # NO GAP: every acked write's event arrived exactly once; the
+        # mover's copy/catch-up/GC echoes never surface
+        want = set(b_names + c_names)
+        missing = want - set(seen_bc)
+        assert not missing, f"gap across window+cutover: {missing}"
+        all_seen = [s for s in seen_a + seen_gap + seen_bc
+                    if s in set(a_names) | want]
+        dups = {n for n in all_seen if all_seen.count(n) > 1}
+        assert not dups, f"duplicates across resumption: {dups}"
+
+        # zero acked writes lost, never fail-open — read back at V+1
+        for sub in acked:
+            got = planner.lookup_resources("pod", "view", "user", sub)
+            assert got, f"acked write {sub} lost across the window"
+        assert not planner.check(CheckItem(
+            "pod", f"{ns_dual}/p0", "view", "user", "intruder"))
+    finally:
+        topo.close()

@@ -41,6 +41,9 @@ SCOPE_FILES = (
     "engine/remote.py",
     "engine/engine.py",
     "scaleout/planner.py",
+    # the tuple mover's routing/cutover path: a swallowed failure here
+    # is a half-routed placement serving stale verdicts
+    "scaleout/rebalance.py",
 )
 
 BUILDER = "_fail_closed_503"

@@ -54,13 +54,53 @@ definition pod {
 """
 
 
+# BENCH_SCHEMA plus conditional grants: the mesh phase's caveated mix
+# (ISSUE 15) — a share of the flat pod#viewer grants carry an
+# IP-allowlist caveat, evaluated ON the mesh.
+MESH_SCHEMA = """
+use expiration
+
+caveat ip_allowlist(ip ipaddress, allowed list<ipaddress>) {
+  ip in allowed
+}
+
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition namespace {
+  relation creator: user
+  relation viewer: user | group#member
+  permission admin = creator
+  permission view = viewer + creator
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user | user with ip_allowlist
+  permission edit = creator
+  permission view = viewer + creator + namespace->view
+}
+"""
+
+# the two stored contexts the caveated mix interleaves (two distinct
+# (caveat, ctx) instances => an 8-row padded bucket with spare rows for
+# incremental instance appends)
+MESH_CTXS = ('{"allowed":["10.0.0.0/8","192.168.0.0/16"]}',
+             '{"allowed":["10.0.0.0/8"]}')
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
 def build_engine(n_pods: int, n_users: int, n_ns: int, n_groups: int,
-                 n_rels: int, seed: int = 0):
-    """Synthesize the graph columnar-side (no per-row Python objects)."""
+                 n_rels: int, seed: int = 0, cav_share: float = 0.0,
+                 schema: str = BENCH_SCHEMA):
+    """Synthesize the graph columnar-side (no per-row Python objects).
+    ``cav_share`` > 0 marks that fraction of the flat pod#viewer grants
+    with the ``ip_allowlist`` caveat (``schema`` must declare it —
+    MESH_SCHEMA), alternating the two MESH_CTXS stored contexts."""
     from spicedb_kubeapi_proxy_tpu.engine import Engine
     from spicedb_kubeapi_proxy_tpu.models import parse_schema
 
@@ -70,10 +110,13 @@ def build_engine(n_pods: int, n_users: int, n_ns: int, n_groups: int,
     groups = np.char.add("g", np.arange(n_groups).astype(str))
     nss = np.char.add("ns", np.arange(n_ns).astype(str))
 
-    cols = {k: [] for k in ("resource_type", "resource_id", "relation",
-                            "subject_type", "subject_id", "subject_relation")}
+    keys = ["resource_type", "resource_id", "relation",
+            "subject_type", "subject_id", "subject_relation"]
+    if cav_share > 0:
+        keys += ["caveat", "caveat_context"]
+    cols = {k: [] for k in keys}
 
-    def add(rt, rid, rl, st, sid, srl=None):
+    def add(rt, rid, rl, st, sid, srl=None, cav=None, ctx=None):
         n = len(rid)
         cols["resource_type"].append(np.full(n, rt))
         cols["resource_id"].append(rid)
@@ -82,11 +125,27 @@ def build_engine(n_pods: int, n_users: int, n_ns: int, n_groups: int,
         cols["subject_id"].append(sid)
         cols["subject_relation"].append(
             np.full(n, srl if srl is not None else ""))
+        if cav_share > 0:
+            cols["caveat"].append(
+                cav if cav is not None else np.full(n, ""))
+            cols["caveat_context"].append(
+                ctx if ctx is not None else np.full(n, ""))
 
     # group membership: ~20 users per group
     gm = min(20 * n_groups, n_rels // 20)
     add("group", groups[rng.integers(n_groups, size=gm)], "member",
         "user", users[rng.integers(n_users, size=gm)])
+    if cav_share > 0:
+        # the mesh mix adds a SHORT nested-group chain (g1 ⊂ g0, ...):
+        # a genuinely cyclic-core range too sparse for the dense-closure
+        # peel, so the fixpoint iterates a few hops and the K-step
+        # convergence fuse has collectives to save — the shallow
+        # headline graph stratifies to a zero-iteration core, which
+        # would make the reduction unmeasurable
+        chain = int(min(6, n_groups - 1))
+        if chain > 0:
+            add("group", groups[np.arange(chain)], "member",
+                "group", groups[np.arange(1, chain + 1)], "member")
     # namespace viewer grants via groups (2 per ns) — exercises the
     # group#member userset + namespace->view arrow rewrite chain
     nv = 2 * n_ns
@@ -101,13 +160,23 @@ def build_engine(n_pods: int, n_users: int, n_ns: int, n_groups: int,
                         dtype=np.int64)
     pair = np.unique(pair)[:n_flat]
     rng.shuffle(pair)
-    add("pod", pods[pair // n_users], "viewer", "user", users[pair % n_users])
+    cav_col = ctx_col = None
+    if cav_share > 0:
+        idx = np.arange(len(pair))
+        is_cav = idx < int(len(pair) * cav_share)
+        cav_col = np.where(is_cav, "ip_allowlist", "")
+        ctx_col = np.where(is_cav,
+                           np.asarray(MESH_CTXS)[idx % len(MESH_CTXS)], "")
+    add("pod", pods[pair // n_users], "viewer", "user",
+        users[pair % n_users], cav=cav_col, ctx=ctx_col)
 
     rels_cols = {k: np.concatenate(v) for k, v in cols.items()}
     total = len(rels_cols["resource_id"])
-    log(f"built columns: {total} relationships")
+    log(f"built columns: {total} relationships"
+        + (f" ({cav_share:.0%} of flat grants caveated)"
+           if cav_share > 0 else ""))
 
-    e = Engine(schema=parse_schema(BENCH_SCHEMA))
+    e = Engine(schema=parse_schema(schema))
     t0 = time.perf_counter()
     e.bulk_load(rels_cols)
     log(f"bulk_load: {time.perf_counter() - t0:.1f}s")
@@ -228,7 +297,8 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
         dtype=np.int32,
     )  # [k, 1, 2]
 
-    def chained(blocks, blocks_bits, src, dst, exp, dsrc, ddst, dexp,
+    def chained(blocks, blocks_bits, src, dst, exp, cav,
+                dsrc, ddst, dexp, dcav, cav_static,
                 seed_stack, qs, qb, now_rel):
         def body(dep, seeds):
             # optimization_barrier ties each query's input to the previous
@@ -237,17 +307,19 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
             # scan's sequential While lowering this guarantees the K
             # queries execute back-to-back, never overlapped
             seeds, _ = jax.lax.optimization_barrier((seeds, dep))
-            out, _, _ = _run(cg.run_meta(), blocks, blocks_bits, src, dst,
-                             exp,
-                             dsrc, ddst, dexp, seeds, qs, qb, now_rel,
-                             max_iters=DEFAULT_MAX_ITERS)
+            out, _, _, _ = _run(cg.run_meta(), blocks, blocks_bits,
+                                src, dst, exp, cav,
+                                dsrc, ddst, dexp, dcav, cav_static, (),
+                                seeds, qs, qb, now_rel,
+                                max_iters=DEFAULT_MAX_ITERS)
             return out.astype(jnp.int32).sum(), out[:1]
         dep, _ = jax.lax.scan(body, jnp.int32(0), seed_stack)
         return dep
 
     fn = jax.jit(chained)
     a = (d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
-         d["dsrc"], d["ddst"], d["dexp"])
+         d["cav"], d["dsrc"], d["ddst"], d["dexp"], d["dcav"],
+         d["cav_static"])
     jqs, jqb = jnp.asarray(qs), jnp.asarray(qb)
     s1 = jnp.asarray(seed_stack[:1])
     sk = jnp.asarray(seed_stack)
@@ -1024,6 +1096,20 @@ def _measure(args, result: dict) -> None:
     except Exception as ex:  # noqa: BLE001 - aux measurement only
         log(f"caveat section failed (non-fatal): {ex}")
 
+    # -- mesh-native hot path (ISSUE 15): caveats on-mesh + K-step fused
+    # fixpoint at 1 vs 2 vs 8 devices over a caveated mix. Runs at EVERY
+    # scale including --tiny (contract-pinned); CPU-only hosts measure
+    # whatever device counts exist (no TPU re-probe — the run-level
+    # degraded label already carries the provenance) and the full run
+    # records the 100k-pod/10M-rel mesh point.
+    try:
+        _mesh_phase(result, quick, args.tiny)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"mesh section failed (non-fatal): {ex}")
+
     # -- scale-out shard scaling (ROADMAP item 4 / ISSUE 11): the same
     # tuples behind 1 vs 2 vs 4 engine groups on loopback — single-shard
     # check p50 (counter-verified no-scatter), scatter-lookup p50, mixed
@@ -1748,6 +1834,169 @@ relationships: ""
         f"(ratio {ratio:.2f}x), warm ctx {warm_ctx:.3f}ms")
 
 
+def _mesh_phase(result: dict, quick: bool, tiny: bool) -> None:
+    """Mesh-native hot path (ISSUE 15): the caveated-mix graph served
+    through ``Engine(mesh=...)`` at 1 vs 2 vs 8 devices (whatever the
+    host actually has — CPU CI forces 8 virtual devices via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``; a bare
+    CPU-only host measures its single device and labels the topology,
+    riding the run-level ``[DEGRADED: cpu]`` convention instead of
+    re-probing hardware). Per device count: list-filter p50 WITH request
+    context, the K-step fused fixpoint's convergence-collective count
+    (vs the single-device iteration count = the pre-fuse per-hop
+    collectives), and a steady-churn window (caveated + plain touches,
+    reused contexts) that must stay recompile-free on the resident
+    shards. ``engine_caveat_mesh_fallback_total`` must not move: the
+    caveat VM runs INSIDE the shard_map body now."""
+    import jax
+
+    from spicedb_kubeapi_proxy_tpu.engine.store import WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+    from spicedb_kubeapi_proxy_tpu.parallel import make_mesh
+    from spicedb_kubeapi_proxy_tpu.parallel.mesh import mesh_topology
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    devs = jax.devices()
+    counts = [c for c in (1, 2, 8) if c <= len(devs)]
+    if tiny:
+        n_pods, n_users, n_ns, n_groups, n_rels = 200, 100, 10, 10, 3_000
+        trials, churn = 3, 3
+    elif quick:
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            2_000, 500, 50, 50, 50_000)
+        trials, churn = 5, 4
+    else:
+        # ROADMAP item 1's scale point: the headline 100k-pod / 10M-rel
+        # build itself, with the caveated mix — measured, not claimed
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            100_000, 10_000, 1_000, 1_000, 10_000_000)
+        trials, churn = 9, 6
+    share = 0.3
+    e, total = build_engine(n_pods, n_users, n_ns, n_groups, n_rels,
+                            seed=2, cav_share=share, schema=MESH_SCHEMA)
+    rng = np.random.default_rng(5)
+    req_ctx = {"ip": "10.1.2.3"}
+    cg = e.compiled()
+    assert cg.caveats is not None and cg.caveats.metas, \
+        "mesh phase needs a caveated graph"
+    objs = e._objects_by_name()
+    u0 = f"u{int(rng.integers(n_users))}"
+    off = cg.offset_of("pod", "view")
+    nq = cg.type_sizes["pod"]
+    seeds = np.asarray([cg.encode_subject("user", u0, None, objs)],
+                       dtype=np.int32)
+    qs = off + np.arange(nq, dtype=np.int32)
+    qb = np.zeros(nq, dtype=np.int32)
+    fut = cg.query_async(seeds, qs, qb, context=req_ctx)
+    fut.result()
+    # the pre-fuse baseline at build (informational; each device-count
+    # point re-measures against ITS revision — churn can add hops)
+    iters_single = fut.iterations()
+
+    fb0 = metrics.counter("engine_caveat_mesh_fallback_total").value
+    points = {}
+    for c in counts:
+        mesh = make_mesh(c, devices=devs[:c])
+        topo = mesh_topology(mesh)
+        e.mesh = mesh
+        e._sharded = None
+        # warm: sharded build + shard_map jit compile + grid cache
+        e.lookup_resources_mask("pod", "view", "user", u0,
+                                context=req_ctx)
+        # one write->read pair OUTSIDE the churn window: the first write
+        # after bulk_load pays the store-index build and its read the
+        # one unavoidable full recompile (bulk-loaded history isn't in
+        # the watch log), plus the first overlay-append scatter compile
+        e.write_relationships([WriteOp("touch", Relationship(
+            "pod", f"ns/p{int(rng.integers(n_pods))}", "viewer",
+            "user", f"u{int(rng.integers(n_users))}", None, None,
+            "ip_allowlist", MESH_CTXS[0]))])
+        e.lookup_resources_mask("pod", "view", "user", u0,
+                                context=req_ctx)
+        lat = []
+        for _ in range(trials):
+            u = f"u{int(rng.integers(n_users))}"
+            t0 = time.perf_counter()
+            e.lookup_resources_mask("pod", "view", "user", u,
+                                    context=req_ctx)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        p50 = float(np.percentile(lat, 50))
+        # the one-per-hop baseline is re-measured at the SAME revision
+        # the mesh conv-check query reads: the warm writes above may
+        # have advanced the graph (a touch can extend the group chain
+        # by a hop), and a stale pre-write baseline would undercount —
+        # flaking the relative pin instead of measuring the reduction
+        cg_now = e.compiled()
+        objs_now = e._objects_by_name()
+        seeds_now = np.asarray(
+            [cg_now.encode_subject("user", u0, None, objs_now)],
+            dtype=np.int32)
+        off_now = cg_now.offset_of("pod", "view")
+        nq_now = cg_now.type_sizes["pod"]
+        qs_now = off_now + np.arange(nq_now, dtype=np.int32)
+        qb_now = np.zeros(nq_now, dtype=np.int32)
+        sfut = cg_now.query_async(seeds_now, qs_now, qb_now,
+                                  context=req_ctx)
+        sfut.result()
+        iters_pt = sfut.iterations()
+        sg = e._backend(cg_now)
+        qf = sg.query_async(seeds_now, qs_now, qb_now, context=req_ctx)
+        qf.result()
+        checks = qf.conv_checks()
+        # steady churn: caveated (reused stored contexts) + plain
+        # touches with a fully-consistent mesh read after each — the
+        # resident shards absorb everything (zero graph recompiles)
+        compiles0 = metrics.counter("engine_graph_compiles_total").value
+        upd0 = metrics.counter("engine_sharded_updates_total").value
+        for i in range(churn):
+            cav = i % 2 == 0
+            e.write_relationships([WriteOp("touch", Relationship(
+                "pod", f"ns/p{int(rng.integers(n_pods))}", "viewer",
+                "user", f"u{int(rng.integers(n_users))}", None, None,
+                "ip_allowlist" if cav else None,
+                MESH_CTXS[i % len(MESH_CTXS)] if cav else None))])
+            e.lookup_resources_mask("pod", "view", "user", u0,
+                                    context=req_ctx)
+        recompiles = int(metrics.counter(
+            "engine_graph_compiles_total").value - compiles0)
+        updates = int(metrics.counter(
+            "engine_sharded_updates_total").value - upd0)
+        points[str(c)] = {
+            "devices": topo["devices"],
+            "data": topo["data"],
+            "graph": topo["graph"],
+            "platform": topo["platform"],
+            "list_p50_ms": round(p50, 3),
+            "k_steps": int(sg.k_steps),
+            "conv_checks": int(checks),
+            "conv_checks_before": int(iters_pt),
+            "churn_recompiles": recompiles,
+            "churn_sharded_updates": updates,
+        }
+        log(f"mesh {c}d (data={mesh.shape['data']},"
+            f"graph={mesh.shape['graph']}): list p50 {p50:.2f}ms, "
+            f"conv collectives {checks} (K={sg.k_steps}; one-per-hop "
+            f"baseline {iters_pt}), churn recompiles {recompiles}, "
+            f"sharded updates {updates}")
+    e.mesh = None
+    e._sharded = None
+    fallbacks = int(metrics.counter(
+        "engine_caveat_mesh_fallback_total").value - fb0)
+    result["mesh"] = {
+        "backend": result.get("backend"),
+        "devices_available": len(devs),
+        "device_counts": counts,
+        "n_pods": n_pods,
+        "n_rels": total,
+        "caveated_share": share,
+        "fixpoint_iters_single": int(iters_single),
+        "caveat_mesh_fallbacks": fallbacks,
+        "points": points,
+    }
+    log(f"mesh phase: {total} rels ({share:.0%} caveated), device axis "
+        f"{counts}, caveat mesh fallbacks {fallbacks}")
+
+
 _SHARD_SCHEMA = """
 use expiration
 
@@ -2400,9 +2649,13 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
 
             class A:  # minimal arrival stand-in for the op table
                 key = 0
+                ns_key = 0  # ops route on BOTH (the warmup above does
+                # too); without it every probe thread died at its first
+                # namespace-keyed op and the capacity anchor was garbage
 
             while time.perf_counter() < stop:
                 A.key = k
+                A.ns_key = k
                 probe_ops[(k * 131) % len(probe_ops)](A)
                 done[i] += 1
                 k += nthreads

@@ -403,23 +403,41 @@ class CompiledCaveats:
 
     # -- device upload -------------------------------------------------------
 
-    def device_static(self) -> tuple:
+    def device_static(self, sharding=None) -> tuple:
         """Per-caveat device arrays (called under the graph host guard;
-        the result lives in CompiledGraph._device)."""
+        the result lives in CompiledGraph._device). ``sharding``: an
+        optional placement for every array — the mesh backend passes a
+        replicated ``NamedSharding(mesh, P())`` so the instance tables
+        and VM tapes live identically on every device and the caveat
+        pass runs inside the shard_map body with no cross-chip
+        traffic."""
+        if sharding is None:
+            def put(a):
+                return jnp.asarray(a)
+        else:
+            def put(a):
+                return jax.device_put(np.asarray(a), sharding)
         out = []
         for h in self.hosts:
             ime, imv = split_planes(h.program.imm)
             out.append({
-                "ops": jnp.asarray(h.program.ops),
-                "ime": jnp.asarray(ime), "imv": jnp.asarray(imv),
-                "ce": jnp.asarray(h.ctx_e), "cv": jnp.asarray(h.ctx_v),
-                "ck": jnp.asarray(h.ctx_k),
-                "loe": jnp.asarray(h.lo_e), "lov": jnp.asarray(h.lo_v),
-                "hie": jnp.asarray(h.hi_e), "hiv": jnp.asarray(h.hi_v),
-                "lk": jnp.asarray(h.list_k),
-                "real": jnp.asarray(h.real),
+                "ops": put(h.program.ops),
+                "ime": put(ime), "imv": put(imv),
+                "ce": put(h.ctx_e), "cv": put(h.ctx_v),
+                "ck": put(h.ctx_k),
+                "loe": put(h.lo_e), "lov": put(h.lo_v),
+                "hie": put(h.hi_e), "hiv": put(h.hi_v),
+                "lk": put(h.list_k),
+                "real": put(h.real),
             })
         return tuple(out)
+
+    def applied_rows(self) -> tuple:
+        """Per-caveat live instance-row counts — the append watermark a
+        mesh view syncs its replicated tables against (spare rows are
+        taken append-only per caveat, so ``[old, new)`` names exactly
+        the columns to patch). Caller holds the graph host guard."""
+        return tuple(int(h.real.sum()) for h in self.hosts)
 
     # -- incremental instance appends ---------------------------------------
 

@@ -698,20 +698,21 @@ class Engine:
         ``query_async(seeds, q_slots, q_batch, now)`` surface."""
         if self.mesh is None:
             return cg
-        cav = cg.caveats
-        if cav is not None and cav.metas:
-            # the sharded fixpoint does not evaluate caveats yet: its
-            # level arrays would serve conditional edges UNCONDITIONALLY
-            # (fail open). Route caveated graphs through the single-
-            # device path instead — counted, so a mesh deployment that
-            # starts loading conditional grants sees why its mesh idles.
+        from ..parallel.sharded import ShardedGraph
+
+        reason = ShardedGraph.unsupported_reason(cg)
+        if reason is not None:
+            # caveats evaluate ON the mesh now (the VM runs inside the
+            # shard_map body against replicated instance tables); only
+            # genuinely unsupported shapes — caveated graphs without
+            # per-edge caveat rows, i.e. hand-built unstratified
+            # layouts — still route to the single-device path, counted
+            # so a mesh deployment sees why its mesh idles.
             metrics.counter("engine_caveat_mesh_fallback_total").inc()
             return cg
         with self._lock:
             sg = self._sharded
             if sg is None or sg.cg is not cg:
-                from ..parallel.sharded import ShardedGraph
-
                 t0 = time.perf_counter()
                 if sg is None:
                     sg = ShardedGraph(cg, self.mesh)

@@ -376,6 +376,22 @@ class RunMeta:
     cav_rows: int = 1
 
 
+def convergence_fuse_steps(meta: "RunMeta") -> int:
+    """Propagation steps the mesh backend fuses per convergence
+    collective — the K in parallel/sharded.py's K-step fused while body.
+
+    Derived from the compiled graph's stratification: a stratified graph
+    iterates only its small cyclic core (recursive groups/orgs, which
+    converge in a few hops — the per-pod bulk is peeled into one-shot
+    acyclic levels), so K=2 halves the convergence collectives without
+    wasting propagation work; an unstratified graph (hand-built, no
+    level split) iterates everything with unknown diameter, so a deeper
+    fuse amortizes better. The fixpoint is monotone — steps past
+    convergence are no-ops — so K only trades at most K-1 cheap wasted
+    hops against saved cross-axis collectives and host syncs."""
+    return 2 if meta.n_levels else 4
+
+
 @dataclass
 class CompiledGraph:
     """An immutable device-ready compilation of (schema, snapshot)."""

@@ -26,6 +26,21 @@ class MeshSpecError(ValueError):
     pass
 
 
+def mesh_topology(mesh: "Mesh") -> dict:
+    """One-line description of a mesh's device topology — the label
+    benches and logs attach to mesh-path measurements so a number is
+    never read without its (device count, axis split, platform)
+    provenance: ``{"devices": N, "data": D, "graph": G,
+    "platform": "cpu"|"tpu"|...}``."""
+    devs = mesh.devices.reshape(-1)
+    return {
+        "devices": int(devs.size),
+        "data": int(mesh.shape["data"]),
+        "graph": int(mesh.shape["graph"]),
+        "platform": str(devs[0].platform) if devs.size else "none",
+    }
+
+
 def parse_mesh_spec(spec: str) -> dict:
     """"auto" -> {} (all devices, derived axes); "data=D,graph=G" ->
     explicit axis sizes (either may be omitted). Raises MeshSpecError."""

@@ -15,8 +15,31 @@ and runs the same fixpoint over a ``("data", "graph")`` mesh:
 - the query batch (rows of the state tensor) is sharded along the ``data``
   axis — concurrent requests, the reference's goroutine fan-out
   (pkg/authz/check.go:77-93), each chip answering its own requests;
-- the convergence test is a collective OR over both axes so every chip runs
-  the same number of fixpoint steps.
+- the convergence test is a collective OR over both axes, fused to run
+  every K propagation steps (K-step fused fixpoint, see below) so every
+  chip runs the same number of steps while small-diameter graphs stop
+  paying one cross-axis collective + host-visible sync per hop;
+- conditional grants evaluate ON the mesh: the caveat instance tables and
+  compiled VM tapes are replicated across every device (``P()``), the
+  per-edge caveat rows are sharded WITH their edge segments, and the
+  vectorized caveat VM (caveats/vm.py) runs once per dispatch inside the
+  shard_map body — edge activation = expiration ∧ ``cav_ok[row]`` is
+  computed where the edges live, so caveated graphs no longer abandon
+  the mesh for the single-device path.
+
+K-step fused convergence: the while body applies K propagation steps and
+compares state only across the whole block, so the convergence
+collective-OR (and the host-side while-condition sync it implies) fires
+``ceil(iters / K)`` times instead of ``iters`` times. K derives from the
+compiled graph's stratification
+(:func:`~...ops.reachability.convergence_fuse_steps`): stratified graphs
+iterate only their small cyclic core, unstratified ones fuse more. The
+iteration is monotone, so steps past the fixpoint are no-ops — fusing
+trades at most K-1 wasted cheap hops for the saved collectives — and the
+loop carry buffers are donated/double-buffered by the ``while_loop``
+lowering (no fresh HBM per block). ``iterations()`` reports the step
+budget consumed (a multiple of K, >= the true iteration count);
+``conv_checks()`` reports the convergence collectives actually paid.
 
 The query surface is both a *grid* (``B`` subjects x ``Q`` result slots
 per subject — bulk checks and concurrent list prefilters, BASELINE config
@@ -28,7 +51,9 @@ route every check/lookup through the mesh unchanged (``Engine(mesh=...)``
 Incremental updates are O(delta) here too: :meth:`ShardedGraph.updated`
 reuses the jitted shard_map and the resident base edge shards, applying
 only the new dead-pair kills (functional expiration/block-cell updates)
-and re-uploading the small sharded delta segment — mirroring the
+and patching the small sharded delta segment in place — including the
+per-slot caveat rows, and new (caveat, context) instance rows appended
+into the replicated context tables' spare rows — mirroring the
 single-chip incremental path instead of rebuilding and re-placing the
 whole graph per write.
 """
@@ -58,26 +83,46 @@ from ..ops.reachability import (
     _apply_program,
     _next_bucket,
     _seed_base,
+    convergence_fuse_steps,
 )
 
 
 def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
-                 dsrc, ddst, dexp, seeds, q_slots, now_rel, *,
-                 max_iters: int):
+                 dsrc, ddst, dexp, dcav, cav_static, cav_req,
+                 seeds, q_slots, now_rel, *,
+                 max_iters: int, k_steps: int):
     """Per-device body (inside shard_map). Shapes are the LOCAL shards:
-    level_edges[k] = (src, dst, exp) [E_k/ng] (per stratification level,
-    each chunk dst-sorted); blocks[i] [n_dst, n_src/ng]; dsrc/ddst/dexp
-    [D/ng] (the incremental delta segment); seeds [B/nd, 2]; q_slots
-    [B/nd, Q]. ``meta`` is a slim RunMeta (not the CompiledGraph — the
-    closure must not pin host/device graph state).
+    level_edges[k] = (src, dst, exp, cav) [E_k/ng] (per stratification
+    level, each chunk dst-sorted); blocks[i] [n_dst, n_src/ng];
+    dsrc/ddst/dexp/dcav [D/ng] (the incremental delta segment); seeds
+    [B/nd, 2]; q_slots [B/nd, Q]. ``cav_static`` (instance tables + VM
+    tapes) and ``cav_req`` (request context) are REPLICATED — every chip
+    evaluates the same tiny caveat VM pass and masks its own edge shard
+    with the resulting validity rows. ``meta`` is a slim RunMeta (not
+    the CompiledGraph — the closure must not pin host/device graph
+    state).
 
     Same stratified schedule as the single-chip _run: only the cyclic
     core (level 0) iterates; each acyclic level is applied once, partial
-    propagations joined with pmax over ICI before the merge."""
+    propagations joined with pmax over ICI before the merge. The while
+    body fuses ``k_steps`` propagation steps per convergence
+    collective."""
     B = seeds.shape[0]
     rows = meta.M // LANE + 1  # + trash row
     Mp = rows * LANE
+    if meta.cav_rows > 1:
+        from ..caveats.vm import eval_caveats
+
+        # one VM pass per dispatch (contexts don't change mid-query);
+        # replicated inputs => every chip computes identical cav_ok
+        cav_ok, cav_missing = eval_caveats(
+            meta.caveats, cav_static, cav_req, meta.cav_rows)
+    else:
+        cav_ok = None
+        cav_missing = jnp.int32(0)
     dvalid = (dexp > now_rel).astype(jnp.uint8)
+    if cav_ok is not None:
+        dvalid = dvalid & cav_ok[dcav]
     brange = jnp.arange(B, dtype=jnp.int32)
     base = _seed_base(meta, seeds)
     baseflat = base.reshape(B, Mp)
@@ -85,8 +130,12 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
 
     def prop_level(V, k):
         Vflat = V.reshape(B, Mp)
-        src, dst, exp_rel = level_edges[k]
+        src, dst, exp_rel, cav = level_edges[k]
         valid = (exp_rel > now_rel).astype(jnp.uint8)
+        if cav_ok is not None:
+            # edge activation = expiration ∧ caveat verdict, evaluated
+            # against THIS chip's cav-row shard (rides with the edges)
+            valid = valid & cav_ok[cav]
         gathered = (Vflat[:, src] & valid[None, :]).T  # [E_local, B]
         prop = jax.ops.segment_max(
             gathered, dst, num_segments=Mp, indices_are_sorted=True
@@ -130,11 +179,18 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
 
     def body(state):
         V, _, it = state
-        V2 = step(V)
-        # every chip must agree on the iteration count: OR over both axes
-        changed = jnp.any(V2 != V).astype(jnp.int32)
+        # K-step fusing: k_steps hops per convergence collective. The
+        # fixpoint is monotone, so hops past convergence are no-ops and
+        # comparing across the whole block is exact (changed == 0 iff
+        # every fused step was a no-op). Every chip must agree on the
+        # step count: OR over both axes, once per BLOCK instead of once
+        # per hop.
+        Vk = V
+        for _ in range(k_steps):
+            Vk = step(Vk)
+        changed = jnp.any(Vk != V).astype(jnp.int32)
         changed = jax.lax.pmax(changed, ("data", "graph"))
-        return V2, changed, it + 1
+        return Vk, changed, it + k_steps
 
     V, still_changing, iters = jax.lax.while_loop(
         cond, body, (base, jnp.int32(1), 0)
@@ -155,23 +211,32 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
     # addressable on EVERY process — under a multi-host mesh a
     # data-sharded output cannot be fetched by the serving process
     out = jax.lax.all_gather(out, "data", axis=0, tiled=True)
-    return out, (still_changing == 0), iters
+    return out, (still_changing == 0), iters, cav_missing
 
 
 class ShardedQueryFuture:
     """A dispatched sharded query (grid or flat form). ``result()`` blocks
     and validates convergence; ``iterations()`` mirrors the single-chip
-    QueryFuture so the engine's metrics finalizers work unchanged."""
+    QueryFuture so the engine's metrics finalizers work unchanged (it
+    reports the step BUDGET consumed — a multiple of the K-step fuse
+    factor, >= the true iteration count); ``conv_checks()`` is the number
+    of convergence collectives actually paid; ``caveats_missing()`` the
+    missing-context instance count (fail-closed denials, counted by the
+    engine)."""
 
-    __slots__ = ("_out", "_converged", "_iters", "_sel", "_max_iters")
+    __slots__ = ("_out", "_converged", "_iters", "_sel", "_max_iters",
+                 "_cav_missing", "_k_steps")
 
-    def __init__(self, out, converged, iters, sel, max_iters):
+    def __init__(self, out, converged, iters, sel, max_iters,
+                 cav_missing=None, k_steps=1):
         self._out = out
         self._converged = converged
         self._iters = iters
         self._sel = sel  # None (grid) | (rows, cols) flat re-map |
         # ("contig_grid", L, R) row-major window slice
         self._max_iters = max_iters
+        self._cav_missing = cav_missing
+        self._k_steps = max(int(k_steps), 1)
 
     def result(self) -> np.ndarray:
         if not bool(self._converged):
@@ -192,6 +257,14 @@ class ShardedQueryFuture:
     def iterations(self) -> int:
         return int(self._iters)
 
+    def conv_checks(self) -> int:
+        """Convergence collective-ORs this query paid: one per K-step
+        block (``iterations() / K``), vs one per hop before fusing."""
+        return int(self._iters) // self._k_steps
+
+    def caveats_missing(self) -> int:
+        return 0 if self._cav_missing is None else int(self._cav_missing)
+
 
 def _pair_keys(pairs: Optional[np.ndarray]) -> np.ndarray:
     if pairs is None or not len(pairs):
@@ -203,21 +276,24 @@ class ShardedGraph:
     """A CompiledGraph pinned across a device mesh.
 
     Edge tensors and dense-block matrices are placed once with ``graph``-
-    axis shardings and stay device-resident across queries; only
-    seeds/queries (and, after incremental writes, the small delta segment)
-    move host->device.
+    axis shardings and stay device-resident across queries; the caveat
+    instance tables + VM tapes are replicated across every device; only
+    seeds/queries, the (tiny) per-request caveat context, and — after
+    incremental writes — the small delta/instance patches move
+    host->device.
     """
 
     def __init__(self, cg: CompiledGraph, mesh: Mesh,
-                 max_iters: int = DEFAULT_MAX_ITERS):
-        if cg.caveats is not None and getattr(cg.caveats, "metas", ()):
-            # the sharded fixpoint has no caveat VM: serving caveated
-            # edges unconditionally would FAIL OPEN. Engine._backend
-            # routes caveated graphs through the single-device path;
-            # refusing here keeps any other caller honest.
-            raise ValueError(
-                "ShardedGraph does not evaluate caveats; caveated "
-                "graphs must use the single-device path")
+                 max_iters: int = DEFAULT_MAX_ITERS,
+                 k_steps: Optional[int] = None):
+        reason = self.unsupported_reason(cg)
+        if reason is not None:
+            # serving such a graph here would FAIL OPEN (conditional
+            # edges with no per-edge rows to mask). Engine._backend
+            # routes these through the single-device path; refusing
+            # here keeps any other caller honest.
+            raise ValueError(f"ShardedGraph cannot serve this graph: "
+                             f"{reason}")
         self.cg = cg
         self.mesh = mesh
         self.max_iters = max_iters
@@ -225,52 +301,74 @@ class ShardedGraph:
         self.ng = mesh.shape["graph"]
         self._edge_sh = NamedSharding(mesh, P("graph"))
         self._block_sh = NamedSharding(mesh, P(None, "graph"))
+        self._repl_sh = NamedSharding(mesh, P())
 
-        # the overlay host arrays (delta segment, res_exp, dead ledger)
-        # are SHARED and mutated in place by incremental_update — read
-        # them under the graph's host guard so a racing overlay append
-        # cannot tear the snapshot this build uploads
+        meta = cg.run_meta()
+        # the raw override (None = derive per graph) is kept so updated()'s
+        # full-rebuild paths preserve an explicit caller choice instead of
+        # silently reverting to the derived default mid-stream
+        self._k_override = k_steps
+        self.k_steps = (max(int(k_steps), 1) if k_steps
+                        else convergence_fuse_steps(meta))
+
+        # the overlay host arrays (delta segment, res_exp, dead ledger,
+        # caveat instance tables) are SHARED and mutated in place by
+        # incremental_update — read them under the graph's host guard so
+        # a racing overlay append cannot tear the snapshot this build
+        # uploads
         with cg._host_guard():
             level_arrays, kept = self._host_level_edges()
             # host copies for the incremental dead-pair search (per
             # level, each dst-sorted)
             self._h_levels = level_arrays
             self._level_edges = tuple(
-                tuple(jax.device_put(a, self._edge_sh) for a in triple)
-                for triple in level_arrays
+                tuple(jax.device_put(a, self._edge_sh) for a in quad)
+                for quad in level_arrays
             )
             self._block_meta = tuple(kept)
             self._blocks = tuple(
                 jax.device_put(self._block_matrix(bm), self._block_sh)
                 for bm in kept
             )
-            (self._dsrc, self._ddst, self._dexp,
-             self._h_dexp) = self._delta_device(cg)
+            (self._dsrc, self._ddst, self._dexp, self._dcav,
+             self._h_dexp, self._h_dcav) = self._delta_device(cg)
+            # caveat instance tables + tapes: replicated on every device
+            # (tiny next to the edge shards), plus the per-caveat
+            # applied-row watermark updated() syncs spare-row appends
+            # against
+            cavt = cg.caveats
+            if cavt is not None and cavt.metas:
+                self._cav_static = cavt.device_static(
+                    sharding=self._repl_sh)
+                self._applied_inst = cavt.applied_rows()
+            else:
+                self._cav_static = ()
+                self._applied_inst = ()
         # dead pairs already folded into this build (updated() applies
-        # only the new tail); _applied_delta / _h_dexp let updated()
-        # patch only the overlay slots that actually changed instead of
-        # re-uploading the whole segment per write
+        # only the new tail); _applied_delta / _h_dexp / _h_dcav let
+        # updated() patch only the overlay slots that actually changed
+        # instead of re-uploading the whole segment per write
         self._applied_dead = _pair_keys(cg.dead_pairs)
         self._applied_delta = cg.n_delta
         # device query-grid cache for layout-pure queries (shared across
         # updated() generations: the slot layout is incremental-invariant)
         self._qgrid: dict = {}
 
-        meta = cg.run_meta()
         if meta.n_levels + 1 != len(self._level_edges):
             raise AssertionError(
                 "level edge arrays out of step with stratification")
         fn = partial(_run_sharded, meta, self._block_meta, self.ng,
-                     max_iters=max_iters)
+                     max_iters=max_iters, k_steps=self.k_steps)
         smap_kw = dict(
             mesh=mesh,
             in_specs=(
-                tuple((P("graph"),) * 3 for _ in self._level_edges),
+                tuple((P("graph"),) * 4 for _ in self._level_edges),
                 tuple(P(None, "graph") for _ in kept),
-                P("graph"), P("graph"), P("graph"),
+                P("graph"), P("graph"), P("graph"), P("graph"),
+                P(), P(),
                 P("data", None), P("data", None), P(),
             ),
-            out_specs=(P(None, None), P(), P()),
+            out_specs=(P(None, None), P(), P(), P()),
         )
         try:
             smapped = shard_map(fn, check_vma=False, **smap_kw)
@@ -281,6 +379,31 @@ class ShardedGraph:
             smapped = shard_map(fn, check_rep=False, **smap_kw)
         self._run = jax.jit(smapped)
 
+    @staticmethod
+    def unsupported_reason(cg: CompiledGraph) -> Optional[str]:
+        """Why this graph cannot run on the mesh, or ``None`` (the
+        common case — caveated graphs ARE served here). The one
+        genuinely unsupported shape: a caveated graph without complete
+        stratified per-edge caveat rows (hand-built layouts) — its
+        level arrays would carry no rows to mask, so conditional edges
+        would serve unconditionally (fail open). The predicate MIRRORS
+        the branches ``_host_level_edges`` actually takes: the
+        ``res_idx is None or res_src is None`` whole-edge-set path
+        builds zero cav rows, and a ``res_cav``/``res_src`` length
+        mismatch would zero-fill — both must refuse when caveat
+        instances exist (compiled graphs always set all three
+        together). Engine._backend counts these in
+        ``engine_caveat_mesh_fallback_total`` and keeps them on the
+        single-device path."""
+        cavt = getattr(cg, "caveats", None)
+        if cavt is not None and getattr(cavt, "metas", ()):
+            if cg.res_idx is None or cg.res_src is None \
+                    or cg.res_cav is None \
+                    or len(cg.res_cav) != len(cg.res_src):
+                return ("caveated graph without per-edge caveat rows "
+                        "(unstratified/hand-built layout)")
+        return None
+
     # -- host-side construction ---------------------------------------------
 
     def _dead_set(self):
@@ -289,7 +412,7 @@ class ShardedGraph:
         d = self.cg.dead_pairs
         return set(zip(d[:, 0].tolist(), d[:, 1].tolist()))
 
-    def _pad_level(self, src, dst, exp):
+    def _pad_level(self, src, dst, exp, cav):
         """Pad one level's edges with trash rows so the graph axis
         divides evenly (at least ng rows so every chip has a chunk)."""
         n = max(len(src), 1)
@@ -297,25 +420,33 @@ class ShardedGraph:
         s = np.full(n_pad, self.cg.M, dtype=np.int32)
         d = np.full(n_pad, self.cg.M, dtype=np.int32)
         e = np.full(n_pad, -np.inf, dtype=np.float32)
+        c = np.zeros(n_pad, dtype=np.int32)  # pad rows: uncaveated
         s[: len(src)] = src
         d[: len(dst)] = dst
         e[: len(exp)] = exp
-        return s, d, e
+        c[: len(cav)] = cav
+        return s, d, e, c
 
     def _host_level_edges(self):
         """(level_arrays, kept_blocks): per stratification level 0..L, the
-        (src, dst, exp) edge arrays this mesh gathers over (base residual
-        slice + folded-back blocks of that level, dst-sorted, padded to
-        the graph axis) and the dense blocks that stay on the MXU path
-        (src axis divisible by the graph-axis size)."""
+        (src, dst, exp, cav) edge arrays this mesh gathers over (base
+        residual slice + folded-back blocks of that level, dst-sorted,
+        padded to the graph axis) and the dense blocks that stay on the
+        MXU path (src axis divisible by the graph-axis size). Folded
+        block edges are never caveated (caveated edges are excluded from
+        dense blocks at compile, like expiring ones), so they carry
+        row 0."""
         cg = self.cg
         dead = self._dead_set()
         if cg.res_idx is None or cg.res_src is None:
             # no dense split computed: whole edge set on the segment path
             # as one core level, with dead pairs killed in place
+            # (unsupported_reason refuses caveated graphs in this shape,
+            # so the cav rows are all 0)
             b_src = cg.src[: cg.n_edges].astype(np.int32, copy=False)
             b_dst = cg.dst[: cg.n_edges].astype(np.int32, copy=False)
             b_exp = cg.exp_rel[: cg.n_edges].astype(np.float32, copy=True)
+            b_cav = np.zeros(cg.n_edges, dtype=np.int32)
             if dead:
                 for s, t in dead:
                     lo = int(np.searchsorted(b_dst, t, side="left"))
@@ -323,7 +454,7 @@ class ShardedGraph:
                     if lo < hi:
                         hit = lo + np.flatnonzero(b_src[lo:hi] == s)
                         b_exp[hit] = -np.inf
-            return [self._pad_level(b_src, b_dst, b_exp)], []
+            return [self._pad_level(b_src, b_dst, b_exp, b_cav)], []
         kept, folded = [], []
         for bm in cg.blocks:
             if bm.n_src % self.ng == 0:
@@ -331,6 +462,9 @@ class ShardedGraph:
             else:
                 folded.append(bm)
         bounds = cg.res_level_bounds or (0, len(cg.res_src))
+        res_cav = cg.res_cav
+        if res_cav is None or len(res_cav) != len(cg.res_src):
+            res_cav = np.zeros(len(cg.res_src), dtype=np.int32)
         n_levels = cg.n_levels
         out = []
         for k in range(n_levels + 1):
@@ -339,23 +473,27 @@ class ShardedGraph:
             # trailing bucket padding is harmless trash
             lo, hi = bounds[k], bounds[k + 1]
             parts = [(cg.res_src[lo:hi], cg.res_dst[lo:hi],
-                      cg.res_exp[lo:hi])]
+                      cg.res_exp[lo:hi], res_cav[lo:hi])]
             for bm in folded:
                 if bm.level != k:
                     continue
                 e_src = (bm.src_off + bm.src_local).astype(np.int32)
                 e_dst = (bm.dst_off + bm.dst_local).astype(np.int32)
                 keep = self._not_dead_mask(e_src, e_dst, dead)
+                n_keep = int(keep.sum())
                 parts.append((
                     e_src[keep], e_dst[keep],
-                    np.full(int(keep.sum()), np.inf, dtype=np.float32)))
+                    np.full(n_keep, np.inf, dtype=np.float32),
+                    np.zeros(n_keep, dtype=np.int32)))
             src = np.concatenate([p[0] for p in parts])
             dst = np.concatenate([p[1] for p in parts])
             exp = np.concatenate([p[2] for p in parts])
+            cav = np.concatenate([p[3] for p in parts])
             if len(parts) > 1:  # merged folded edges: restore dst order
                 order = np.argsort(dst, kind="stable")
-                src, dst, exp = src[order], dst[order], exp[order]
-            out.append(self._pad_level(src, dst, exp))
+                src, dst, exp, cav = (src[order], dst[order], exp[order],
+                                      cav[order])
+            out.append(self._pad_level(src, dst, exp, cav))
         return out, kept
 
     @staticmethod
@@ -377,9 +515,9 @@ class ShardedGraph:
 
     def _delta_device(self, cg: CompiledGraph):
         """Upload the delta segment, padded so the graph axis divides.
-        Returns the three device arrays plus the padded host expiration
-        copy — updated()'s change-detection mirror."""
-        d_src, d_dst, d_exp, _ = cg._delta_host()
+        Returns the four device arrays plus the padded host expiration
+        and caveat-row copies — updated()'s change-detection mirrors."""
+        d_src, d_dst, d_exp, d_cav = cg._delta_host()
         pad = len(d_src)
         if pad % self.ng:
             pad2 = ((pad + self.ng - 1) // self.ng) * self.ng
@@ -389,10 +527,14 @@ class ShardedGraph:
                 [d_dst, np.full(pad2 - pad, cg.M, dtype=np.int32)])
             d_exp = np.concatenate(
                 [d_exp, np.full(pad2 - pad, -np.inf, dtype=np.float32)])
+            d_cav = np.concatenate(
+                [d_cav, np.zeros(pad2 - pad, dtype=np.int32)])
         return (jax.device_put(d_src, self._edge_sh),
                 jax.device_put(d_dst, self._edge_sh),
                 jax.device_put(d_exp, self._edge_sh),
-                np.array(d_exp, dtype=np.float32))
+                jax.device_put(d_cav, self._edge_sh),
+                np.array(d_exp, dtype=np.float32),
+                np.array(d_cav, dtype=np.int32))
 
     # -- incremental updates -------------------------------------------------
 
@@ -404,8 +546,16 @@ class ShardedGraph:
         old = self.cg
         if cg is old:
             return self
+
+        def rebuild() -> "ShardedGraph":
+            # ONE spelling of the full-rebuild fallback: every early
+            # return must carry the same construction-time preferences
+            # (an explicit k_steps override must survive a rebuild)
+            return ShardedGraph(cg, self.mesh, self.max_iters,
+                                self._k_override)
+
         if cg.signature() != old.signature():
-            return ShardedGraph(cg, self.mesh, self.max_iters)
+            return rebuild()
         # signature equality only proves JIT compatibility (shapes,
         # layout, stratification) — delta-apply is valid ONLY for
         # incremental descendants, which share their base edge arrays BY
@@ -417,7 +567,7 @@ class ShardedGraph:
         # denials.
         if not (cg.res_src is old.res_src and cg.res_dst is old.res_dst
                 and cg.src is old.src and cg.dst is old.dst):
-            return ShardedGraph(cg, self.mesh, self.max_iters)
+            return rebuild()
         reclosed_idx: list[int] = []
         if cg.blocks is not old.blocks:
             # a re-closed closured block (incremental membership delete)
@@ -426,7 +576,7 @@ class ShardedGraph:
             # state; anything else (and folded blocks, whose closure
             # edges live inside the level arrays) needs the full rebuild.
             if len(cg.blocks) != len(old.blocks):
-                return ShardedGraph(cg, self.mesh, self.max_iters)
+                return rebuild()
             for i, (nb, ob) in enumerate(zip(cg.blocks, old.blocks)):
                 if nb is ob:
                     continue
@@ -436,7 +586,7 @@ class ShardedGraph:
                     and nb.level == ob.level and nb.closured
                     and ob.closured)
                 if not same_shape or nb.n_src % self.ng:
-                    return ShardedGraph(cg, self.mesh, self.max_iters)
+                    return rebuild()
                 reclosed_idx.append(i)
         new = object.__new__(ShardedGraph)
         new.__dict__.update(self.__dict__)
@@ -461,7 +611,7 @@ class ShardedGraph:
             pos_per_level: dict[int, list] = {}
             block_cells: dict[int, list] = {}
             for s, t in pairs.tolist():
-                for k, (h_src, h_dst, _) in enumerate(self._h_levels):
+                for k, (h_src, h_dst, _, _) in enumerate(self._h_levels):
                     lo = int(np.searchsorted(h_dst, t, side="left"))
                     hi = int(np.searchsorted(h_dst, t, side="right"))
                     if lo < hi:
@@ -476,10 +626,10 @@ class ShardedGraph:
             if pos_per_level:
                 levels = list(self._level_edges)
                 for k, pos in pos_per_level.items():
-                    s_dev, d_dev, e_dev = levels[k]
+                    s_dev, d_dev, e_dev, c_dev = levels[k]
                     levels[k] = (s_dev, d_dev, jax.device_put(
                         e_dev.at[np.asarray(pos, dtype=np.int64)]
-                        .set(-np.inf), self._edge_sh))
+                        .set(-np.inf), self._edge_sh), c_dev)
                 new._level_edges = tuple(levels)
             if block_cells:
                 blocks = list(self._blocks)
@@ -497,16 +647,21 @@ class ShardedGraph:
             # instead of re-uploading the whole capacity-sized segment
             # on every write (the pre-patch behavior, which made each
             # mesh write pay O(capacity) host->device traffic).
-            d_src, d_dst, d_exp, _ = cg._delta_host()
+            d_src, d_dst, d_exp, d_cav = cg._delta_host()
             n = len(d_exp)
             mirror = self._h_dexp
-            # appended slots (src/dst/exp assigned once, at append)...
+            mirror_c = self._h_dcav
+            # appended slots (src/dst assigned once, at append)...
             app = np.arange(self._applied_delta,
                             min(cg.n_delta, n), dtype=np.int64)
             # ...plus expiration re-touches of EXISTING slots
             # (touch/delete reuse their pair's slot in place)
             changed = np.flatnonzero(mirror[:n] != d_exp)
             changed = np.union1d(changed, app)
+            # ...and caveat-row re-touches (a touch may attach, replace,
+            # or strip the condition without moving the expiration)
+            changed_c = np.union1d(
+                np.flatnonzero(mirror_c[:n] != d_cav), app)
             if len(changed):
                 new._h_dexp = mirror.copy()
                 new._h_dexp[changed] = d_exp[changed]
@@ -520,32 +675,88 @@ class ShardedGraph:
                 new._dexp = jax.device_put(
                     self._dexp.at[changed].set(d_exp[changed]),
                     self._edge_sh)
+            if len(changed_c):
+                new._h_dcav = mirror_c.copy()
+                new._h_dcav[changed_c] = d_cav[changed_c]
+                new._dcav = jax.device_put(
+                    self._dcav.at[changed_c].set(d_cav[changed_c]),
+                    self._edge_sh)
             new._applied_delta = cg.n_delta
+            # caveat instance appends: incremental_update placed new
+            # (caveat, context) rows into the shared host tables' spare
+            # rows (append-only per caveat) — patch exactly those column
+            # ranges into the REPLICATED device tables, O(new rows)
+            cavt = cg.caveats
+            if cavt is not None and cavt.metas and self._cav_static:
+                used = cavt.applied_rows()
+                if used != self._applied_inst:
+                    cs = list(self._cav_static)
+                    for ci, (lo, hi) in enumerate(
+                            zip(self._applied_inst, used)):
+                        if hi <= lo:
+                            continue
+                        h = cavt.hosts[ci]
+                        sl = slice(lo, hi)
+                        ent = dict(cs[ci])
+                        ent["ce"] = ent["ce"].at[:, sl].set(h.ctx_e[:, sl])
+                        ent["cv"] = ent["cv"].at[:, sl].set(h.ctx_v[:, sl])
+                        ent["ck"] = ent["ck"].at[:, sl].set(h.ctx_k[:, sl])
+                        ent["loe"] = ent["loe"].at[:, :, sl].set(
+                            h.lo_e[:, :, sl])
+                        ent["lov"] = ent["lov"].at[:, :, sl].set(
+                            h.lo_v[:, :, sl])
+                        ent["hie"] = ent["hie"].at[:, :, sl].set(
+                            h.hi_e[:, :, sl])
+                        ent["hiv"] = ent["hiv"].at[:, :, sl].set(
+                            h.hi_v[:, :, sl])
+                        ent["lk"] = ent["lk"].at[:, sl].set(
+                            h.list_k[:, sl])
+                        ent["real"] = ent["real"].at[sl].set(h.real[sl])
+                        # re-pin the replicated placement explicitly: the
+                        # functional update must not leave a table with a
+                        # committed single-device layout
+                        cs[ci] = {k2: jax.device_put(v2, self._repl_sh)
+                                  for k2, v2 in ent.items()}
+                    new._cav_static = tuple(cs)
+                    new._applied_inst = used
         return new
 
     # -- dispatch -----------------------------------------------------------
 
     def _dispatch(self, seeds_pad: np.ndarray, grid: np.ndarray,
-                  now: Optional[float]):
-        now_rel = np.float32(
-            (time.time() if now is None else now) - self.cg.base_time
-        )
+                  now_abs: float, cav_req: tuple):
+        now_rel = np.float32(now_abs - self.cg.base_time)
         # host numpy inputs stay UNCOMMITTED: jit shards them per the
         # in_specs, which works identically whether the mesh spans one
         # process or many (a committed local array would need a reshard
         # from a non-global placement under multi-controller)
-        out, converged, iters = self._run(
+        out, converged, iters, cav_missing = self._run(
             self._level_edges, self._blocks,
-            self._dsrc, self._ddst, self._dexp,
+            self._dsrc, self._ddst, self._dexp, self._dcav,
+            self._cav_static, cav_req,
             seeds_pad, grid, now_rel,
         )
         try:
             out.copy_to_host_async()
             converged.copy_to_host_async()
             iters.copy_to_host_async()
+            cav_missing.copy_to_host_async()
         except AttributeError:  # non-jax backends in tests
             pass
-        return out, converged, iters
+        return out, converged, iters, cav_missing
+
+    def _request_arrays(self, context: Optional[dict],
+                        cav_req: Optional[tuple], now_abs: float) -> tuple:
+        """The per-caveat request-context arrays riding this dispatch
+        (replicated); pre-encoded ``cav_req`` (chunked bulk callers)
+        wins, else encode here — including the auto-injected ``now``."""
+        cavt = self.cg.caveats
+        if cavt is None or not cavt.metas:
+            return ()
+        if cav_req is not None:
+            return cav_req
+        req, _ = cavt.encode_request(context, now_abs)
+        return req
 
     def _pad_rows(self, B: int) -> int:
         B_pad = max(_next_bucket(B, 1), self.nd)
@@ -558,6 +769,7 @@ class ShardedGraph:
         seed_slots: np.ndarray,  # int32 [B, 2] (subject slot, wildcard slot)
         q_slots: np.ndarray,  # int32 [B, Q] result slots per subject
         now: Optional[float] = None,
+        context: Optional[dict] = None,
     ) -> np.ndarray:
         """Run the sharded fixpoint; returns bool [B, Q]."""
         cg = self.cg
@@ -568,8 +780,11 @@ class ShardedGraph:
         seeds[:B] = seed_slots
         qs = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
         qs[:B, :Q] = q_slots
-        out, converged, iters = self._dispatch(seeds, qs, now)
-        fut = ShardedQueryFuture(out, converged, iters, None, self.max_iters)
+        now_abs = time.time() if now is None else now
+        out, converged, iters, cav_missing = self._dispatch(
+            seeds, qs, now_abs, self._request_arrays(context, None, now_abs))
+        fut = ShardedQueryFuture(out, converged, iters, None,
+                                 self.max_iters, cav_missing, self.k_steps)
         return fut.result()[:B, :Q]
 
     def query_async(
@@ -582,10 +797,12 @@ class ShardedGraph:
         q_contiguous: Optional[bool] = None,  # accepted for surface parity
         q_contig_grid: Optional[tuple] = None,  # (lo, L, R) promise: R rows
         # x one shared [lo, lo+L) window — skips the rank re-map entirely
-        context: Optional[dict] = None,  # surface parity; caveated
-        # graphs never reach this backend (constructor guard), so a
-        # request context has nothing to gate here
-        cav_req: Optional[tuple] = None,  # surface parity (unused)
+        context: Optional[dict] = None,  # request caveat context: merged
+        # under the tuple contexts ON the mesh (replicated request
+        # arrays), exactly like the single-device dispatch
+        cav_req: Optional[tuple] = None,  # pre-encoded request arrays
+        # (CompiledCaveats.encode_request) — chunked bulk callers encode
+        # ONCE for the whole logical call instead of per chunk
     ) -> ShardedQueryFuture:
         """Engine-compatible flat form (CompiledGraph.query_async surface):
         the flat (q_slots, q_batch) queries are packed into a [B, Qmax]
@@ -652,8 +869,13 @@ class ShardedGraph:
                 if len(self._qgrid) >= 32:
                     self._qgrid.pop(next(iter(self._qgrid)), None)
                 self._qgrid[(q_cache_key, B_pad)] = grid
-        out, converged, iters = self._dispatch(seeds, grid, now)
+        now_abs = time.time() if now is None else now
+        out, converged, iters, cav_missing = self._dispatch(
+            seeds, grid, now_abs,
+            self._request_arrays(context, cav_req, now_abs))
         sel = (("contig_grid", L, R) if contig is not None
                else (q_batch, cols))
         return ShardedQueryFuture(out, converged, iters, sel,
-                                  max_iters=self.max_iters)
+                                  max_iters=self.max_iters,
+                                  cav_missing=cav_missing,
+                                  k_steps=self.k_steps)

@@ -20,6 +20,37 @@ def build(meta):
     return jax.jit(partial(_kernel, meta))
 
 
+def _mesh_kernel(meta, edges, n_steps):
+    for _ in range(n_steps):  # finding: traced n_steps through the
+        edges = edges + 1  # shard_map wrapper + assignment chain
+    return edges
+
+
+def build_mesh(meta):
+    from jax.experimental.shard_map import shard_map
+
+    fn = partial(_mesh_kernel, meta)
+    smapped = shard_map(fn, in_specs=None, out_specs=None)
+    return jax.jit(smapped)
+
+
+def _mesh_kernel_b(meta, z, m):
+    for _ in range(m):  # finding: reached through the SECOND function's
+        z = z * 2  # same-named locals (scope-aware resolution)
+    return z
+
+
+def build_mesh_b(meta):
+    # deliberately the SAME local names as build_mesh: a module-global
+    # assignment map would resolve `fn`/`smapped` to build_mesh's chain
+    # and never check _mesh_kernel_b
+    from jax.experimental.shard_map import shard_map
+
+    fn = partial(_mesh_kernel_b, meta)
+    smapped = shard_map(fn, in_specs=None, out_specs=None)
+    return jax.jit(smapped)
+
+
 _lock = threading.Lock()
 
 

@@ -23,6 +23,25 @@ def build(meta):
     return jax.jit(partial(_kernel, meta), static_argnames=("n",))
 
 
+def _mesh_kernel(meta, x, k_steps):
+    # meta is positional-bound and k_steps KEYWORD-bound through the
+    # partial -> shard_map -> assignment chain: both are static, so
+    # Python control flow on them is fine (the mesh path's K-step loop)
+    for _ in range(k_steps):
+        x = x * 2
+    if meta.levels > 1:
+        x = x + 1
+    return x
+
+
+def build_mesh(meta):
+    from jax.experimental.shard_map import shard_map
+
+    fn = partial(_mesh_kernel, meta, k_steps=4)
+    smapped = shard_map(fn, in_specs=None, out_specs=None)
+    return jax.jit(smapped)
+
+
 _lock = threading.Lock()
 
 

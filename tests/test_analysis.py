@@ -95,7 +95,13 @@ def test_jit_stability_fires_on_bad():
     assert "np-on-traced-x" in toks
     assert "item-in-jit" in toks
     assert "host-sync-under-_lock" in toks
-    assert len(fs) == 6
+    # the mesh-path shape: jit target resolved through an assignment
+    # chain and the shard_map wrapper (jax.jit(shard_map(partial(f))))
+    assert "py-range-n_steps" in toks
+    # scope-aware resolution: a SECOND function reusing the same local
+    # names (fn/smapped) must still have ITS kernel checked
+    assert "py-range-m" in toks
+    assert len(fs) == 8
 
 
 def test_jit_stability_quiet_on_good():

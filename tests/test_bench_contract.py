@@ -66,9 +66,47 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     assert stages["device"]["n"] > 0
     assert stages["device"]["p50_ms"] is not None
     _assert_caveat_schema(out["caveats"])
+    _assert_mesh_schema(out["mesh"])
     _assert_shard_schema(out["shard"])
     _assert_rebalance_schema(out["rebalance"])
     _assert_macro_schema(out["macro"])
+
+
+def _assert_mesh_schema(mesh: dict) -> None:
+    """The ISSUE 15 mesh contract: the device-count axis is MEASURED
+    (monotone, labeled with its (data, graph) topology), the caveated
+    mix ran ON the mesh (`engine_caveat_mesh_fallback_total` delta
+    == 0), steady churn stayed recompile-free on the resident shards,
+    p50s are finite, and the K-step fuse's convergence-collective
+    reduction is recorded relative to the one-per-hop baseline (the
+    single-device iteration count): checks <= ceil(iters/K) + 1."""
+    assert mesh["devices_available"] >= 1
+    assert mesh["n_pods"] >= 1 and mesh["n_rels"] >= 1
+    assert 0.0 < mesh["caveated_share"] < 1.0
+    assert mesh["caveat_mesh_fallbacks"] == 0
+    counts = mesh["device_counts"]
+    assert counts and counts == sorted(set(counts))
+    iters = mesh["fixpoint_iters_single"]
+    assert isinstance(iters, int) and iters >= 1
+    assert set(mesh["points"]) == {str(c) for c in counts}
+    for c in counts:
+        pt = mesh["points"][str(c)]
+        assert pt["devices"] == c
+        assert pt["data"] * pt["graph"] == c  # topology label
+        assert isinstance(pt["platform"], str) and pt["platform"]
+        v = pt["list_p50_ms"]
+        assert isinstance(v, (int, float)) and v == v and v > 0 \
+            and abs(v) != float("inf"), (c, v)
+        k = pt["k_steps"]
+        assert isinstance(k, int) and k >= 2
+        checks = pt["conv_checks"]
+        # per-point baseline, measured at the SAME revision as the mesh
+        # query (churn between points can add hops to the cyclic core)
+        base = pt["conv_checks_before"]
+        assert isinstance(base, int) and base >= 1
+        assert 1 <= checks <= -(-base // k) + 1, (c, checks, base, k)
+        assert pt["churn_recompiles"] == 0
+        assert pt["churn_sharded_updates"] >= 1
 
 
 def _assert_shard_schema(sh: dict) -> None:

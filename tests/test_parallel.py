@@ -333,6 +333,260 @@ def test_proxy_with_engine_mesh(tmp_path):
     asyncio.run(go())
 
 
+CAVEAT_BOOTSTRAP = """
+schema: |-
+  use expiration
+  caveat ip_allowlist(ip ipaddress, allowed list<ipaddress>) {
+    ip in allowed
+  }
+  caveat win(now timestamp, until timestamp) { now < until }
+  definition user {}
+  definition doc {
+    relation viewer: user | user with ip_allowlist
+      | user with win | user with expiration
+    permission view = viewer
+  }
+relationships: |-
+  doc:readme#viewer@user:alice
+  doc:readme#viewer@user:bob[ip_allowlist:{"allowed":["10.0.0.0/8"]}]
+  doc:plan#viewer@user:bob[ip_allowlist:{"allowed":["192.168.0.0/16"]}]
+"""
+
+
+def test_sharded_caveated_matches_single_device_and_oracle():
+    """Conditional grants evaluate ON the mesh: the caveat VM runs
+    inside the shard_map body against replicated instance tables, so a
+    caveated graph routes through ShardedGraph (no fallback) and its
+    verdicts — satisfying context, non-matching context, and the
+    fail-closed missing-context tri-state — are byte-identical to the
+    single-device engine and the recursive oracle."""
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    mesh = make_mesh(8, data=2, graph=4)
+    em = Engine(bootstrap=CAVEAT_BOOTSTRAP, mesh=mesh)
+    e1 = Engine(bootstrap=CAVEAT_BOOTSTRAP)
+    fb0 = metrics.counter("engine_caveat_mesh_fallback_total").value
+    items = [CheckItem("doc", d, "view", "user", u)
+             for d in ("readme", "plan") for u in ("alice", "bob")]
+    for ctx in ({"ip": "10.0.0.5"}, {"ip": "192.168.1.1"},
+                {"ip": "8.8.8.8"}, None):
+        got = em.check_bulk(items, context=ctx)
+        assert got == e1.check_bulk(items, context=ctx), ctx
+        o = em.oracle(context=ctx)
+        assert got == [o.check(i.resource_type, i.resource_id,
+                               i.permission, i.subject_type, i.subject_id)
+                       for i in items], ctx
+    # the mesh really served these: ShardedGraph built, zero fallbacks
+    assert em._sharded is not None
+    assert metrics.counter(
+        "engine_caveat_mesh_fallback_total").value == fb0
+    # contexted lookups agree too
+    for ctx in ({"ip": "10.0.0.5"}, None):
+        assert sorted(em.lookup_resources("doc", "view", "user", "bob",
+                                          context=ctx)) == \
+            sorted(e1.lookup_resources("doc", "view", "user", "bob",
+                                       context=ctx))
+    # missing context fails closed AND counts through the mesh path
+    c0 = metrics.counter(
+        "engine_caveat_denied_missing_context_total").value
+    assert em.check_bulk(
+        [CheckItem("doc", "readme", "view", "user", "bob")]) == [False]
+    assert metrics.counter(
+        "engine_caveat_denied_missing_context_total").value > c0
+
+
+def test_sharded_caveated_incremental_churn_differential():
+    """The ISSUE 15 parity bar: randomized caveated + expiring +
+    plain-tuple churn (touches with reused AND new contexts, deletes,
+    live/lapsed expirations) applied to a mesh engine and a
+    single-device engine in lockstep on the forced 8-device host
+    platform — after EVERY batch the verdicts are byte-identical to
+    each other and to the recursive oracle, and steady churn stays on
+    the incremental path (no per-write recompiles, resident shard
+    reuse)."""
+    import time as _time
+
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    rng = np.random.default_rng(0xCAFE)
+    mesh = make_mesh(8, data=2, graph=4)
+    em = Engine(bootstrap=CAVEAT_BOOTSTRAP, mesh=mesh)
+    e1 = Engine(bootstrap=CAVEAT_BOOTSTRAP)
+    users = [f"u{i}" for i in range(6)]
+    docs = [f"d{i}" for i in range(5)]
+    ctxs = ['{"allowed":["10.0.0.0/8"]}',
+            '{"allowed":["172.16.0.0/12"]}']
+    req = {"ip": "10.5.5.5"}
+    now_fixed = _time.time()
+
+    def wr(op):
+        for eng in (em, e1):
+            eng.write_relationships([op])
+
+    # warm both engines (first compile + first sharded build)
+    em.check_bulk([CheckItem("doc", "readme", "view", "user", "alice")],
+                  context=req, now=now_fixed)
+    e1.check_bulk([CheckItem("doc", "readme", "view", "user", "alice")],
+                  context=req, now=now_fixed)
+    compiles0 = metrics.counter("engine_graph_compiles_total").value
+    fb0 = metrics.counter("engine_caveat_mesh_fallback_total").value
+    live: list[Relationship] = []
+    for step in range(16):
+        kind = int(rng.integers(4))
+        d = docs[int(rng.integers(len(docs)))]
+        u = users[int(rng.integers(len(users)))]
+        if kind == 0 and live:  # delete an existing churn tuple
+            wr(WriteOp("delete", live.pop(int(rng.integers(len(live))))))
+        elif kind == 1:  # caveated touch, contexts mostly reused
+            ctx = ctxs[int(rng.integers(len(ctxs)))]
+            rel = Relationship("doc", d, "viewer", "user", u, None, None,
+                               "ip_allowlist", ctx)
+            wr(WriteOp("touch", rel))
+            live.append(rel)
+        elif kind == 2:  # expiring grant, alive or lapsed at the clock
+            exp = now_fixed + (300.0 if rng.random() < 0.5 else -300.0)
+            rel = Relationship("doc", d, "viewer", "user", u,
+                               expiration=exp)
+            wr(WriteOp("touch", rel))
+            live.append(rel)
+        else:  # plain grant
+            rel = Relationship("doc", d, "viewer", "user", u)
+            wr(WriteOp("touch", rel))
+            live.append(rel)
+        items = [CheckItem("doc", dd, "view", "user", uu)
+                 for dd in docs for uu in users]
+        for ctx in (req, None):
+            got = em.check_bulk(items, context=ctx, now=now_fixed)
+            want = e1.check_bulk(items, context=ctx, now=now_fixed)
+            assert got == want, (step, ctx)
+            o = em.oracle(now=now_fixed, context=ctx)
+            assert got == [o.check(i.resource_type, i.resource_id,
+                                   i.permission, i.subject_type,
+                                   i.subject_id) for i in items], \
+                (step, ctx)
+        assert sorted(em.lookup_resources(
+            "doc", "view", "user", u, now=now_fixed, context=req)) == \
+            sorted(e1.lookup_resources(
+                "doc", "view", "user", u, now=now_fixed, context=req)), \
+            step
+    # reused contexts ride the overlay: no per-write full recompiles
+    # (the churn's distinct contexts at most add instance rows once),
+    # and a caveated graph NEVER fell back off the mesh
+    assert metrics.counter("engine_graph_compiles_total").value \
+        <= compiles0 + 2
+    assert metrics.counter(
+        "engine_caveat_mesh_fallback_total").value == fb0
+
+
+def test_sharded_updated_carries_caveat_instance_append():
+    """A caveated write with a NEW (caveat, context) pair rides the
+    incremental path (spare instance row) and ShardedGraph.updated()
+    patches the REPLICATED context tables in place: no recompile, no
+    sharded rebuild, and the new conditional grant answers correctly
+    under both polarities of request context."""
+    from spicedb_kubeapi_proxy_tpu.models.tuples import parse_relationship
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    mesh = make_mesh(8, data=2, graph=4)
+    em = Engine(bootstrap=CAVEAT_BOOTSTRAP, mesh=mesh)
+    em.check_bulk([CheckItem("doc", "readme", "view", "user", "bob")],
+                  context={"ip": "10.0.0.1"})
+    sg0 = em._sharded
+    assert sg0 is not None
+    compiles0 = metrics.counter("engine_graph_compiles_total").value
+    upd0 = metrics.counter("engine_sharded_updates_total").value
+    em.write_relationships([WriteOp("touch", parse_relationship(
+        'doc:memo#viewer@user:carol'
+        '[ip_allowlist:{"allowed":["172.16.0.0/12"]}]'))])
+    item = CheckItem("doc", "memo", "view", "user", "carol")
+    assert em.check_bulk([item], context={"ip": "172.16.9.9"}) == [True]
+    assert em.check_bulk([item], context={"ip": "10.0.0.1"}) == [False]
+    assert em.check_bulk([item]) == [False]  # missing ctx: fail closed
+    assert metrics.counter("engine_graph_compiles_total").value \
+        == compiles0, "instance append must not recompile"
+    assert metrics.counter("engine_sharded_updates_total").value > upd0
+    sg1 = em._sharded
+    assert sg1 is not sg0 and sg1._run is sg0._run, \
+        "updated() must reuse the jitted shard_map"
+    assert sg1._applied_inst != sg0._applied_inst, \
+        "the replicated instance tables must have advanced"
+    assert em.oracle(context={"ip": "172.16.9.9"}).check(
+        "doc", "memo", "view", "user", "carol")
+
+
+def test_sharded_kstep_fuses_convergence_checks():
+    """K-step fused fixpoint: the mesh runs K propagation steps per
+    convergence collective, so a query pays ceil(iters/K)+<=1 checks
+    instead of one per hop — counted via conv_checks() and compared
+    against the single-device iteration count for the SAME query, with
+    identical results."""
+    e, users = build_engine(seed=3)
+    cg = e.compiled()
+    objs = e._objects_by_name()
+    sg = ShardedGraph(cg, make_mesh(8, data=2, graph=4))
+    assert sg.k_steps >= 2
+    off = cg.offset_of("doc", "read")
+    n = cg.type_sizes["doc"]
+    seeds = np.asarray([cg.encode_subject("user", users[0], None, objs)],
+                       dtype=np.int32)
+    qs = off + np.arange(n, dtype=np.int32)
+    qb = np.zeros(n, dtype=np.int32)
+    f1 = cg.query_async(seeds, qs, qb)
+    want = f1.result()
+    iters_single = f1.iterations()
+    fm = sg.query_async(seeds, qs, qb)
+    got = fm.result()
+    assert np.array_equal(got, want)
+    checks = fm.conv_checks()
+    # the relative pin from ISSUE 15: at most one confirming block past
+    # the single-device iteration count, and strictly fewer collectives
+    # than one-per-hop whenever the query iterates past one block
+    assert 1 <= checks <= -(-iters_single // sg.k_steps) + 1
+    assert fm.iterations() == checks * sg.k_steps
+    if iters_single > sg.k_steps:
+        assert checks < iters_single
+    # explicit K override is honored and stays exact
+    sg4 = ShardedGraph(cg, make_mesh(8, data=2, graph=4), k_steps=4)
+    f4 = sg4.query_async(seeds, qs, qb)
+    assert np.array_equal(f4.result(), want)
+    assert f4.conv_checks() <= -(-iters_single // 4) + 1
+
+
+def test_sharded_refuses_unstratified_caveated_graph():
+    """The one genuinely unsupported mesh shape: a caveated graph
+    without per-edge caveat rows (hand-built unstratified layout) must
+    be refused — serving it would drop the caveat mask (fail open) —
+    and Engine._backend counts the fallback."""
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    em = Engine(bootstrap=CAVEAT_BOOTSTRAP)
+    cg = em.compiled()
+    assert ShardedGraph.unsupported_reason(cg) is None
+    import dataclasses
+
+    bare = dataclasses.replace(cg, res_src=None, res_dst=None,
+                               res_exp=None, res_cav=None,
+                               res_level_bounds=None, _device={})
+    assert ShardedGraph.unsupported_reason(bare) is not None
+    with pytest.raises(ValueError, match="cannot serve"):
+        ShardedGraph(bare, make_mesh(8))
+    # the engine routes it to the single-device path, counted
+    em2 = Engine(bootstrap=CAVEAT_BOOTSTRAP, mesh=make_mesh(8))
+    fb0 = metrics.counter("engine_caveat_mesh_fallback_total").value
+    backend = em2._backend(bare)
+    assert backend is bare
+    assert metrics.counter(
+        "engine_caveat_mesh_fallback_total").value == fb0 + 1
+
+
+def test_mesh_topology_label():
+    from spicedb_kubeapi_proxy_tpu.parallel.mesh import mesh_topology
+
+    t = mesh_topology(make_mesh(8, data=2, graph=4))
+    assert t == {"devices": 8, "data": 2, "graph": 4, "platform": "cpu"}
+
+
 def test_mesh_spec_parsing():
     from spicedb_kubeapi_proxy_tpu.proxy.options import (
         Options, OptionsError, _parse_mesh_spec)

@@ -22,8 +22,12 @@ signature:
   rule: snapshot under the lock, sync outside it)
 
 Jitted functions are found by name: ``jax.jit(f)``, ``jax.jit(
-partial(f, bound...))`` (the bound prefix is static), ``pjit`` same,
-and ``@jax.jit``-style decorators.
+partial(f, bound...))`` (the bound prefix AND keyword-bound names are
+static), ``pjit`` same, ``@jax.jit``-style decorators, and — the mesh
+path (parallel/sharded.py, recompile-free appends) — targets resolved
+through local ``Name = ...`` assignments and ``shard_map``-family
+wrappers: ``fn = partial(f, meta, k=K); smapped = shard_map(fn, ...);
+jax.jit(smapped)`` checks ``f`` with ``meta``/``k`` static.
 """
 
 from __future__ import annotations
@@ -37,30 +41,58 @@ RULE = "jit-stability"
 
 JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit")
 
+# transparent wrappers whose first argument is the traced function —
+# jit(shard_map(f)) must check f, not give up at the wrapper
+WRAP_NAMES = ("shard_map", "jax.shard_map",
+              "jax.experimental.shard_map.shard_map", "smap")
 
-def _jit_call_target(call: ast.Call) -> Optional[Tuple[str, int]]:
-    """(function name, number of partial-bound leading args) when *call*
-    is ``jax.jit(f)`` / ``jax.jit(partial(f, a, b))``."""
-    name = call_name(call)
-    if name not in JIT_NAMES or not call.args:
+
+def _resolve_target(expr, assigns: Dict[str, ast.AST], depth: int = 0
+                    ) -> Optional[Tuple[str, int, Set[str]]]:
+    """(function name, partial-bound positional count, partial-bound
+    keyword names) for a jit target expression, chased through Name
+    assignments, shard_map-family wrappers, and (nested) partials."""
+    if expr is None or depth > 6:
         return None
-    target = call.args[0]
-    if isinstance(target, ast.Name):
-        return target.id, 0
-    if isinstance(target, ast.Call):
-        tname = call_name(target)
-        if tname in ("partial", "functools.partial") and target.args \
-                and isinstance(target.args[0], ast.Name):
-            return target.args[0].id, len(target.args) - 1
+    if isinstance(expr, ast.Name):
+        nxt = assigns.get(expr.id)
+        if nxt is None:
+            return expr.id, 0, set()
+        return _resolve_target(nxt, assigns, depth + 1)
+    if isinstance(expr, ast.Call):
+        name = call_name(expr)
+        if name in WRAP_NAMES and expr.args:
+            return _resolve_target(expr.args[0], assigns, depth + 1)
+        if name in ("partial", "functools.partial") and expr.args:
+            inner = _resolve_target(expr.args[0], assigns, depth + 1)
+            if inner is None:
+                return None
+            fname, bound, kws = inner
+            return (fname, bound + len(expr.args) - 1,
+                    kws | {kw.arg for kw in expr.keywords
+                           if kw.arg is not None})
     return None
 
 
+def _jit_call_target(call: ast.Call, assigns: Dict[str, ast.AST]
+                     ) -> Optional[Tuple[str, int, Set[str]]]:
+    """(function name, bound positional count, bound keyword names) when
+    *call* is ``jax.jit(f)`` / ``jax.jit(partial(f, a, b, k=v))`` /
+    ``jax.jit(<name assigned from shard_map(partial(f, ...))>)``."""
+    name = call_name(call)
+    if name not in JIT_NAMES or not call.args:
+        return None
+    return _resolve_target(call.args[0], assigns)
+
+
 def _static_names(call: ast.Call, func: ast.FunctionDef,
-                  bound: int) -> Set[str]:
-    """Parameter names jit treats as static: partial-bound prefix plus
-    static_argnums/static_argnames keywords."""
+                  bound: int, bound_kws: Set[str] = frozenset()
+                  ) -> Set[str]:
+    """Parameter names jit treats as static: partial-bound positional
+    prefix, partial keyword-bound names, plus static_argnums /
+    static_argnames keywords."""
     params = [a.arg for a in func.args.args]
-    static = set(params[:bound])
+    static = set(params[:bound]) | set(bound_kws)
     for kw in call.keywords:
         if kw.arg == "static_argnames":
             for n in ast.walk(kw.value):
@@ -179,21 +211,47 @@ def run(modules) -> list:
         if mod.tree is None:
             continue
         funcs: Dict[str, ast.FunctionDef] = {}
-        jit_sites: List[Tuple[ast.Call, str, int]] = []
+        # SCOPE-AWARE assignment maps: the same local name (`fn`,
+        # `smapped`) assigned in two different functions must resolve
+        # per enclosing scope — a module-wide map would let the first
+        # function's assignment shadow every later one and silently
+        # skip (or mis-static) their jit targets. Within one scope the
+        # first assignment wins (the shard_map check_vma/check_rep
+        # fallback pair targets the same traced function either way).
+        scope_assigns: Dict[Optional[ast.AST], Dict[str, ast.AST]] = {}
+        _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
         for n in ast.walk(mod.tree):
             if isinstance(n, ast.FunctionDef):
                 funcs.setdefault(n.name, n)
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                scope = next((a for a in mod.ancestors(n)
+                              if isinstance(a, _FUNCS)), None)
+                scope_assigns.setdefault(scope, {}).setdefault(
+                    n.targets[0].id, n.value)
+
+        def assigns_for(call: ast.Call) -> Dict[str, ast.AST]:
+            scopes = [a for a in mod.ancestors(call)
+                      if isinstance(a, _FUNCS)]
+            eff = dict(scope_assigns.get(None, {}))
+            for sc in reversed(scopes):  # outermost first: inner shadows
+                eff.update(scope_assigns.get(sc, {}))
+            return eff
+
+        jit_sites: List[Tuple[ast.Call, str, int, Set[str]]] = []
+        for n in ast.walk(mod.tree):
             if isinstance(n, ast.Call):
-                tgt = _jit_call_target(n)
+                tgt = _jit_call_target(n, assigns_for(n))
                 if tgt is not None:
-                    jit_sites.append((n, tgt[0], tgt[1]))
+                    jit_sites.append((n, tgt[0], tgt[1], tgt[2]))
         seen: Set[str] = set()
-        for call, fname, bound in jit_sites:
+        for call, fname, bound, bound_kws in jit_sites:
             func = funcs.get(fname)
             if func is None or fname in seen:
                 continue
             seen.add(fname)
-            _check_jitted(mod, func, _static_names(call, func, bound),
+            _check_jitted(mod, func,
+                          _static_names(call, func, bound, bound_kws),
                           findings)
         for fname, func in funcs.items():
             if fname in seen:

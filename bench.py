@@ -307,11 +307,12 @@ def _chained_device_estimate(e, subjects, trials: int, k: int = 8):
             # scan's sequential While lowering this guarantees the K
             # queries execute back-to-back, never overlapped
             seeds, _ = jax.lax.optimization_barrier((seeds, dep))
-            out, _, _, _ = _run(cg.run_meta(), blocks, blocks_bits,
-                                src, dst, exp, cav,
-                                dsrc, ddst, dexp, dcav, cav_static, (),
-                                seeds, qs, qb, now_rel,
-                                max_iters=DEFAULT_MAX_ITERS)
+            out, _, _, _, _ = _run(cg.run_meta(), blocks, blocks_bits,
+                                   src, dst, exp, cav,
+                                   dsrc, ddst, dexp, dcav, cav_static, (),
+                                   seeds, qs, qb, now_rel,
+                                   jnp.float32(1.0),
+                                   max_iters=DEFAULT_MAX_ITERS)
             return out.astype(jnp.int32).sum(), out[:1]
         dep, _ = jax.lax.scan(body, jnp.int32(0), seed_stack)
         return dep
@@ -1109,6 +1110,18 @@ def _measure(args, result: dict) -> None:
 
         traceback.print_exc(file=sys.stderr)
         log(f"mesh section failed (non-fatal): {ex}")
+
+    # -- masked-semiring SpMM core (ISSUE 17): forced pull vs push vs
+    # auto over the caveated mix at EVERY scale (contract-pinned) —
+    # the same-revision dense-phase baseline comes from the force-mode
+    # knob, not a separate checkout
+    try:
+        _semiring_phase(result, quick, args.tiny)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"semiring section failed (non-fatal): {ex}")
 
     # -- scale-out shard scaling (ROADMAP item 4 / ISSUE 11): the same
     # tuples behind 1 vs 2 vs 4 engine groups on loopback — single-shard
@@ -1995,6 +2008,146 @@ def _mesh_phase(result: dict, quick: bool, tiny: bool) -> None:
     }
     log(f"mesh phase: {total} rels ({share:.0%} caveated), device axis "
         f"{counts}, caveat mesh fallbacks {fallbacks}")
+
+
+def _semiring_phase(result: dict, quick: bool, tiny: bool) -> None:
+    """Masked-semiring SpMM core (ISSUE 17): the caveated-mix graph's
+    dense phase measured under every mode of the one propagation
+    primitive — forced ``pull`` (the pre-semiring dense baseline, SAME
+    revision via the force-mode knob), forced ``push`` (bit-packed
+    contraction), and ``auto`` (the occupancy-switched ``lax.cond``).
+    Per mode: bulk-check p50, list-filter p50, and the per-iteration
+    push-vs-pull choices the fixpoint actually made (``push_steps`` out
+    of ``iterations``). A second section pins the Pallas-vs-lax delta
+    on the forced-pull dense path by flipping the ``SemiringDenseKernel``
+    gate between freshly-traced dispatches; on a CPU host the MXU kernel
+    never engages (both sides are the lax fallback), so the point is
+    recorded with the run-level ``[DEGRADED: cpu]`` provenance instead
+    of a fabricated speedup."""
+    import jax
+
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem
+    from spicedb_kubeapi_proxy_tpu.ops import bitprop, semiring
+    from spicedb_kubeapi_proxy_tpu.utils.features import features
+
+    if tiny:
+        n_pods, n_users, n_ns, n_groups, n_rels = 200, 100, 10, 10, 3_000
+        trials, n_checks = 3, 64
+    elif quick:
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            2_000, 500, 50, 50, 50_000)
+        trials, n_checks = 5, 512
+    else:
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            100_000, 10_000, 1_000, 1_000, 10_000_000)
+        trials, n_checks = 9, 2048
+    share = 0.3
+    e, total = build_engine(n_pods, n_users, n_ns, n_groups, n_rels,
+                            seed=3, cav_share=share, schema=MESH_SCHEMA)
+    rng = np.random.default_rng(7)
+    req_ctx = {"ip": "10.1.2.3"}
+    items = [CheckItem("pod", f"ns/p{int(p)}", "view", "user", f"u{int(u)}")
+             for p, u in zip(rng.integers(n_pods, size=n_checks),
+                             rng.integers(n_users, size=n_checks))]
+    u0 = f"u{int(rng.integers(n_users))}"
+    cg = e.compiled()
+    objs = e._objects_by_name()
+    seeds = np.asarray([cg.encode_subject("user", u0, None, objs)],
+                       dtype=np.int32)
+    off = cg.offset_of("pod", "view")
+    nq = cg.type_sizes["pod"]
+    qs = off + np.arange(nq, dtype=np.int32)
+    qb = np.zeros(nq, dtype=np.int32)
+
+    def p50(fn, n=trials):
+        lat = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return float(np.percentile(lat, 50))
+
+    def list_once():
+        e.lookup_resources_mask("pod", "view", "user", u0,
+                                context=req_ctx)
+
+    modes = {}
+    for mode in ("pull", "push", "auto"):
+        with semiring.force_mode(mode):
+            # warm: the per-mode jitted run entry compiles HERE
+            e.check_bulk(items, context=req_ctx)
+            list_once()
+            check_p50 = p50(lambda: e.check_bulk(items, context=req_ctx))
+            list_p50 = p50(list_once)
+            # a direct dispatch exposes the per-iteration mode choices
+            fut = cg.query_async(seeds, qs, qb, context=req_ctx)
+            fut.result()
+            iters = int(fut.iterations())
+            push = int(fut.push_steps())
+            modes[mode] = {
+                "check_p50_ms": round(check_p50, 3),
+                "list_p50_ms": round(list_p50, 3),
+                "iterations": iters,
+                "push_steps": push,
+                "pull_steps": int(max(iters - push, 0)),
+            }
+            log(f"semiring {mode}: check p50 {check_p50:.2f}ms, "
+                f"list p50 {list_p50:.2f}ms, "
+                f"steps push={push}/pull={max(iters - push, 0)} "
+                f"of {iters}")
+    degraded = jax.default_backend() not in _TPU_PLATFORMS
+    # mode correctness spot check rides the bench too: the three forced
+    # modes must answer bulk-check identically on this revision
+    with semiring.force_mode("pull"):
+        want = e.check_bulk(items, context=req_ctx)
+    for m in ("push", "auto"):
+        with semiring.force_mode(m):
+            assert e.check_bulk(items, context=req_ctx) == want, m
+    # Pallas-vs-lax on the forced-pull dense path: drop the cached
+    # per-mode run entry so each side re-traces under its gate state
+    d = cg._dev()
+
+    def fresh_pull_p50():
+        d.pop(("run", "pull"), None)
+        with semiring.force_mode("pull"):
+            list_once()  # compile
+            return p50(list_once)
+
+    pallas_engaged = bool(bitprop.dense_kernel_enabled())
+    lat_kernel = fresh_pull_p50()
+    features.set("SemiringDenseKernel", False)
+    try:
+        lat_lax = fresh_pull_p50()
+    finally:
+        features.reset()
+        d.pop(("run", "pull"), None)
+    pallas_delta = lat_lax / max(lat_kernel, 1e-9)
+    base = modes["pull"]
+    speedup_push = base["check_p50_ms"] / max(modes["push"]["check_p50_ms"],
+                                              1e-9)
+    speedup_auto = base["check_p50_ms"] / max(modes["auto"]["check_p50_ms"],
+                                              1e-9)
+    result["semiring"] = {
+        "backend": result.get("backend"),
+        "n_pods": n_pods,
+        "n_rels": total,
+        "caveated_share": share,
+        "bulk_checks": n_checks,
+        "crossover": float(getattr(cg, "spmm_crossover", 1.0)),
+        "modes": modes,
+        "dense_speedup_push_vs_pull": round(speedup_push, 3),
+        "dense_speedup_auto_vs_pull": round(speedup_auto, 3),
+        "pallas_engaged": pallas_engaged,
+        "pallas_list_p50_ms": round(lat_kernel, 3),
+        "lax_list_p50_ms": round(lat_lax, 3),
+        "pallas_over_lax": round(pallas_delta, 3),
+        "provenance": "[DEGRADED: cpu]" if degraded else "tpu",
+    }
+    log(f"semiring phase: {total} rels, dense-phase speedup "
+        f"push {speedup_push:.2f}x / auto {speedup_auto:.2f}x vs forced "
+        f"pull, pallas/lax {pallas_delta:.2f}x "
+        f"(kernel {'on' if pallas_engaged else 'off — lax both sides'})"
+        + (" [DEGRADED: cpu]" if degraded else ""))
 
 
 _SHARD_SCHEMA = """

@@ -175,6 +175,122 @@ def bit_or_matmul(a_bits: jax.Array, v_bits: jax.Array, n_b: int) -> jax.Array:
     return out[:, :n_b].astype(jnp.uint8)
 
 
+# ---------------------------------------------------------------------------
+# MXU-shaped dense kernel: the semiring PULL path (ops/semiring.py)
+# ---------------------------------------------------------------------------
+
+# the MXU systolic array is 128x128; the dense kernel's grid tiles both
+# block axes at exactly this, so every inner contraction is one MXU pass
+MXU_TILE = 128
+# int8 operands need sublane multiples of 32: batches are padded up to it
+SUBLANE = 32
+# frontier rows the dense kernel will pad/stream before the plain XLA
+# matmul (which tiles the batch itself) is the better schedule
+DENSE_B_MAX = 4096
+
+
+def dense_kernel_enabled() -> bool:
+    """Dense MXU Pallas kernel runs on TPU; tests force the interpreter
+    with SDBKP_SEMIRING=interpret (CPU default stays on dot_general).
+    The SemiringDenseKernel feature gate turns it off wholesale. Part of
+    the jit-cache key (reachability._jit_run_for) — flipping it never
+    reuses a stale trace."""
+    from ..utils.features import features
+
+    if not features.enabled("SemiringDenseKernel"):
+        return False
+    mode = os.environ.get("SDBKP_SEMIRING", "auto")
+    if mode == "0":
+        return False
+    if mode == "interpret":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _dense_interpret() -> bool:
+    return os.environ.get("SDBKP_SEMIRING") == "interpret" \
+        or jax.default_backend() != "tpu"
+
+
+def _dense_vmem_bytes(b32: int, n_dst: int) -> int:
+    # double-buffered A tile + frontier tile + int32 out tile resident
+    # per grid step
+    return (2 * MXU_TILE * MXU_TILE + b32 * MXU_TILE
+            + 4 * b32 * MXU_TILE)
+
+
+def dense_eligible(n_dst: int, n_src: int, batch: int) -> bool:
+    """Both block axes must be MXU-tile multiples (slot ranges are
+    LANE=128-aligned by construction, so full blocks always qualify;
+    sharded src chunks qualify when the per-device chunk stays
+    tile-aligned) and the padded batch tile must fit VMEM."""
+    if n_dst % MXU_TILE or n_src % MXU_TILE:
+        return False
+    if batch > DENSE_B_MAX:
+        return False
+    b32 = -(-batch // SUBLANE) * SUBLANE
+    return _dense_vmem_bytes(b32, n_dst) <= VMEM_BUDGET
+
+
+def _dense_kernel(f_ref, a_ref, out_ref):
+    """One (dst-tile, src-tile) grid step of the masked boolean matmul:
+    ``out[b, d] |= OR_s f[b, s] & a[d, s]`` via an int8 MXU contraction.
+    The out tile is revisited across the src-tile grid axis (zeroed at
+    the first step) — the standard Pallas accumulation pattern; the
+    frontier-tile emptiness predicate skips the matmul for all-zero
+    frontier chunks, the push-flavored work skip that makes the pull
+    kernel cheap on sparse iterations too."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(jnp.any(f_ref[:] != 0))
+    def _accum():
+        part = jax.lax.dot_general(
+            f_ref[:], a_ref[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)  # [b32, MXU_TILE]
+        out_ref[:] = out_ref[:] | (part > 0).astype(jnp.int32)
+
+
+def dense_or_matmul(A: jax.Array, frontier: jax.Array) -> jax.Array:
+    """Masked boolean-semiring block hop on the MXU: ``A [n_dst, n_src]``
+    int8, ``frontier [B, n_src]`` uint8 -> reached ``[B, n_dst]`` uint8.
+    Grid = (dst tiles, src tiles), every tile exactly MXU-shaped;
+    eligibility is the caller's job (:func:`dense_eligible`)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_dst, n_src = A.shape
+    b = frontier.shape[0]
+    b32 = -(-b // SUBLANE) * SUBLANE
+    f = jnp.zeros((b32, n_src), dtype=jnp.int8)
+    f = jax.lax.dynamic_update_slice(f, frontier.astype(jnp.int8), (0, 0))
+    out = pl.pallas_call(
+        _dense_kernel,
+        grid=(n_dst // MXU_TILE, n_src // MXU_TILE),
+        in_specs=[
+            pl.BlockSpec((b32, MXU_TILE), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((MXU_TILE, MXU_TILE), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b32, MXU_TILE), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b32, n_dst), jnp.int32),
+        interpret=_dense_interpret(),
+    )(f, A)
+    return (out[:b] > 0).astype(jnp.uint8)
+
+
+def dense_hop_reference(A: np.ndarray, frontier: np.ndarray) -> np.ndarray:
+    """Pure-numpy oracle of one dense block hop (tests)."""
+    return ((frontier.astype(np.int64) @ A.astype(np.int64).T) > 0
+            ).astype(np.uint8)
+
+
 def bit_hop_reference(a_bits: np.ndarray, frontier: np.ndarray) -> np.ndarray:
     """Pure-numpy oracle of one packed hop (tests)."""
     n_dst, k = a_bits.shape

@@ -56,7 +56,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import bitprop
+from . import bitprop, semiring
 from .. import native
 from ..utils.metrics import metrics
 from ..models.schema import (
@@ -129,8 +129,13 @@ def _jit_run_for(cg: "CompiledGraph"):
     The closure captures a slim static-metadata view, NOT the graph: a
     captured CompiledGraph would pin its host edge arrays and _device HBM
     buffers for as long as the cache entry lives — a dead-revision memory
-    leak proportional to graph size x cached signatures."""
-    sig = (cg.signature(), bitprop.kernel_enabled())
+    leak proportional to graph size x cached signatures.
+
+    Kernel/mode toggles that are baked into traces (bit kernel, dense
+    Pallas kernel, forced semiring mode) discriminate the key — flipping
+    one mid-process gets a fresh trace, never a stale one."""
+    sig = (cg.signature(), bitprop.kernel_enabled(),
+           bitprop.dense_kernel_enabled(), semiring.resolved_mode())
     with _DEV_INIT_LOCK:
         run = _JIT_CACHE.get(sig)
         if run is None:
@@ -374,6 +379,10 @@ class RunMeta:
     # edge activation is the expiration mask alone)
     caveats: tuple = ()
     cav_rows: int = 1
+    # semiring propagation-mode policy baked into the trace: "auto" =
+    # per-iteration lax.cond on traced occupancy; "push"/"pull" force one
+    # branch (ops/semiring.py force_mode / SDBKP_SEMIRING_MODE)
+    spmm_mode: str = "auto"
 
 
 def convergence_fuse_steps(meta: "RunMeta") -> int:
@@ -467,6 +476,12 @@ class CompiledGraph:
     relperm_off: Optional[np.ndarray] = None
     # (resource tid, tupleset rel id, term slot offset, tgt_off[n_types+1])
     arrow_maps: list = field(default_factory=list)
+    # push/pull crossover threshold fed to the semiring primitive as a
+    # TRACED scalar (ops/semiring.propagate): push while the traced
+    # per-iteration occupancy is <= this. Mutated in place by the engine
+    # from its frontier-occupancy EWMA
+    # (semiring.crossover_from_occupancy) — tuning costs zero recompiles.
+    spmm_crossover: float = 1.0
     # lazily-populated device state
     _device: dict = field(default_factory=dict)
 
@@ -624,6 +639,7 @@ class CompiledGraph:
             level_ranges=tuple(level_ranges),
             caveats=cav.metas if cav is not None else (),
             cav_rows=cav.n_rows if cav is not None else 1,
+            spmm_mode=semiring.resolved_mode(),
         )
 
     def _dev(self):
@@ -711,9 +727,11 @@ class CompiledGraph:
                 bits_dev.append(None)
         d["blocks"] = tuple(blocks_dev)
         d["blocks_bits"] = tuple(bits_dev)
-        # the bit-kernel toggle is baked into traces, so it is part of
-        # the shared-function cache key
-        d["run"] = _jit_run_for(self)
+        # kernel/mode toggles are baked into traces, so they are part of
+        # the shared-function cache key; query_async keeps a per-mode
+        # entry so a force_mode() flip (bench baseline knob) cannot
+        # dispatch through a stale trace
+        d[("run", semiring.resolved_mode())] = _jit_run_for(self)
         return d
 
     def _dead_cells(self, bm: _BlockMeta) -> tuple[np.ndarray, np.ndarray]:
@@ -856,16 +874,24 @@ class CompiledGraph:
         # named span in jax.profiler traces (bench --profile-dir / any
         # caller-managed jax.profiler.trace): lets a device timeline
         # attribute time to the reachability dispatch specifically
+        # per-mode jitted entry (force_mode flips between dispatches must
+        # hit their own trace); built lazily under the shared cache lock
+        mk = semiring.resolved_mode()
+        run = d.get(("run", mk))
+        if run is None:
+            run = _jit_run_for(self)
+            d[("run", mk)] = run
         with jax.profiler.TraceAnnotation("sdbkp:fixpoint"):
             # seeds ride the jit call as a host array: jax folds the
             # transfer into the dispatch instead of a separate device_put
             # round trip (visible through remotely-attached chips)
-            out, converged, iters, cav_missing = d["run"](
+            out, converged, iters, n_push, cav_missing = run(
                 d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
                 d["cav"], d["dsrc"], d["ddst"], d["dexp"], d["dcav"],
                 d["cav_static"], cav_req,
                 seeds, qs_dev, qb_dev,
-                now_rel, max_iters=max_iters, **run_kwargs,
+                now_rel, np.float32(self.spmm_crossover),
+                max_iters=max_iters, **run_kwargs,
             )
         try:
             out.copy_to_host_async()
@@ -875,11 +901,12 @@ class CompiledGraph:
             # synchronous device roundtrip per query (a full tunnel RTT on
             # remotely-attached chips)
             iters.copy_to_host_async()
+            n_push.copy_to_host_async()
             cav_missing.copy_to_host_async()
         except AttributeError:  # non-jax array backends in tests
             pass
         return QueryFuture(out, converged, iters, Q, max_iters,
-                           cav_missing)
+                           cav_missing, n_push)
 
     def query(
         self,
@@ -901,20 +928,54 @@ class CompiledGraph:
         ``tail_once`` is the one-shot cost of all acyclic levels. Streams
         counted: residual gather/segment, dense-block operands (bit-packed
         or int8 A), elementwise program passes. An estimate of bytes
-        *touched* — XLA fusion can only reduce it."""
+        *touched* — XLA fusion can only reduce it.
+
+        ``modes`` reports the core dense-block bytes PER SEMIRING MODE
+        (ops/semiring.py) so collective-bytes baselines (ROADMAP item 1)
+        can be stated per branch instead of assuming one layout:
+        ``push`` streams each block's bit-packed dual (its eligible
+        blocks) or the full int8 A where no dual exists; ``pull`` always
+        streams the full int8 A; ``pallas`` adds the MXU kernel's
+        frontier re-stream (the [b32, n_src] operand is re-read once per
+        dst-tile row of the grid). ``blocks``/``total`` keep reporting
+        the mode the CURRENT configuration would run (bits when the bit
+        kernel is live and the batch fits, else dense)."""
         rows = self.M // LANE + 1
         Mp = rows * LANE
 
         def res_bytes(n):  # src+dst int32 + valid uint8 + B gathered
             return n * (4 + 4 + 1 + batch) + batch * Mp
 
+        def bits_bytes(b):
+            k0 = (b.n_src + 31) // 32
+            k_pad = -(-k0 // bitprop.LANES) * bitprop.LANES
+            return b.n_dst * k_pad * 4
+
+        def push_bytes(b):
+            # bit-packed dual when one exists for this batch, else the
+            # push pass degrades to the dense pull stream for the block
+            if batch <= bitprop.BIT_B_MAX and bitprop.eligible(
+                    b.n_dst, b.n_src):
+                return bits_bytes(b)
+            return b.n_dst * b.n_src
+
+        def pull_bytes(b):
+            return b.n_dst * b.n_src
+
+        def pallas_bytes(b):
+            # dense MXU kernel: A streamed once + the padded frontier
+            # tile re-streamed per dst-tile grid row
+            if not bitprop.dense_eligible(b.n_dst, b.n_src, batch):
+                return pull_bytes(b)
+            b32 = -(-batch // bitprop.SUBLANE) * bitprop.SUBLANE
+            return b.n_dst * b.n_src \
+                + b32 * b.n_src * (b.n_dst // bitprop.MXU_TILE)
+
         def block_bytes(b):
             use_bits = (batch <= bitprop.BIT_B_MAX
                         and bitprop.kernel_enabled())
             if use_bits and bitprop.eligible(b.n_dst, b.n_src):
-                k0 = (b.n_src + 31) // 32
-                k_pad = -(-k0 // bitprop.LANES) * bitprop.LANES
-                return b.n_dst * k_pad * 4
+                return bits_bytes(b)
             return b.n_dst * b.n_src
 
         bounds = self.res_level_bounds
@@ -927,8 +988,8 @@ class CompiledGraph:
             tail_res = bounds[-1] - bounds[1]
         delta = self._delta_pad() * (4 + 4 + 1 + batch)
         core_res = res_bytes(n_core) + delta
-        core_blocks = sum(block_bytes(b) for b in self.blocks
-                          if b.level == 0)
+        core_blk = [b for b in self.blocks if b.level == 0]
+        core_blocks = sum(block_bytes(b) for b in core_blk)
         core_prog = sum(2 * p.size * batch for p in self.programs
                         if p.level == 0)
         tail = (res_bytes(tail_res) if tail_res else 0) \
@@ -937,7 +998,12 @@ class CompiledGraph:
             + self.n_levels * (delta + 2 * batch * Mp)  # merges + delta
         return {"residual": core_res, "blocks": core_blocks,
                 "programs": core_prog, "tail_once": tail,
-                "total": core_res + core_blocks + core_prog}
+                "total": core_res + core_blocks + core_prog,
+                "modes": {
+                    "push": sum(push_bytes(b) for b in core_blk),
+                    "pull": sum(pull_bytes(b) for b in core_blk),
+                    "pallas": sum(pallas_bytes(b) for b in core_blk),
+                }}
 
 
 @dataclass
@@ -948,7 +1014,10 @@ class QueryFuture:
     dispatch depth, exported to the metrics registry by the engine.
     ``caveats_missing()`` is the number of caveat instances that resolved
     to the missing-context tri-state this dispatch (denied fail-closed;
-    feeds ``engine_caveat_denied_missing_context_total``)."""
+    feeds ``engine_caveat_denied_missing_context_total``).
+    ``push_steps()`` is how many of those hops took the semiring PUSH
+    branch (ops/semiring.py; the rest took pull) — the per-iteration
+    mode telemetry behind ``engine_semiring_push_steps_total``."""
 
     _out: object
     _converged: object
@@ -956,6 +1025,7 @@ class QueryFuture:
     _q: int
     _max_iters: int
     _cav_missing: object = None
+    _push: object = None
 
     def result(self) -> np.ndarray:
         if not bool(self._converged):
@@ -967,6 +1037,9 @@ class QueryFuture:
 
     def iterations(self) -> int:
         return int(self._iters)
+
+    def push_steps(self) -> int:
+        return 0 if self._push is None else int(self._push)
 
     def caveats_missing(self) -> int:
         return 0 if self._cav_missing is None else int(self._cav_missing)
@@ -1006,66 +1079,6 @@ def _apply_program(cg: CompiledGraph, V, programs=None):
     return V
 
 
-def _propagate(cg, blocks, blocks_bits, src, dst, valid,
-               dsrc, ddst, dvalid, V, level: Optional[int] = None):
-    """One hop restricted to one stratification level (None = all): dense
-    relation blocks as MXU matmuls (large batch) or bit-packed VPU
-    contractions (small batch), plus residual edges as a
-    gather/segment-max, plus the (small) incremental delta segment as a
-    second gather/segment-max. The residual args must already be the
-    level's slice; blocks are filtered here by their level. V is
-    [B, rows, LANE]; returns prop in the flat [B, rows*LANE] view (caller
-    reshapes)."""
-    B = V.shape[0]
-    Mp = V.shape[1] * LANE  # M + trash row
-    Vflat = V.reshape(B, Mp)
-    # residual (expiring / sparse / tiny) edges: gather + segment-max over
-    # the slot axis (edge arrays index flat slots; trash padding lands in
-    # the trash row)
-    if src.shape[0]:
-        gathered = (Vflat[:, src] & valid[None, :]).T  # [E_slice, B]
-        prop = jax.ops.segment_max(
-            gathered, dst, num_segments=Mp, indices_are_sorted=True
-        ).T  # [B, Mp]
-    else:
-        prop = jnp.zeros((B, Mp), dtype=jnp.uint8)
-    # delta overlay segment: edges appended by incremental updates since
-    # the last full compile, in APPEND order (slots are assigned once and
-    # updated in place, so no sort exists to exploit). Applied at EVERY
-    # level — contributions outside the level's ranges are masked off by
-    # the caller's merge, so correctness holds at O(capacity) cost per
-    # phase.
-    gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T  # [D_pad, B]
-    prop = prop | jax.ops.segment_max(
-        gathered_d, ddst, num_segments=Mp, indices_are_sorted=False
-    ).T
-    # B is static under trace, so the representation choice is baked into
-    # the compiled program: bit kernel streams 8x less HBM per hop at
-    # B<=BIT_B_MAX; the MXU matmul amortizes A across large batches
-    use_bits = B <= bitprop.BIT_B_MAX and bitprop.kernel_enabled()
-    for bm, A, Abits in zip(cg.blocks, blocks, blocks_bits):
-        if level is not None and bm.level != level:
-            continue
-        frontier = jax.lax.dynamic_slice(
-            Vflat, (0, bm.src_off), (B, bm.n_src)
-        )  # [B, n_src]
-        if use_bits and Abits is not None:
-            vb = bitprop.pack_frontier(frontier, bm.n_src)
-            contrib = bitprop.bit_or_matmul(Abits, vb, B).T  # [B, n_dst]
-        else:
-            contrib = (
-                jax.lax.dot_general(
-                    frontier.astype(jnp.int8), A,
-                    dimension_numbers=(((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.int32) > 0
-            ).astype(jnp.uint8)  # [B, n_dst]
-        cur = jax.lax.dynamic_slice(prop, (0, bm.dst_off), (B, bm.n_dst))
-        prop = jax.lax.dynamic_update_slice(
-            prop, cur | contrib, (0, bm.dst_off)
-        )
-    return prop
-
-
 def _seed_base(cg: CompiledGraph, seeds):
     """Seed the [B, rows, LANE] state from subject/wildcard slot pairs and
     run the permission programs once. The single source of the layout
@@ -1085,7 +1098,7 @@ def _seed_base(cg: CompiledGraph, seeds):
 
 def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel, cav,
          dsrc, ddst, dexp, dcav, cav_static, cav_req,
-         seeds, q_slots, q_batch, now_rel, *,
+         seeds, q_slots, q_batch, now_rel, crossover, *,
          max_iters: int, q_contig_len: int = 0, q_contig_rows: int = 1):
     """The jitted stratified fixpoint. V layout: [B, rows, LANE] uint8 —
     the slot space rides the lane axis so a B=1 query streams exactly M
@@ -1098,27 +1111,32 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel, cav,
     sources are already final. In kube-shaped graphs this keeps the
     dominant per-pod blocks out of the loop entirely.
 
-    Conditional grants: when the graph carries caveat instances
-    (cg.cav_rows > 1), the caveat VM evaluates every instance's
-    tri-state ONCE up front (contexts don't change within a dispatch)
-    and edge activation becomes ``expiration ∧ cav_ok[edge_row]`` for
-    base-residual and overlay edges alike — caveated edges never enter
-    dense blocks (compile_graph routes them residual, like expiring
-    edges), so the mask composes with the existing validity plumbing."""
+    Every hop is ONE call into the masked-semiring primitive
+    (ops/semiring.propagate) — the same primitive the shard_map body
+    uses — with the ``(exp > now) ∧ cav_ok[row]`` edge-activation mask
+    computed exactly once per dispatch (semiring.edge_activation) and
+    fused into the multiply. The caveat VM evaluates every instance's
+    tri-state once up front when the graph carries caveat instances
+    (cg.cav_rows > 1); caveated edges never enter dense blocks
+    (compile_graph routes them residual, like expiring edges).
+    ``crossover`` is the traced push/pull threshold (CompiledGraph
+    .spmm_crossover): the per-iteration mode branch is a lax.cond on
+    traced occupancy, so neither tuning nor the runtime flip
+    re-specializes."""
     B = seeds.shape[0]
     rows = cg.M // LANE + 1  # + trash row (slots M .. M+LANE-1)
     Mp = rows * LANE
-    valid = (exp_rel > now_rel).astype(jnp.uint8)  # [E_res]
-    dvalid = (dexp > now_rel).astype(jnp.uint8)  # [D_pad]
     if cg.cav_rows > 1:
         from ..caveats.vm import eval_caveats
 
         cav_ok, cav_missing = eval_caveats(
             cg.caveats, cav_static, cav_req, cg.cav_rows)
-        valid = valid & cav_ok[cav]
-        dvalid = dvalid & cav_ok[dcav]
     else:
+        cav_ok = None
         cav_missing = jnp.int32(0)
+    # fused edge activation, once per dispatch (not per hop/level)
+    act = semiring.edge_activation(exp_rel, now_rel, cav, cav_ok)
+    dact = semiring.edge_activation(dexp, now_rel, dcav, cav_ok)
     base = _seed_base(cg, seeds)
     baseflat = base.reshape(B, Mp)
     bounds = cg.res_level_bounds
@@ -1126,26 +1144,33 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel, cav,
 
     def level_slice(k):
         lo, hi = bounds[k], bounds[k + 1]
-        return src[lo:hi], dst[lo:hi], valid[lo:hi]
+        return src[lo:hi], dst[lo:hi], act[lo:hi]
+
+    def prop_level(V, k):
+        Vflat = V.reshape(B, Mp)
+        s, d, a = level_slice(k)
+        occ = semiring.frontier_occupancy(Vflat)
+        return semiring.propagate(
+            cg.blocks, blocks, blocks_bits, s, d, a,
+            dsrc, ddst, dact, Vflat, occ, crossover,
+            level=k, mode=cg.spmm_mode)
 
     def step(V):
-        s, d, v = level_slice(0)
-        prop = _propagate(cg, blocks, blocks_bits, s, d, v,
-                          dsrc, ddst, dvalid, V, level=0)
+        prop, is_push = prop_level(V, 0)
         return _apply_program(
-            cg, prop.reshape(B, rows, LANE) | base, core_progs)
+            cg, prop.reshape(B, rows, LANE) | base, core_progs), is_push
 
     def cond(state):
-        V, prev_changed, it = state
+        V, prev_changed, it, _ = state
         return prev_changed & (it < max_iters)
 
     def body(state):
-        V, _, it = state
-        V2 = step(V)
-        return V2, jnp.any(V2 != V), it + 1
+        V, _, it, n_push = state
+        V2, is_push = step(V)
+        return V2, jnp.any(V2 != V), it + 1, n_push + is_push
 
-    V, still_changing, iters = jax.lax.while_loop(
-        cond, body, (base, jnp.bool_(True), 0))
+    V, still_changing, iters, n_push = jax.lax.while_loop(
+        cond, body, (base, jnp.bool_(True), 0, jnp.int32(0)))
     # acyclic levels: one application each. No phase may be skipped —
     # incremental delta edges can target any level and only this phase's
     # re-application establishes their values. The merge writes only the
@@ -1153,9 +1178,8 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel, cav,
     # untouched and no dense masks exist anywhere.
     for k in range(1, cg.n_levels + 1):
         progs_k = [p for p in cg.programs if p.level == k]
-        s, d, v = level_slice(k)
-        prop = _propagate(cg, blocks, blocks_bits, s, d, v,
-                          dsrc, ddst, dvalid, V, level=k)
+        prop, is_push = prop_level(V, k)
+        n_push = n_push + is_push
         propb = prop | baseflat
         Vflat = V.reshape(B, Mp)
         for off, size in cg.level_ranges[k - 1]:
@@ -1181,7 +1205,7 @@ def _run(cg: "RunMeta", blocks, blocks_bits, src, dst, exp_rel, cav,
         ).reshape(q_contig_rows * q_contig_len).astype(jnp.bool_)
     else:
         out = V.reshape(B, Mp)[q_batch, q_slots].astype(jnp.bool_)
-    return out, jnp.logical_not(still_changing), iters, cav_missing
+    return out, jnp.logical_not(still_changing), iters, n_push, cav_missing
 
 
 # ---------------------------------------------------------------------------

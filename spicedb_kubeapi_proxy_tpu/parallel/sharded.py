@@ -28,18 +28,30 @@ and runs the same fixpoint over a ``("data", "graph")`` mesh:
   the mesh for the single-device path.
 
 K-step fused convergence: the while body applies K propagation steps and
-compares state only across the whole block, so the convergence
-collective-OR (and the host-side while-condition sync it implies) fires
-``ceil(iters / K)`` times instead of ``iters`` times. K derives from the
-compiled graph's stratification
+pays ONE convergence collective per block — a pmax of the K per-step
+local change flags (one [K] int32 vector, the same collective count as
+the old single scalar) — so the collective-OR (and the host-side
+while-condition sync it implies) fires ``ceil(iters / K)`` times instead
+of ``iters`` times. K derives from the compiled graph's stratification
 (:func:`~...ops.reachability.convergence_fuse_steps`): stratified graphs
 iterate only their small cyclic core, unstratified ones fuse more. The
 iteration is monotone, so steps past the fixpoint are no-ops — fusing
 trades at most K-1 wasted cheap hops for the saved collectives — and the
 loop carry buffers are donated/double-buffered by the ``while_loop``
-lowering (no fresh HBM per block). ``iterations()`` reports the step
-budget consumed (a multiple of K, >= the true iteration count);
-``conv_checks()`` reports the convergence collectives actually paid.
+lowering (no fresh HBM per block). Because the flags are per step,
+``iterations()`` reports the ACTUAL converged-at step (the number of
+steps that changed anything — no longer quantized to K, so the engine's
+occupancy/crossover telemetry sees true depths); ``conv_checks()``
+reports the convergence collectives actually paid.
+
+Propagation itself is one call per level into the masked-semiring
+primitive (ops/semiring.propagate) — the SAME primitive the
+single-device fixpoint uses, with the ``(exp > now) ∧ cav_ok[row]``
+edge-activation mask hoisted to once per dispatch (= once per K-step
+fused window) — followed by the pmax partial-product join over ICI. The
+join stays OUTSIDE the primitive's push/pull ``lax.cond`` branches:
+devices whose data shards diverge on the traced occupancy branch must
+never meet a collective inside one branch.
 
 The query surface is both a *grid* (``B`` subjects x ``Q`` result slots
 per subject — bulk checks and concurrent list prefilters, BASELINE config
@@ -75,6 +87,7 @@ try:  # jax>=0.4.35 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from ..ops import semiring
 from ..ops.reachability import (
     CompiledGraph,
     ConvergenceError,
@@ -89,7 +102,7 @@ from ..ops.reachability import (
 
 def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
                  dsrc, ddst, dexp, dcav, cav_static, cav_req,
-                 seeds, q_slots, now_rel, *,
+                 seeds, q_slots, now_rel, crossover, *,
                  max_iters: int, k_steps: int):
     """Per-device body (inside shard_map). Shapes are the LOCAL shards:
     level_edges[k] = (src, dst, exp, cav) [E_k/ng] (per stratification
@@ -102,11 +115,18 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
     the CompiledGraph — the closure must not pin host/device graph
     state).
 
-    Same stratified schedule as the single-chip _run: only the cyclic
-    core (level 0) iterates; each acyclic level is applied once, partial
-    propagations joined with pmax over ICI before the merge. The while
-    body fuses ``k_steps`` propagation steps per convergence
-    collective."""
+    Same stratified schedule as the single-chip _run, through the SAME
+    masked-semiring primitive (ops/semiring.propagate, with
+    ``shard=(g_idx, ng)`` so block frontiers cover only this chip's
+    src-axis chunk): only the cyclic core (level 0) iterates; each
+    acyclic level is applied once; partial propagations are joined with
+    pmax over ICI AFTER the primitive returns (outside its push/pull
+    lax.cond — a collective inside a branch devices may disagree on
+    would deadlock). Edge activation (expiration ∧ caveat verdict) is
+    hoisted to once per dispatch — once per K-step fused window, not
+    once per hop. The while body fuses ``k_steps`` propagation steps
+    per convergence collective, pmaxing the K per-step change flags as
+    one vector so the true converged-at step survives fusing."""
     B = seeds.shape[0]
     rows = meta.M // LANE + 1  # + trash row
     Mp = rows * LANE
@@ -120,9 +140,14 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
     else:
         cav_ok = None
         cav_missing = jnp.int32(0)
-    dvalid = (dexp > now_rel).astype(jnp.uint8)
-    if cav_ok is not None:
-        dvalid = dvalid & cav_ok[dcav]
+    # fused edge activation, computed exactly once per dispatch for the
+    # delta overlay and every level's edge shard (this chip's cav-row
+    # shard rides with its edges)
+    dact = semiring.edge_activation(dexp, now_rel, dcav, cav_ok)
+    acts = tuple(
+        semiring.edge_activation(exp_rel, now_rel, cav, cav_ok)
+        for _, _, exp_rel, cav in level_edges)
+    no_bits = tuple(None for _ in block_meta)  # mesh blocks: matmul/Pallas
     brange = jnp.arange(B, dtype=jnp.int32)
     base = _seed_base(meta, seeds)
     baseflat = base.reshape(B, Mp)
@@ -130,75 +155,58 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
 
     def prop_level(V, k):
         Vflat = V.reshape(B, Mp)
-        src, dst, exp_rel, cav = level_edges[k]
-        valid = (exp_rel > now_rel).astype(jnp.uint8)
-        if cav_ok is not None:
-            # edge activation = expiration ∧ caveat verdict, evaluated
-            # against THIS chip's cav-row shard (rides with the edges)
-            valid = valid & cav_ok[cav]
-        gathered = (Vflat[:, src] & valid[None, :]).T  # [E_local, B]
-        prop = jax.ops.segment_max(
-            gathered, dst, num_segments=Mp, indices_are_sorted=True
-        ).T  # [B, Mp] — this chip's partial
-        # incremental delta overlay: applied at every phase (append
-        # order — NOT dst-sorted); off-level contributions are dropped
-        # by the caller's range-scoped merge
-        gathered_d = (Vflat[:, dsrc] & dvalid[None, :]).T
-        prop = prop | jax.ops.segment_max(
-            gathered_d, ddst, num_segments=Mp, indices_are_sorted=False
-        ).T
-        # dense blocks of this level: this chip contracts its src-axis
-        # chunk of A against the matching frontier columns
-        for bm, A in zip(block_meta, blocks):
-            if bm.level != k:
-                continue
-            chunk = bm.n_src // ng
-            frontier = jax.lax.dynamic_slice(
-                Vflat, (0, bm.src_off + g_idx * chunk), (B, chunk))
-            contrib = (
-                jax.lax.dot_general(
-                    frontier.astype(jnp.int8), A,
-                    dimension_numbers=(((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.int32) > 0
-            ).astype(jnp.uint8)  # [B, n_dst]
-            cur = jax.lax.dynamic_slice(prop, (0, bm.dst_off), (B, bm.n_dst))
-            prop = jax.lax.dynamic_update_slice(
-                prop, cur | contrib, (0, bm.dst_off))
-        return jax.lax.pmax(prop, "graph")  # join partials over ICI
+        src, dst = level_edges[k][0], level_edges[k][1]
+        occ = semiring.frontier_occupancy(Vflat)
+        prop, is_push = semiring.propagate(
+            block_meta, blocks, no_bits, src, dst, acts[k],
+            dsrc, ddst, dact, Vflat, occ, crossover,
+            level=k, mode=meta.spmm_mode, shard=(g_idx, ng))
+        # join partials over ICI — outside the primitive's mode cond
+        return jax.lax.pmax(prop, "graph"), is_push
 
     core_progs = [p for p in meta.programs if p.level == 0]
 
     def step(V):
-        prop = prop_level(V, 0)
+        prop, is_push = prop_level(V, 0)
         return _apply_program(
-            meta, prop.reshape(B, rows, LANE) | base, core_progs)
+            meta, prop.reshape(B, rows, LANE) | base, core_progs), is_push
 
     def cond(state):
-        _, prev_changed, it = state
+        _, prev_changed, it, _, _ = state
         return (prev_changed > 0) & (it < max_iters)
 
     def body(state):
-        V, _, it = state
+        V, _, it, checks, n_push = state
         # K-step fusing: k_steps hops per convergence collective. The
-        # fixpoint is monotone, so hops past convergence are no-ops and
-        # comparing across the whole block is exact (changed == 0 iff
-        # every fused step was a no-op). Every chip must agree on the
-        # step count: OR over both axes, once per BLOCK instead of once
-        # per hop.
+        # fixpoint is monotone, so hops past convergence are no-ops.
+        # Each step's LOCAL change flag is recorded and the K flags are
+        # joined in ONE pmax (a [K] vector — same collective count as
+        # the old scalar): fl[k] == 0 iff step k changed nothing
+        # anywhere, so fl.sum() is the number of real steps this block
+        # ran (monotonicity makes the flags a 1*0* prefix pattern) and
+        # fl[-1] == 0 means the fixpoint is reached. Every chip sees
+        # identical fl, so all agree on the loop exit.
         Vk = V
+        flags = []
+        pushes = jnp.int32(0)
         for _ in range(k_steps):
-            Vk = step(Vk)
-        changed = jnp.any(Vk != V).astype(jnp.int32)
-        changed = jax.lax.pmax(changed, ("data", "graph"))
-        return Vk, changed, it + k_steps
+            V2, is_push = step(Vk)
+            flags.append(jnp.any(V2 != Vk).astype(jnp.int32))
+            pushes = pushes + is_push
+            Vk = V2
+        fl = jax.lax.pmax(jnp.stack(flags), ("data", "graph"))
+        return (Vk, fl[k_steps - 1], it + fl.sum(), checks + 1,
+                n_push + pushes)
 
-    V, still_changing, iters = jax.lax.while_loop(
-        cond, body, (base, jnp.int32(1), 0)
+    V, still_changing, iters, checks, n_push = jax.lax.while_loop(
+        cond, body, (base, jnp.int32(1), jnp.int32(0), jnp.int32(0),
+                     jnp.int32(0))
     )
     # acyclic levels: one application each (see ops/reachability._run)
     for k in range(1, meta.n_levels + 1):
         progs_k = [p for p in meta.programs if p.level == k]
-        prop = prop_level(V, k)
+        prop, is_push = prop_level(V, k)
+        n_push = n_push + is_push
         propb = prop | baseflat
         Vflat = V.reshape(B, Mp)
         for off, size in meta.level_ranges[k - 1]:
@@ -211,24 +219,33 @@ def _run_sharded(meta, block_meta, ng: int, level_edges, blocks,
     # addressable on EVERY process — under a multi-host mesh a
     # data-sharded output cannot be fetched by the serving process
     out = jax.lax.all_gather(out, "data", axis=0, tiled=True)
-    return out, (still_changing == 0), iters, cav_missing
+    # push counts may diverge per data shard (occupancy is local): join
+    # so the P() out_spec's replication promise holds
+    n_push = jax.lax.pmax(n_push, ("data", "graph"))
+    converged = still_changing == 0
+    # fl.sum() counts CHANGING hops; the single-chip loop also pays (and
+    # reports) exactly one confirming no-op hop when it converges — count
+    # it here too so both futures report the same converged-at step
+    iters = iters + converged.astype(jnp.int32)
+    return out, converged, iters, checks, n_push, cav_missing
 
 
 class ShardedQueryFuture:
     """A dispatched sharded query (grid or flat form). ``result()`` blocks
     and validates convergence; ``iterations()`` mirrors the single-chip
-    QueryFuture so the engine's metrics finalizers work unchanged (it
-    reports the step BUDGET consumed — a multiple of the K-step fuse
-    factor, >= the true iteration count); ``conv_checks()`` is the number
-    of convergence collectives actually paid; ``caveats_missing()`` the
-    missing-context instance count (fail-closed denials, counted by the
-    engine)."""
+    QueryFuture so the engine's metrics finalizers work unchanged — it
+    reports the ACTUAL converged-at step (per-step change flags survive
+    the K-step fuse), so occupancy/crossover telemetry is no longer
+    quantized to K; ``conv_checks()`` is the number of convergence
+    collectives actually paid; ``push_steps()`` how many core hops took
+    the semiring push branch; ``caveats_missing()`` the missing-context
+    instance count (fail-closed denials, counted by the engine)."""
 
     __slots__ = ("_out", "_converged", "_iters", "_sel", "_max_iters",
-                 "_cav_missing", "_k_steps")
+                 "_cav_missing", "_k_steps", "_checks", "_push")
 
     def __init__(self, out, converged, iters, sel, max_iters,
-                 cav_missing=None, k_steps=1):
+                 cav_missing=None, k_steps=1, checks=None, push=None):
         self._out = out
         self._converged = converged
         self._iters = iters
@@ -237,6 +254,8 @@ class ShardedQueryFuture:
         self._max_iters = max_iters
         self._cav_missing = cav_missing
         self._k_steps = max(int(k_steps), 1)
+        self._checks = checks
+        self._push = push
 
     def result(self) -> np.ndarray:
         if not bool(self._converged):
@@ -259,8 +278,16 @@ class ShardedQueryFuture:
 
     def conv_checks(self) -> int:
         """Convergence collective-ORs this query paid: one per K-step
-        block (``iterations() / K``), vs one per hop before fusing."""
-        return int(self._iters) // self._k_steps
+        block, vs one per hop before fusing. Counted directly by the
+        traced loop (``iterations()`` now reports true steps, so the
+        old ``iters / K`` reconstruction would under-count blocks whose
+        tail steps were no-ops)."""
+        if self._checks is not None:
+            return int(self._checks)
+        return -(-int(self._iters) // self._k_steps)
+
+    def push_steps(self) -> int:
+        return 0 if self._push is None else int(self._push)
 
     def caveats_missing(self) -> int:
         return 0 if self._cav_missing is None else int(self._cav_missing)
@@ -366,9 +393,9 @@ class ShardedGraph:
                 tuple(P(None, "graph") for _ in kept),
                 P("graph"), P("graph"), P("graph"), P("graph"),
                 P(), P(),
-                P("data", None), P("data", None), P(),
+                P("data", None), P("data", None), P(), P(),
             ),
-            out_specs=(P(None, None), P(), P(), P()),
+            out_specs=(P(None, None), P(), P(), P(), P(), P()),
         )
         try:
             smapped = shard_map(fn, check_vma=False, **smap_kw)
@@ -730,20 +757,23 @@ class ShardedGraph:
         # in_specs, which works identically whether the mesh spans one
         # process or many (a committed local array would need a reshard
         # from a non-global placement under multi-controller)
-        out, converged, iters, cav_missing = self._run(
+        crossover = np.float32(getattr(self.cg, "spmm_crossover", 1.0))
+        out, converged, iters, checks, n_push, cav_missing = self._run(
             self._level_edges, self._blocks,
             self._dsrc, self._ddst, self._dexp, self._dcav,
             self._cav_static, cav_req,
-            seeds_pad, grid, now_rel,
+            seeds_pad, grid, now_rel, crossover,
         )
         try:
             out.copy_to_host_async()
             converged.copy_to_host_async()
             iters.copy_to_host_async()
+            checks.copy_to_host_async()
+            n_push.copy_to_host_async()
             cav_missing.copy_to_host_async()
         except AttributeError:  # non-jax backends in tests
             pass
-        return out, converged, iters, cav_missing
+        return out, converged, iters, checks, n_push, cav_missing
 
     def _request_arrays(self, context: Optional[dict],
                         cav_req: Optional[tuple], now_abs: float) -> tuple:
@@ -781,10 +811,11 @@ class ShardedGraph:
         qs = np.full((B_pad, Q_pad), cg.M, dtype=np.int32)
         qs[:B, :Q] = q_slots
         now_abs = time.time() if now is None else now
-        out, converged, iters, cav_missing = self._dispatch(
+        out, converged, iters, checks, n_push, cav_missing = self._dispatch(
             seeds, qs, now_abs, self._request_arrays(context, None, now_abs))
         fut = ShardedQueryFuture(out, converged, iters, None,
-                                 self.max_iters, cav_missing, self.k_steps)
+                                 self.max_iters, cav_missing, self.k_steps,
+                                 checks=checks, push=n_push)
         return fut.result()[:B, :Q]
 
     def query_async(
@@ -870,7 +901,7 @@ class ShardedGraph:
                     self._qgrid.pop(next(iter(self._qgrid)), None)
                 self._qgrid[(q_cache_key, B_pad)] = grid
         now_abs = time.time() if now is None else now
-        out, converged, iters, cav_missing = self._dispatch(
+        out, converged, iters, checks, n_push, cav_missing = self._dispatch(
             seeds, grid, now_abs,
             self._request_arrays(context, cav_req, now_abs))
         sel = (("contig_grid", L, R) if contig is not None
@@ -878,4 +909,5 @@ class ShardedGraph:
         return ShardedQueryFuture(out, converged, iters, sel,
                                   max_iters=self.max_iters,
                                   cav_missing=cav_missing,
-                                  k_steps=self.k_steps)
+                                  k_steps=self.k_steps,
+                                  checks=checks, push=n_push)

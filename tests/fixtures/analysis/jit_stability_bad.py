@@ -51,6 +51,18 @@ def build_mesh_b(meta):
     return jax.jit(smapped)
 
 
+def _occ_kernel(meta, v, crossover):
+    occ = v.astype("float32").mean()  # derived from traced v
+    frac = occ / meta.span
+    if frac <= crossover:  # finding: Python branch on a DERIVED traced
+        v = v + 1  # value — the push/pull switch baked into the trace
+    return v
+
+
+def build_occ(meta):
+    return jax.jit(partial(_occ_kernel, meta))
+
+
 _lock = threading.Lock()
 
 

@@ -42,6 +42,25 @@ def build_mesh(meta):
     return jax.jit(smapped)
 
 
+def _occ_kernel(meta, v, crossover):
+    # the ISSUE 17 shape: the per-iteration push/pull switch on traced
+    # occupancy IS a lax.cond — the derived value never drives Python
+    occ = jnp.mean(v.astype(jnp.float32))
+    is_push = occ <= crossover
+    v = lax.cond(is_push, lambda x: x + 1, lambda x: x * 2, v)
+    if v.shape[0] > 4:  # static-shape extraction: no taint
+        v = v[:4]
+    span = len(meta.programs)  # len() on a derived tuple: still static
+    extra = None if span < 2 else occ
+    if extra is None:  # identity guard on a derived name: stable under
+        return v  # trace, allowed
+    return v + extra
+
+
+def build_occ(meta):
+    return jax.jit(partial(_occ_kernel, meta))
+
+
 _lock = threading.Lock()
 
 

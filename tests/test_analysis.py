@@ -101,7 +101,12 @@ def test_jit_stability_fires_on_bad():
     # scope-aware resolution: a SECOND function reusing the same local
     # names (fn/smapped) must still have ITS kernel checked
     assert "py-range-m" in toks
-    assert len(fs) == 8
+    # taint propagation (ISSUE 17): a Python branch on a value DERIVED
+    # from a traced arg (occ = mean(v); if occ <= ...) is a finding —
+    # the semiring push/pull switch must stay a lax.cond
+    assert "py-branch-derived-frac" in toks
+    assert "py-branch-crossover" in toks
+    assert len(fs) == 10
 
 
 def test_jit_stability_quiet_on_good():

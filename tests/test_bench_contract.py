@@ -67,6 +67,7 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     assert stages["device"]["p50_ms"] is not None
     _assert_caveat_schema(out["caveats"])
     _assert_mesh_schema(out["mesh"])
+    _assert_semiring_schema(out["semiring"])
     _assert_shard_schema(out["shard"])
     _assert_rebalance_schema(out["rebalance"])
     _assert_macro_schema(out["macro"])
@@ -107,6 +108,45 @@ def _assert_mesh_schema(mesh: dict) -> None:
         assert 1 <= checks <= -(-base // k) + 1, (c, checks, base, k)
         assert pt["churn_recompiles"] == 0
         assert pt["churn_sharded_updates"] >= 1
+
+
+def _assert_semiring_schema(sem: dict) -> None:
+    """The ISSUE 17 semiring contract: all three forced modes of the one
+    SpMM primitive are measured at the SAME revision (the force-mode knob
+    is the baseline, not a second checkout), the per-iteration push-vs-
+    pull choices are recorded per mode, the dense-phase speedups are
+    relative to the forced-pull baseline, the Pallas-vs-lax point is
+    present, and a CPU host carries the degraded provenance instead of a
+    fabricated MXU number."""
+    assert sem["n_pods"] >= 1 and sem["n_rels"] >= 1
+    assert 0.0 < sem["caveated_share"] < 1.0
+    assert sem["bulk_checks"] >= 1
+    # the crossover the auto lax.cond actually compared against (the
+    # engine's occupancy EWMA feeds it; bounds pinned by the heuristic)
+    assert 0.05 <= sem["crossover"] <= 1.0
+    assert set(sem["modes"]) == {"pull", "push", "auto"}
+    for mode, pt in sem["modes"].items():
+        for k in ("check_p50_ms", "list_p50_ms"):
+            v = pt[k]
+            assert isinstance(v, (int, float)) and v == v and v > 0 \
+                and abs(v) != float("inf"), (mode, k, v)
+        iters = pt["iterations"]
+        assert isinstance(iters, int) and iters >= 1
+        assert 0 <= pt["push_steps"] <= iters
+        assert pt["pull_steps"] == iters - pt["push_steps"]
+    # a forced-pull fixpoint must never report push steps
+    assert sem["modes"]["pull"]["push_steps"] == 0
+    for k in ("dense_speedup_push_vs_pull", "dense_speedup_auto_vs_pull",
+              "pallas_list_p50_ms", "lax_list_p50_ms", "pallas_over_lax"):
+        v = sem[k]
+        assert isinstance(v, (int, float)) and v == v and v > 0 \
+            and abs(v) != float("inf"), (k, v)
+    assert isinstance(sem["pallas_engaged"], bool)
+    assert sem["provenance"] in ("tpu", "[DEGRADED: cpu]")
+    # no silent MXU claims off-TPU: the kernel cannot have engaged on a
+    # degraded (CPU) run, where both sides of the delta are the lax path
+    if sem["provenance"] == "[DEGRADED: cpu]":
+        assert sem["pallas_engaged"] is False
 
 
 def _assert_shard_schema(sh: dict) -> None:

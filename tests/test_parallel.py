@@ -520,7 +520,9 @@ def test_sharded_kstep_fuses_convergence_checks():
     convergence collective, so a query pays ceil(iters/K)+<=1 checks
     instead of one per hop — counted via conv_checks() and compared
     against the single-device iteration count for the SAME query, with
-    identical results."""
+    identical results. iterations() reports the TRUE converged-at step
+    (the per-step change flags survive the fuse as a [K] pmax vector),
+    not the K-quantized budget the pre-semiring future reported."""
     e, users = build_engine(seed=3)
     cg = e.compiled()
     objs = e._objects_by_name()
@@ -543,7 +545,11 @@ def test_sharded_kstep_fuses_convergence_checks():
     # the single-device iteration count, and strictly fewer collectives
     # than one-per-hop whenever the query iterates past one block
     assert 1 <= checks <= -(-iters_single // sg.k_steps) + 1
-    assert fm.iterations() == checks * sg.k_steps
+    # the ISSUE 17 fix: no more "budget consumed, a multiple of K" —
+    # the mesh future reports the same converged-at step the
+    # single-device future does, and the checks stay fused
+    assert fm.iterations() == iters_single
+    assert fm.iterations() <= checks * sg.k_steps
     if iters_single > sg.k_steps:
         assert checks < iters_single
     # explicit K override is honored and stays exact
@@ -551,6 +557,7 @@ def test_sharded_kstep_fuses_convergence_checks():
     f4 = sg4.query_async(seeds, qs, qb)
     assert np.array_equal(f4.result(), want)
     assert f4.conv_checks() <= -(-iters_single // 4) + 1
+    assert f4.iterations() == iters_single
 
 
 def test_sharded_refuses_unstratified_caveated_graph():
@@ -797,3 +804,79 @@ def test_watch_over_engine_mesh(tmp_path):
         await cfg.workflow.shutdown()
         upstream_server.close()
     asyncio.run(go())
+
+
+def test_semiring_push_pull_differential_churn(monkeypatch):
+    """The ISSUE 17 parity bar for the masked-semiring core: forced
+    push, forced pull, and auto mode agree byte-identically with each
+    other and with the recursive oracle at EVERY churn step, on BOTH
+    backends, with real dense blocks and bit-packed duals in play
+    (interpret-mode kernels on the CPU host platform) while expiring +
+    caveated + plain tuples churn through the incremental overlay."""
+    import time as _time
+
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+    from spicedb_kubeapi_proxy_tpu.ops import reachability, semiring
+
+    # interpret-mode bit kernel + a low dense threshold: the small test
+    # graph forms real dense blocks WITH bit duals, so push and pull are
+    # genuinely different code paths here, not the same fallback
+    monkeypatch.setenv("SDBKP_BITPROP", "interpret")
+    monkeypatch.setattr(reachability, "DENSE_MIN_EDGES", 8)
+
+    rng = np.random.default_rng(0x5E31)
+    users = [f"u{i}" for i in range(7)]
+    docs = [f"d{i}" for i in range(10)]
+    engines = {"single": Engine(bootstrap=CAVEAT_BOOTSTRAP),
+               "mesh": Engine(bootstrap=CAVEAT_BOOTSTRAP,
+                              mesh=make_mesh(8, data=2, graph=4))}
+    seed_rels = [f"doc:{d}#viewer@user:{u}"
+                 for d in docs for u in users if hash((d, u)) % 2]
+    for e in engines.values():
+        e.write_relationships(touch(*seed_rels))
+    cg = engines["single"].compiled()
+    assert cg.blocks, "differential needs at least one dense block"
+    assert any(b is not None
+               for b in cg._dev()["blocks_bits"]), \
+        "differential needs a bit-packed dual (real push path)"
+
+    now_fixed = _time.time()
+    req = {"ip": "10.5.5.5"}
+    ctxs = ['{"allowed":["10.0.0.0/8"]}', '{"allowed":["172.16.0.0/12"]}']
+    items = [CheckItem("doc", d, "view", "user", u)
+             for d in docs for u in users]
+    live: list[Relationship] = []
+    for step in range(6):
+        kind = int(rng.integers(4))
+        d = docs[int(rng.integers(len(docs)))]
+        u = users[int(rng.integers(len(users)))]
+        if kind == 0 and live:
+            op = WriteOp("delete", live.pop(int(rng.integers(len(live)))))
+        elif kind == 1:
+            rel = Relationship("doc", d, "viewer", "user", u, None, None,
+                               "ip_allowlist",
+                               ctxs[int(rng.integers(len(ctxs)))])
+            live.append(rel)
+            op = WriteOp("touch", rel)
+        elif kind == 2:
+            exp = now_fixed + (300.0 if rng.random() < 0.5 else -300.0)
+            rel = Relationship("doc", d, "viewer", "user", u,
+                               expiration=exp)
+            live.append(rel)
+            op = WriteOp("touch", rel)
+        else:
+            rel = Relationship("doc", d, "viewer", "user", u)
+            live.append(rel)
+            op = WriteOp("touch", rel)
+        for e in engines.values():
+            e.write_relationships([op])
+        for ctx in (req, None):
+            o = engines["single"].oracle(now=now_fixed, context=ctx)
+            want = [o.check(i.resource_type, i.resource_id, i.permission,
+                            i.subject_type, i.subject_id) for i in items]
+            for mode in ("pull", "push", "auto"):
+                with semiring.force_mode(mode):
+                    for name, e in engines.items():
+                        got = e.check_bulk(items, context=ctx,
+                                           now=now_fixed)
+                        assert got == want, (step, ctx, mode, name)

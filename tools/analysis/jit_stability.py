@@ -12,6 +12,16 @@ signature:
   ``if``/``while`` test, compared in one, or passed to ``range()`` —
   each is either a TracerBoolConversionError at runtime or a hidden
   re-specialization
+- DERIVED traced values must not either (the ISSUE 17 semiring
+  contract: the per-iteration push/pull switch is a ``lax.cond`` on
+  traced occupancy, never a Python ``if``): locals assigned —
+  transitively, to a small fixpoint — from traced parameters taint
+  their targets, and an ``if``/``while`` test on a tainted name is a
+  finding. Static-shape extractors (``.shape`` / ``.ndim`` /
+  ``.dtype`` / ``.size`` attribute reads, ``len()``) do NOT propagate
+  taint (they are Python ints under trace), and pure identity guards
+  (``x is None`` / ``x is not None``) are allowed — tracers have
+  stable identity
 - no ``numpy`` (``np.*``) calls applied directly to traced parameters —
   numpy eagerly concretizes, forcing a device sync per call (use
   ``jnp``/``lax``)
@@ -129,25 +139,103 @@ def _name_refs(expr: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
 
 
+# attribute reads that yield static Python values even on tracers — they
+# must not propagate taint (``if v.shape[0] > 4`` is specialization-free)
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+
+
+def _dynamic_refs(expr: ast.AST) -> Set[str]:
+    """Names referenced by *expr* through value-carrying paths only:
+    subtrees under a static-shape attribute read or a ``len()`` call are
+    skipped — their results are Python scalars under trace."""
+    out: Set[str] = set()
+
+    def rec(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call) and call_name(n) == "len":
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            rec(c)
+
+    rec(expr)
+    return out
+
+
+def _is_identity_test(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` style guards: identity on a
+    tracer is a stable Python fact, not a concretization."""
+    return isinstance(test, ast.Compare) and bool(test.ops) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+def _derived_traced(func, traced: Set[str]) -> Set[str]:
+    """Locals tainted by traced parameters: names assigned from
+    expressions that reference traced-or-tainted names through a dynamic
+    path, chased to a bounded fixpoint (assignment order in source need
+    not match dataflow order). Inner defs are skipped to mirror the body
+    walk in :func:`_check_jitted`."""
+    assigns = []
+    stack = list(func.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)) \
+                and n.value is not None:
+            assigns.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    tainted: Set[str] = set()
+    for _ in range(10):
+        changed = False
+        for a in assigns:
+            if not (_dynamic_refs(a.value) & (traced | tainted)):
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for t in targets:
+                for nn in ast.walk(t):
+                    if isinstance(nn, ast.Name) and nn.id not in traced \
+                            and nn.id not in tainted:
+                        tainted.add(nn.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
 def _check_jitted(mod: Module, func, static: Set[str],
                   findings: list) -> None:
     params = {a.arg for a in func.args.args} | \
         {a.arg for a in func.args.kwonlyargs}
     traced = params - static
+    tainted = _derived_traced(func, traced)
     stack = list(func.body)
     while stack:
         n = stack.pop()
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue  # inner defs are traced closures; checked via walk
         if isinstance(n, (ast.If, ast.While)):
-            used = _name_refs(n.test) & traced
-            for u in sorted(used):
+            if _is_identity_test(n.test):
+                refs = set()  # `is None` guards: tracer identity is stable
+            else:
+                refs = _dynamic_refs(n.test)
+            for u in sorted(refs & traced):
                 findings.append(mod.finding(
                     RULE, n, f"py-branch-{u}",
                     f"jitted `{func.name}` branches in Python on traced "
                     f"arg `{u}` — a trace error or per-value "
                     f"re-specialization; use lax.cond/select or declare "
                     f"it static"))
+            for u in sorted((refs & tainted) - traced):
+                    findings.append(mod.finding(
+                        RULE, n, f"py-branch-derived-{u}",
+                        f"jitted `{func.name}` branches in Python on "
+                        f"`{u}`, derived from a traced arg — the branch "
+                        f"bakes one side into the trace (the semiring "
+                        f"push/pull switch must be a lax.cond on the "
+                        f"traced value)"))
         if isinstance(n, ast.Call):
             cname = call_name(n)
             if cname == "range":

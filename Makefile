@@ -46,12 +46,22 @@ bench-quick:
 
 # CI-sized bench exercising the full hot path including the decision
 # cache's repeat-traffic phase (cold vs warm p50 + hit rate on stderr),
-# gated by the write-path regression check: zero recompiles under
-# steady-state churn and read-after-write p50 within a fixed RATIO of
-# the same run's read-only p50 (relative, so any backend speed works;
-# the pre-overlay seed sat at 2.16x — tools/write_path_gate.py)
+# gated by the relative regression checks (relative = internal to one
+# run, so any backend speed works):
+#  - tools/write_path_gate.py: zero recompiles under steady-state churn
+#    and read-after-write p50 within a fixed ratio of the same run's
+#    read-only p50 (the pre-overlay seed sat at 2.16x)
+#  - tools/tiered_gate.py: hot-working-set p50 under the 50% device
+#    budget within TIERED_RATIO (default 1.3x) of the same run's
+#    all-resident p50, oracle parity at the beyond-budget point, and
+#    zero recompiles across steady streaming
+# One bench run feeds both gates via a temp file (they can't share a
+# pipe), removed only on success so a failing run leaves the evidence.
 bench-smoke:
-	$(PY) bench.py --quick | $(PY) tools/write_path_gate.py
+	$(PY) bench.py --quick > /tmp/_bench_smoke.json
+	$(PY) tools/write_path_gate.py /tmp/_bench_smoke.json
+	$(PY) tools/tiered_gate.py /tmp/_bench_smoke.json
+	rm -f /tmp/_bench_smoke.json
 
 # open-loop macrobench smoke: ONLY the trace-shaped offered-load sweep
 # at --tiny scale (seconds, not minutes) — proves the goodput curve,
@@ -122,6 +132,7 @@ verify: lint analyze
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_caveats.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_scaleout.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_rebalance.py
+	$(PY) -m pytest -q -p no:cacheprovider tests/test_tiered.py
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -105,6 +105,12 @@ class Compactor:
         self.threshold = float(threshold)
         self._cond = threading.Condition()
         self._pending = False
+        # tiered-storage placement (storage/tiers.py): the worker doubles
+        # as the placement engine — it decays access recency, demotes
+        # blocks that went cold, and re-materializes pinned overlay
+        # blocks, all off the serving path (reachability.tier_maintain)
+        self._place_pending = False
+        self._notify_count = 0
         self._closed = False
         # recent fold wall times, feeding the Retry-After estimate
         self._durations: deque = deque(maxlen=8)
@@ -119,6 +125,12 @@ class Compactor:
         engine's incremental path and the write headroom check)."""
         if cg is None or cg.delta_pos is None or not cg.delta_cap:
             return
+        if getattr(cg, "tier", None) is not None:
+            # placement rides the same cheap per-advance hook: every
+            # PLACE_EVERY advanced graphs, sweep residency once
+            self._notify_count += 1
+            if self._notify_count % self.PLACE_EVERY == 0:
+                self.request_placement()
         if (cg.n_delta >= self.threshold * cg.delta_cap
                 or cg.n_dead >= self.threshold * len(cg.dead_buf)):
             self.request()
@@ -129,6 +141,19 @@ class Compactor:
             if self._closed:
                 return
             self._pending = True
+            self._cond.notify()
+
+    # advanced-graph notifies between placement sweeps; sweeps are cheap
+    # (bookkeeping + at most a few block materializations) but need not
+    # run per write
+    PLACE_EVERY = 64
+
+    def request_placement(self) -> None:
+        """Ask for an async tier placement sweep (idempotent)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._place_pending = True
             self._cond.notify()
 
     def retry_after(self) -> float:
@@ -171,11 +196,24 @@ class Compactor:
     def _run(self) -> None:
         while True:
             with self._cond:
-                while not self._pending and not self._closed:
+                while not (self._pending or self._place_pending) \
+                        and not self._closed:
                     self._cond.wait()
                 if self._closed and not self._pending:
                     return
-                self._pending = False
+                do_fold, self._pending = self._pending, False
+                do_place, self._place_pending = self._place_pending, False
+            if do_place and not do_fold:
+                # a fold supersedes placement: it rebuilds the graph —
+                # and with it a fresh, unpinned TierStore
+                from ..ops.reachability import tier_maintain
+
+                try:
+                    tier_maintain(self.engine._compiled)
+                except Exception:
+                    log.exception("tier placement sweep failed "
+                                  "(will retry on next cadence)")
+                continue
             try:
                 self.compact()
             except Exception:

@@ -135,7 +135,9 @@ class Engine:
     def __init__(self, bootstrap: Optional[str] = None,
                  schema: Optional[Schema] = None,
                  validate_writes: bool = True,
-                 mesh=None, delta_capacity: int = DELTA_CAPACITY):
+                 mesh=None, delta_capacity: int = DELTA_CAPACITY,
+                 device_graph_budget_bytes: Optional[int] = None,
+                 tier_spill_dir: Optional[str] = None):
         if schema is None:
             b: Bootstrap = parse_bootstrap(bootstrap or DEFAULT_BOOTSTRAP)
             schema = b.schema
@@ -155,6 +157,13 @@ class Engine:
         # folds the overlay into a fresh base off the write path
         self._delta_capacity = max(int(delta_capacity), 64)
         self._compactor = None
+        # tiered graph storage (--device-graph-budget-bytes, storage/):
+        # when set, every graph this engine compiles gets its dense
+        # blocks residency-tracked under this device byte budget — cold
+        # blocks live in host arenas and stream in on demand. 0/None =
+        # classic all-resident placement.
+        self._tier_budget = int(device_graph_budget_bytes or 0)
+        self._tier_spill_dir = tier_spill_dir
         # (base revision, store revision) pair the incremental path
         # declined at write time — the read path must not retry (and
         # re-count) the identical suffix; any further write resets it
@@ -519,6 +528,8 @@ class Engine:
         metrics.gauge("engine_csr_nnz").set(cg.n_edges)
         metrics.gauge("engine_graph_slots").set(cg.M)
         metrics.gauge("engine_delta_occupancy").set(cg.n_delta)
+        if cg.tier is not None:
+            cg.tier.publish_gauges()
 
     def compiled(self) -> CompiledGraph:
         """Fully-consistent snapshot: a stale compiled graph is brought
@@ -552,6 +563,12 @@ class Engine:
         t0 = time.perf_counter()
         cg = compile_graph(self.schema, self.store.snapshot(),
                            delta_capacity=self._delta_capacity)
+        if self._tier_budget:
+            # each compiled base gets a fresh TierStore: residency and
+            # overlay pins start clean, which is exactly the "pinned
+            # until folded" rule
+            cg.enable_tiering(self._tier_budget,
+                              spill_dir=self._tier_spill_dir)
         metrics.counter("engine_graph_compiles_total").inc()
         metrics.histogram("engine_graph_compile_seconds").observe(
             time.perf_counter() - t0)
@@ -704,6 +721,15 @@ class Engine:
         compiled graph changes revision. Both expose the same
         ``query_async(seeds, q_slots, q_batch, now)`` surface."""
         if self.mesh is None:
+            return cg
+        t = cg.tier
+        if t is not None and t.total_bytes() > t.budget_bytes:
+            # beyond-budget tiered graph: the mesh backend pins every
+            # block resident (parallel/sharded.py streams nothing), so
+            # a graph that cannot fit routes through the single-chip
+            # demand-streaming path instead — counted so a mesh
+            # deployment sees why its mesh idles on oversized groups
+            metrics.counter("engine_tier_mesh_fallback_total").inc()
             return cg
         from ..parallel.sharded import ShardedGraph
 
@@ -1117,6 +1143,11 @@ class Engine:
         EWMA re-stamps it here."""
         cg.spmm_crossover = semiring.crossover_from_occupancy(
             self._occ_ewma)
+        # the crossover was invisible to operators before this gauge:
+        # auto mode's push/pull choice is made ON DEVICE per iteration,
+        # and the only host-side artifacts are this threshold and the
+        # per-mode step counters below
+        metrics.gauge("engine_semiring_crossover").set(cg.spmm_crossover)
 
     def _observe_occupancy(self, frac: float) -> None:
         """Fold one observed final-frontier fill fraction ([0, 1], from

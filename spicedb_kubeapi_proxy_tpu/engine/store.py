@@ -963,22 +963,66 @@ class Store:
     def _parse_state(z) -> tuple[dict, "Columns"]:
         import json
 
-        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        # np.asarray instead of astype: matching-dtype columns pass
+        # through without a copy, which keeps mmap-backed directory
+        # snapshots (load(..., mmap=True)) lazily paged instead of
+        # materializing a second full copy at parse time
+        names = z.files if hasattr(z, "files") else set(z.keys())
+        meta = json.loads(bytes(np.asarray(z["meta"]).tobytes()).decode())
         cols = Columns(
-            z["rt"].astype(np.int32), z["rid"].astype(np.int32),
-            z["rl"].astype(np.int32), z["st"].astype(np.int32),
-            z["sid"].astype(np.int32), z["srl"].astype(np.int32),
-            z["exp"].astype(np.float64),
+            np.asarray(z["rt"], dtype=np.int32),
+            np.asarray(z["rid"], dtype=np.int32),
+            np.asarray(z["rl"], dtype=np.int32),
+            np.asarray(z["st"], dtype=np.int32),
+            np.asarray(z["sid"], dtype=np.int32),
+            np.asarray(z["srl"], dtype=np.int32),
+            np.asarray(z["exp"], dtype=np.float64),
             # snapshots predating caveat support carry no cav column:
             # every restored tuple is unconditional
-            (z["cav"].astype(np.int32) if "cav" in z.files else None),
+            (np.asarray(z["cav"], dtype=np.int32)
+             if "cav" in names else None),
         )
         return meta, cols
 
-    def load(self, path: str) -> None:
-        """Replace this store's contents with a saved snapshot."""
-        with np.load(path) as z:
-            meta, cols = self._parse_state(z)
+    def save_dir(self, path: str) -> int:
+        """Save a snapshot in the ``persistence/codec.save`` directory
+        form (one flat ``.npy`` per column): the only layout
+        ``load(..., mmap=True)`` can genuinely memory-map back.
+        Returns the saved revision."""
+        import json
+
+        from ..persistence import codec
+
+        cols, meta = self._collect_state()
+        arrays = {
+            "rt": cols.rt, "rid": cols.rid, "rl": cols.rl,
+            "st": cols.st, "sid": cols.sid, "srl": cols.srl,
+            "exp": cols.exp, "cav": cols.cav,
+            "meta": np.frombuffer(json.dumps(meta).encode(),
+                                  dtype=np.uint8),
+        }
+        codec.save(path, {k: v for k, v in arrays.items()
+                          if v is not None})
+        return int(meta["revision"])
+
+    def load(self, path: str, mmap: bool = False) -> None:
+        """Replace this store's contents with a saved snapshot.
+
+        ``path`` is either the classic single-file npz or a
+        :meth:`save_dir` directory; the directory form with
+        ``mmap=True`` maps every column read-only so restoring a large
+        graph pages tuples in on demand instead of transiently holding
+        snapshot + store copies in host RAM at once (npz/zip members
+        cannot be mmapped — see persistence/codec.load)."""
+        import os
+
+        if os.path.isdir(path):
+            from ..persistence import codec
+
+            meta, cols = self._parse_state(codec.load(path, mmap=mmap))
+        else:
+            with np.load(path) as z:
+                meta, cols = self._parse_state(z)
         self._install_state(meta, cols)
 
     def load_state_bytes(self, payload: bytes) -> None:

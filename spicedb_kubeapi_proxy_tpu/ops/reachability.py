@@ -114,6 +114,12 @@ def _fallback(reason: str) -> None:
 _JIT_CACHE: dict = {}
 _JIT_CACHE_MAX = 32
 
+# Monotonic count of fresh jit traces built (cache misses in
+# _jit_run_for). Tests freeze it after warmup to prove that residency
+# churn — promote / demote / stream-in — never alters a jit signature:
+# steady-state streaming must be ZERO recompiles.
+_TRACE_BUILDS = 0
+
 # serializes lazy device-state init across worker threads (one lock for all
 # graphs: init is rare — once per store revision); re-entrant so the shared
 # jit-cache helper can take it from both the init path (already holding it)
@@ -121,7 +127,7 @@ _JIT_CACHE_MAX = 32
 _DEV_INIT_LOCK = threading.RLock()
 
 
-def _jit_run_for(cg: "CompiledGraph"):
+def _jit_run_for(cg: "CompiledGraph", active: Optional[tuple] = None):
     """The jitted fixpoint for cg's signature, shared across revisions.
     Cache mutation is serialized on _DEV_INIT_LOCK — _dev_locked and
     incremental_update would otherwise race the get/evict/insert.
@@ -133,13 +139,21 @@ def _jit_run_for(cg: "CompiledGraph"):
 
     Kernel/mode toggles that are baked into traces (bit kernel, dense
     Pallas kernel, forced semiring mode) discriminate the key — flipping
-    one mid-process gets a fresh trace, never a stale one."""
+    one mid-process gets a fresh trace, never a stale one.
+
+    ``active``: tiered dispatch passes the demand-set block indices
+    (sorted tuple) — the trace consumes exactly those blocks. The key is
+    a function of the QUERY SHAPE (which ranges seed / are read), never
+    of residency, so promote/demote churn cannot cause a retrace."""
+    global _TRACE_BUILDS
     sig = (cg.signature(), bitprop.kernel_enabled(),
-           bitprop.dense_kernel_enabled(), semiring.resolved_mode())
+           bitprop.dense_kernel_enabled(), semiring.resolved_mode(),
+           active)
     with _DEV_INIT_LOCK:
         run = _JIT_CACHE.get(sig)
         if run is None:
-            run = jax.jit(partial(_run, cg.run_meta()),
+            _TRACE_BUILDS += 1
+            run = jax.jit(partial(_run, cg.run_meta(active)),
                           static_argnames=("max_iters", "q_contig_len",
                                            "q_contig_rows"))
             if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
@@ -476,6 +490,21 @@ class CompiledGraph:
     relperm_off: Optional[np.ndarray] = None
     # (resource tid, tupleset rel id, term slot offset, tgt_off[n_types+1])
     arrow_maps: list = field(default_factory=list)
+    # range-granularity dependency adjacency retained from compile time:
+    # sorted ((src range id, dst range id), ...) pairs covering the FULL
+    # edge set (computed before the dense split) plus every program's
+    # leaf -> permission edge. The tiered dispatch path intersects
+    # forward reachability from the seed ranges with backward
+    # reachability from the queried ranges over this graph (plus the
+    # live overlay) to pick the dense blocks a dispatch actually needs.
+    # None on hand-built graphs (tiering then streams every block).
+    range_adj: Optional[tuple] = None
+    # tiered-storage residency state (storage/tiers.TierStore), attached
+    # by enable_tiering(); None = classic all-resident placement. NOT
+    # part of signature(): residency is invisible to traces. Shared
+    # across incremental descendants of one compiled base (carried by
+    # dataclasses.replace), rebuilt fresh by each compaction fold.
+    tier: Optional[object] = None
     # push/pull crossover threshold fed to the semiring primitive as a
     # TRACED scalar (ops/semiring.propagate): push while the traced
     # per-iteration occupancy is <= this. Mutated in place by the engine
@@ -605,10 +634,17 @@ class CompiledGraph:
         return self.host_lock if self.host_lock is not None \
             else nullcontext()
 
-    def run_meta(self) -> "RunMeta":
+    def run_meta(self, active: Optional[tuple] = None) -> "RunMeta":
         """Slim static-metadata view for jit closures: everything the
         traced fixpoint reads from the graph object, nothing that holds
-        host edge arrays or device buffers alive."""
+        host edge arrays or device buffers alive.
+
+        ``active`` (tiered dispatch): keep only these block indices —
+        the trace then takes exactly that many block operands. The
+        per-level merge windows stay UNFILTERED: an excluded closured
+        block's range merges plain propagation values, which is safe
+        because demand closure guarantees excluded ranges cannot
+        influence any queried slot."""
         bounds = self.res_level_bounds
         if bounds is None:
             n_res = (len(self.res_src) if self.res_src is not None
@@ -630,10 +666,12 @@ class CompiledGraph:
                          if b.closured and b.level == k]
                 level_ranges.append(tuple(wins))
         cav = self.caveats
+        kept = (self.blocks if active is None
+                else [self.blocks[i] for i in active])
         return RunMeta(
             M=self.M,
             programs=tuple(self.programs),
-            blocks=tuple(b.slim() for b in self.blocks),
+            blocks=tuple(b.slim() for b in kept),
             res_level_bounds=tuple(bounds),
             n_levels=self.n_levels,
             level_ranges=tuple(level_ranges),
@@ -693,6 +731,17 @@ class CompiledGraph:
         d["cav_static"] = (self.caveats.device_static()
                           if self.caveats is not None
                           and self.caveats.metas else ())
+
+        # Tiered placement: NOTHING is device-resident up front — every
+        # block starts cold and streams in on first demand, which is
+        # what makes "namespaces never touched by traffic cost zero
+        # device bytes" literally true. The placeholder tuples keep the
+        # dict shape for non-dispatch consumers; the dispatch path
+        # assembles its own per-demand-set operand tuples.
+        if self.tier is not None:
+            d["blocks"] = tuple(None for _ in self.blocks)
+            d["blocks_bits"] = tuple(None for _ in self.blocks)
+            return d
 
         # dense blocks from host meta, minus any cells killed by
         # incremental updates since the last full compile (host meta is
@@ -759,6 +808,139 @@ class CompiledGraph:
                 np.full(pad, self.M, dtype=np.int32),
                 np.full(pad, -np.inf, dtype=np.float32),
                 np.zeros(pad, dtype=np.int32))
+
+    # -- tiered storage ----------------------------------------------------
+
+    def enable_tiering(self, budget_bytes: int,
+                       spill_dir: Optional[str] = None):
+        """Split this graph's dense blocks into residency-tracked tiers
+        under an explicit device byte budget (storage/): every block's
+        COO is encoded into a host-cold arena, nothing is uploaded until
+        a dispatch demands it, and streamed blocks stay hot only while
+        the budget allows. Call before serving queries (the engine does,
+        right after compile); any previously built device block state is
+        dropped. Returns the TierStore."""
+        from ..storage import ColdArena, TierStore
+        if self.tier is not None:
+            # re-enable (budget change): retire the old store's
+            # prefetch workers before the fresh one takes over
+            self.tier.close()
+        arena = ColdArena(spill_dir)
+        tier = TierStore(budget_bytes, arena)
+        bits_on = bitprop.kernel_enabled()
+        for i, b in enumerate(self.blocks):
+            nb = b.n_dst * b.n_src  # int8 dense cells
+            if bits_on and bitprop.eligible(b.n_dst, b.n_src):
+                k_pad = -(-((b.n_src + 31) // 32) // bitprop.LANES) \
+                    * bitprop.LANES
+                nb += b.n_dst * k_pad * 4  # packed dual rides along
+            cols = {"dst_local": np.asarray(b.dst_local, dtype=np.int32),
+                    "src_local": np.asarray(b.src_local, dtype=np.int32)}
+            if b.closured:
+                cols["base_dst_local"] = np.asarray(
+                    b.base_dst_local, dtype=np.int32)
+                cols["base_src_local"] = np.asarray(
+                    b.base_src_local, dtype=np.int32)
+            arena.put(i, cols)
+            tier.register(i, nb, b.level)
+        self.tier = tier
+        with _DEV_INIT_LOCK:
+            self._device = {}
+        tier.publish_gauges()
+        return tier
+
+    def _demand_blocks(self, seed_slots: np.ndarray,
+                       q_slots: np.ndarray) -> Optional[tuple]:
+        """Block indices this dispatch can possibly exercise: a block is
+        demanded iff its src range is forward-reachable from the seed
+        ranges AND its dst range is backward-reachable from the queried
+        ranges, over the compile-retained range adjacency plus the live
+        overlay pairs. Everything outside that intersection provably
+        cannot influence a queried slot, so it neither uploads nor
+        counts an access. None = no adjacency (hand-built graph):
+        stream everything.
+
+        The result is cached per (seed ranges, query ranges, overlay
+        watermark) — the demand key is a pure function of query shape,
+        so steady traffic reuses both the active set and its trace."""
+        offs = self.range_offs
+        if offs is None or self.range_adj is None or not len(self.blocks):
+            return None
+        trash = self.M
+
+        def ranges_of(slots) -> frozenset:
+            s = np.asarray(slots).ravel()
+            s = s[(s >= 0) & (s < trash)]
+            if not len(s):
+                return frozenset()
+            rid = np.searchsorted(offs, s, side="right") - 1
+            return frozenset(np.unique(rid).tolist())
+
+        seed_r = ranges_of(seed_slots)
+        q_r = ranges_of(q_slots)
+        key = (seed_r, q_r, self.n_delta)
+        cached = self.tier.demand_cache_get(key)
+        if cached is not None:
+            return cached
+        n_ranges = len(offs)
+        fwd: list = [set() for _ in range(n_ranges)]
+        back: list = [set() for _ in range(n_ranges)]
+        for s, t in self.range_adj:
+            fwd[s].add(t)
+            back[t].add(s)
+        if self.n_delta and self.delta_src is not None:
+            with self._host_guard():
+                ds = self.delta_src[:self.n_delta].copy()
+                dt = self.delta_dst[:self.n_delta].copy()
+            keep = (ds >= 0) & (ds < trash) & (dt >= 0) & (dt < trash)
+            if np.any(keep):
+                srid = np.searchsorted(offs, ds[keep], side="right") - 1
+                drid = np.searchsorted(offs, dt[keep], side="right") - 1
+                for s, t in zip(srid.tolist(), drid.tolist()):
+                    fwd[s].add(t)
+                    back[t].add(s)
+
+        def close_over(starts, edges) -> set:
+            seen = set(starts)
+            frontier = list(starts)
+            while frontier:
+                nxt = []
+                for r in frontier:
+                    for t in edges[r]:
+                        if t not in seen:
+                            seen.add(t)
+                            nxt.append(t)
+                frontier = nxt
+            return seen
+
+        reach_f = close_over(seed_r, fwd)
+        reach_b = close_over(q_r, back)
+        active = tuple(
+            i for i, b in enumerate(self.blocks)
+            if _range_id(offs, b.src_off) in reach_f
+            and _range_id(offs, b.dst_off) in reach_b)
+        self.tier.demand_cache_put(key, active)
+        return active
+
+    def _stream_blocks(self, active: tuple) -> tuple:
+        """Assemble the dispatch's block operand tuples, streaming cold
+        demanded blocks in through the double-buffered prefetcher in
+        stratification order (level L lands before level L+1). The wall
+        time the dispatch actually blocks on arrivals is the miss stall
+        (engine_tier_miss_stall_seconds)."""
+        tier = self.tier
+        hot, missing = tier.lookup(active)
+        if missing:
+            t0 = time.perf_counter()
+            futs = tier.prefetcher.fetch(
+                missing, partial(_materialize_block, self))
+            for i in missing:
+                payload = futs[i].result()
+                hot[i] = payload
+                tier.admit(i, payload)
+            tier.observe_stall(time.perf_counter() - t0)
+        return (tuple(hot[i][0] for i in active),
+                tuple(hot[i][1] for i in active))
 
     def query_async(
         self,
@@ -877,16 +1059,33 @@ class CompiledGraph:
         # per-mode jitted entry (force_mode flips between dispatches must
         # hit their own trace); built lazily under the shared cache lock
         mk = semiring.resolved_mode()
-        run = d.get(("run", mk))
-        if run is None:
-            run = _jit_run_for(self)
-            d[("run", mk)] = run
+        if self.tier is None:
+            run = d.get(("run", mk))
+            if run is None:
+                run = _jit_run_for(self)
+                d[("run", mk)] = run
+            blocks_arg = d["blocks"]
+            bits_arg = d["blocks_bits"]
+        else:
+            # tiered dispatch: demand-set the blocks, stream in the cold
+            # ones, and run the per-(mode, active-set) trace. The run
+            # key depends only on query shape — residency churn between
+            # dispatches reuses this exact entry (zero recompiles).
+            active = self._demand_blocks(seed_slots, q_slots)
+            if active is None:
+                active = tuple(range(len(self.blocks)))
+            blocks_arg, bits_arg = self._stream_blocks(active)
+            rk = ("run", mk, active)
+            run = d.get(rk)
+            if run is None:
+                run = _jit_run_for(self, active)
+                d[rk] = run
         with jax.profiler.TraceAnnotation("sdbkp:fixpoint"):
             # seeds ride the jit call as a host array: jax folds the
             # transfer into the dispatch instead of a separate device_put
             # round trip (visible through remotely-attached chips)
             out, converged, iters, n_push, cav_missing = run(
-                d["blocks"], d["blocks_bits"], d["src"], d["dst"], d["exp"],
+                blocks_arg, bits_arg, d["src"], d["dst"], d["exp"],
                 d["cav"], d["dsrc"], d["ddst"], d["dexp"], d["dcav"],
                 d["cav_static"], cav_req,
                 seeds, qs_dev, qb_dev,
@@ -1004,6 +1203,125 @@ class CompiledGraph:
                     "pull": sum(pull_bytes(b) for b in core_blk),
                     "pallas": sum(pallas_bytes(b) for b in core_blk),
                 }}
+
+
+def _materialize_block(cg: "CompiledGraph", i: int) -> tuple:
+    """Build one dense block's device arrays from its cold-arena COO
+    (falling back to the compiled host meta for blocks the arena never
+    saw), minus the dead-ledger cells — the streaming twin of the loop
+    in ``_dev_build``. Runs on prefetch worker threads; reads only
+    per-revision-immutable state (arena payloads are replaced whole by
+    recloses, dead_pairs is a frozen watermark view)."""
+    bm = cg.blocks[i]
+    tier = cg.tier
+    dl = sl = None
+    if tier is not None and tier.arena.has(i):
+        coo = tier.arena.get(i)
+        dl, sl = coo["dst_local"], coo["src_local"]
+    if dl is None:
+        dl, sl = bm.dst_local, bm.src_local
+    dl = np.asarray(dl)
+    sl = np.asarray(sl)
+    dl_dead, sl_dead = cg._dead_cells(bm)
+    A = jnp.zeros((bm.n_dst, bm.n_src), dtype=jnp.int8) \
+        .at[jnp.asarray(dl), jnp.asarray(sl)].set(1)
+    if len(dl_dead):
+        A = A.at[jnp.asarray(dl_dead), jnp.asarray(sl_dead)].set(0)
+    bits = None
+    if bitprop.kernel_enabled() and bitprop.eligible(bm.n_dst, bm.n_src):
+        bits_h = bitprop.pack_block_host(dl, sl, bm.n_dst, bm.n_src)
+        if len(dl_dead):
+            np.bitwise_and.at(
+                bits_h, (dl_dead, sl_dead // 32),
+                ~(np.uint32(1) << (sl_dead % 32).astype(np.uint32)))
+        bits = jnp.asarray(bits_h)
+    return (A, bits)
+
+
+def _tier_apply_update(cg: "CompiledGraph", blocks_host: list,
+                       reclose: dict, block_cells: dict) -> None:
+    """Incremental edits against tiered blocks (incremental_update's
+    device section when a TierStore owns placement). Re-closed blocks
+    re-encode their arena payload from the new closure COO and, when
+    resident, rebuild their device arrays whole; plain cell edits apply
+    the same functional scatter/bit-word updates the resident path uses
+    — but only to hot payloads (cold blocks need nothing: the next
+    materialization reads the updated host meta and dead ledger).
+    Every touched block is PINNED hot until the next compaction fold
+    rebuilds the graph — and with it a fresh TierStore, which is how
+    pins reset."""
+    tier = cg.tier
+    for b in reclose:
+        bm = blocks_host[b]
+        tier.arena.put(b, {
+            "dst_local": np.asarray(bm.dst_local, dtype=np.int32),
+            "src_local": np.asarray(bm.src_local, dtype=np.int32),
+            "base_dst_local": np.asarray(bm.base_dst_local,
+                                         dtype=np.int32),
+            "base_src_local": np.asarray(bm.base_src_local,
+                                         dtype=np.int32)})
+        if tier.peek(b) is not None:
+            A = jnp.zeros((bm.n_dst, bm.n_src), dtype=jnp.int8) \
+                .at[jnp.asarray(bm.dst_local),
+                    jnp.asarray(bm.src_local)].set(1)
+            bits = None
+            if bitprop.kernel_enabled() and bitprop.eligible(
+                    bm.n_dst, bm.n_src):
+                bits = jnp.asarray(bitprop.pack_block_host(
+                    bm.dst_local, bm.src_local, bm.n_dst, bm.n_src))
+            tier.replace(b, (A, bits))
+        tier.pin(b)
+    for b, cells in block_cells.items():
+        payload = tier.peek(b)
+        if payload is not None:
+            A, bits = payload
+            dl = np.fromiter((c[0] for c in cells), dtype=np.int32,
+                             count=len(cells))
+            sl = np.fromiter((c[1] for c in cells), dtype=np.int32,
+                             count=len(cells))
+            vals = np.fromiter(cells.values(), dtype=np.int8,
+                               count=len(cells))
+            A = A.at[dl, sl].set(vals)
+            if bits is not None:
+                # group per (row, word): multiple cells can share a
+                # packed word, and a gather-modify-scatter with
+                # duplicate indices would drop updates
+                agg: dict = {}
+                for (dli, sli), v in cells.items():
+                    k = (dli, sli // 32)
+                    setm, clrm = agg.get(k, (0, 0))
+                    bit = 1 << (sli % 32)
+                    if v:
+                        setm |= bit
+                    else:
+                        clrm |= bit
+                    agg[k] = (setm, clrm)
+                rows = np.array([k[0] for k in agg], dtype=np.int32)
+                words = np.array([k[1] for k in agg], dtype=np.int32)
+                sets = np.array([v[0] for v in agg.values()],
+                                dtype=np.uint32)
+                clrs = np.array([v[1] for v in agg.values()],
+                                dtype=np.uint32)
+                cur = bits[rows, words]
+                bits = bits.at[rows, words].set(
+                    (cur & jnp.asarray(~clrs)) | jnp.asarray(sets))
+            tier.replace(b, (A, bits))
+        tier.pin(b)
+
+
+def tier_maintain(cg: "CompiledGraph") -> None:
+    """Placement sweep, run off the serving path (the Compactor's
+    worker thread — engine/compaction.py is the placement engine):
+    decay access recency, demote blocks that went cold while the store
+    is over headroom, and eagerly re-materialize pinned-but-cold blocks
+    so the write path never pays a stream-in for its own overlay's
+    dense cells. Publishes the occupancy gauges afterwards."""
+    tier = getattr(cg, "tier", None)
+    if tier is None:
+        return
+    for i in tier.place():
+        tier.admit(i, _materialize_block(cg, i), pinned=True)
+    tier.publish_gauges()
 
 
 @dataclass
@@ -1531,6 +1849,22 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
 
     level_map, n_levels = _stratify(offs, src_rid, dst_rid, programs,
                                     ignore_self=frozenset(closure_rids))
+
+    # Retain the range-granularity adjacency for tiered demand closure:
+    # every (src range, dst range) pair the FULL edge set crosses (the
+    # rids above were computed before the dense split, so block edges
+    # are covered) plus each program's leaf -> permission edges. Self
+    # pairs stay in — unlike _stratify, reachability wants them.
+    adj_pairs: set = set()
+    if n_edges:
+        for p in np.unique(
+                src_rid.astype(np.int64) * len(offs) + dst_rid).tolist():
+            adj_pairs.add(divmod(p, len(offs)))
+    for p in programs:
+        p_rid = _range_id(offs, p.dst_off)
+        for off_ in set(p.leaf_off.values()):
+            adj_pairs.add((_range_id(offs, off_), p_rid))
+    range_adj = tuple(sorted(adj_pairs))
     if closure_rids:
         # Levels are DOUBLED so a peeled closured range gets two ordered
         # phases at its position in the topo order: odd phase 2k-1
@@ -1659,6 +1993,7 @@ def compile_graph(schema: Schema, snapshot: Snapshot,
         rel_off=rel_off,
         relperm_off=relperm_off,
         arrow_maps=arrow_maps,
+        range_adj=range_adj,
     )
 
 
@@ -2058,7 +2393,7 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
         if res_kill:
             d["exp"] = old["exp"].at[np.asarray(
                 res_kill, dtype=np.int64)].set(-np.inf)
-        if block_cells or reclose:
+        if (block_cells or reclose) and cg.tier is None:
             blocks_dev = list(old["blocks"])
             bits_dev = list(old["blocks_bits"])
             for b in reclose:
@@ -2108,6 +2443,13 @@ def incremental_update(cg: CompiledGraph, records, new_revision: int,
             d["blocks_bits"] = tuple(bits_dev)
         # capacity is static, so the signature — and with it d["run"] —
         # cannot change across overlay appends
+
+    # Tiered placement: overlay-touched blocks update through the tier
+    # store instead of the resident device tuples (which are
+    # placeholders). Runs regardless of whether single-chip device state
+    # ever initialized — the cold arena's COO must not go stale.
+    if cg.tier is not None and (block_cells or reclose):
+        _tier_apply_update(cg, blocks_host, reclose, block_cells)
 
     return replace(
         cg,

@@ -308,6 +308,15 @@ class ShardedGraph:
     seeds/queries, the (tiny) per-request caveat context, and — after
     incremental writes — the small delta/instance patches move
     host->device.
+
+    Tiered storage scope (storage/): the mesh backend keeps EVERY block
+    resident — per-dispatch demand streaming is a single-chip-path
+    feature (the shard_map's operand tuple is fixed at build time). A
+    tiered graph whose blocks fit the budget builds here normally and
+    simply accounts all blocks hot (``TierStore.mark_sharded``); one
+    that exceeds the budget never reaches this class — Engine._backend
+    routes it to the single-chip streaming path and counts the decision
+    in ``engine_tier_mesh_fallback_total``.
     """
 
     def __init__(self, cg: CompiledGraph, mesh: Mesh,
@@ -371,6 +380,15 @@ class ShardedGraph:
             else:
                 self._cav_static = ()
                 self._applied_inst = ()
+        if cg.tier is not None:
+            # mesh placement: every materialized block is device-resident
+            # for the life of this build — account it hot so the
+            # occupancy gauges tell the truth under a mesh too (outside
+            # the host guard: the tier store has its own lock)
+            idxs = [cg.block_index.get((bm.dst_off, bm.src_off))
+                    for bm in self._block_meta]
+            cg.tier.mark_sharded([i for i in idxs if i is not None])
+            cg.tier.publish_gauges()
         # dead pairs already folded into this build (updated() applies
         # only the new tail); _applied_delta / _h_dexp / _h_dcav let
         # updated() patch only the overlay slots that actually changed

@@ -15,6 +15,8 @@ side — the encoder never produces object arrays.
 from __future__ import annotations
 
 import io
+import os
+import tempfile
 
 import numpy as np
 
@@ -62,3 +64,57 @@ def decode_bulk_cols(blob: bytes) -> dict:
     smuggle object arrays."""
     with np.load(io.BytesIO(blob)) as z:
         return {k: z[k] for k in z.files}
+
+
+def save(path: str, arrays: dict) -> int:
+    """Persist ``{name: ndarray}`` as a *directory* of one ``.npy`` file
+    per column, and return the total bytes written.
+
+    The directory form exists because ``np.load(..., mmap_mode=...)``
+    silently ignores the mmap request for ``.npz`` archives (zip members
+    can't be mapped); one flat ``.npy`` per column is the only layout
+    numpy will genuinely map. Each column is written to a temp file in
+    the target directory and atomically renamed, mirroring the store's
+    snapshot discipline, so a torn write never leaves a half-length
+    column behind.
+    """
+    os.makedirs(path, exist_ok=True)
+    total = 0
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        fd, tmp = tempfile.mkstemp(dir=path, suffix=".npy.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.save(f, a)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(path, name + ".npy"))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        total += int(a.nbytes)
+    return total
+
+
+def load(path: str, mmap: bool = False) -> dict:
+    """Load a ``save()`` directory back into ``{name: ndarray}``.
+
+    With ``mmap=True`` every column comes back as a read-only memory map
+    (``mmap_mode="r"``): snapshot recovery and cold-arena installs touch
+    pages on demand instead of transiently holding a second full copy of
+    the graph in host RAM. ``allow_pickle`` stays False in both modes —
+    same trust boundary as ``decode_bulk_cols``.
+    """
+    out = {}
+    for fn in sorted(os.listdir(path)):
+        if not fn.endswith(".npy"):
+            continue
+        out[fn[:-4]] = np.load(
+            os.path.join(path, fn),
+            mmap_mode="r" if mmap else None,
+            allow_pickle=False,
+        )
+    return out

@@ -194,6 +194,13 @@ class Options:
     # only — a tcp:// engine host owns its own overlay (same flags there).
     delta_capacity: int = 4096
     compact_threshold: float = 0.75
+    # tiered graph storage (storage/, docs/operations.md "Tiered graph
+    # storage"): device byte budget for resident dense blocks. 0 =
+    # classic all-resident placement; > 0 keeps hot blocks on device
+    # under the cap, parks cold ones in host arenas, and streams them
+    # into dispatches on demand. Emulatable on CPU (the budget gates
+    # the same placement bookkeeping). In-process engines only.
+    device_graph_budget_bytes: int = 0
     # request caveat context (caveats/, docs/operations.md "Caveats &
     # conditional grants"): forward caller attributes (client IP from
     # the trusted header below — last XFF hop — user, verb/resource) to the engine so
@@ -546,6 +553,9 @@ class Options:
                                     self.compact_threshold)
         except ValueError as e:
             raise OptionsError(str(e)) from None
+        if self.device_graph_budget_bytes < 0:
+            raise OptionsError("device-graph-budget-bytes must be >= 0 "
+                               "(0 disables tiered graph storage)")
         if not (self.caveat_ip_header or "").strip():
             raise OptionsError("caveat-ip-header must not be empty "
                                "(set --caveat-context=false to disable "
@@ -774,7 +784,9 @@ class Options:
 
                 mesh = make_mesh(**_parse_mesh_spec(self.engine_mesh))
             engine = Engine(bootstrap=bootstrap or None, mesh=mesh,
-                            delta_capacity=self.delta_capacity)
+                            delta_capacity=self.delta_capacity,
+                            device_graph_budget_bytes=(
+                                self.device_graph_budget_bytes or None))
             if self.compact_threshold > 0:
                 # background overlay folds + overlay-full write
                 # back-pressure (engine/compaction.py); 0 restores the
@@ -986,6 +998,7 @@ class Options:
         "checkpoint_wal_records", "checkpoint_keep",
         "authz_cache", "authz_cache_size", "authz_cache_mask_bytes",
         "delta_capacity", "compact_threshold",
+        "device_graph_budget_bytes",
         "caveat_context", "caveat_ip_header",
         "shard_map", "shard_journal_path", "shard_cache",
         "rebalance_to",
@@ -1196,6 +1209,16 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "read on a synchronous recompile (0 "
                              "disables compaction and restores the "
                              "synchronous fallback)")
+    parser.add_argument("--device-graph-budget-bytes", type=int,
+                        default=0,
+                        help="tiered graph storage: device byte budget "
+                             "for resident dense graph blocks. Hot "
+                             "blocks stay on device under this cap; "
+                             "cold blocks live in host arenas and "
+                             "stream into dispatches on demand "
+                             "(engine_tier_* metrics). 0 keeps the "
+                             "classic all-resident placement "
+                             "(in-process engines only)")
     parser.add_argument("--shard-map",
                         help="scale-out: explicit versioned shard map "
                              "(JSON file path or inline JSON: "
@@ -1440,6 +1463,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         authz_cache_mask_bytes=args.authz_cache_mask_bytes,
         delta_capacity=args.delta_capacity,
         compact_threshold=args.compact_threshold,
+        device_graph_budget_bytes=args.device_graph_budget_bytes,
         caveat_context=args.caveat_context,
         caveat_ip_header=args.caveat_ip_header,
         shard_map=args.shard_map,

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""CI regression gate for tiered graph storage (ISSUE 18).
+
+Reads a bench.py result JSON (argument path or stdin) and enforces the
+hardware-independent tiering invariants:
+
+1. **Steady-state streaming never recompiles.** The demand key is a
+   pure function of query shape, so repeated hot-working-set traffic
+   must reuse its trace (``tiered.zero_recompiles``). A recompile means
+   residency leaked into the jit signature.
+
+2. **The hot working set tracks the all-resident baseline.** The gate
+   is the RATIO of the 50%-budget steady-state check p50 to the same
+   run's all-resident p50 — internal to one run, so it holds on any
+   backend speed. Once the demanded blocks are admitted, a dispatch
+   pays only the tier lookup; the ratio must stay under
+   ``TIERED_RATIO`` (default 1.3).
+
+3. **Beyond-budget answers are still the oracle's.** Both the hot
+   point and the beyond-budget point (budget far under the working
+   set, every dispatch streaming) must report ``parity_ok``, and the
+   beyond-budget point must have actually paid miss stalls — an empty
+   stall count means the phase silently measured a resident graph.
+
+Exit 0 on pass, 1 with a named reason on fail, 2 on malformed input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+MAX_RATIO = float(os.environ.get("TIERED_RATIO", "1.3"))
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        with open(sys.argv[1]) as f:
+            raw = f.read()
+    else:
+        raw = sys.stdin.read()
+    # bench.py's contract is exactly one JSON line on stdout, but be
+    # lenient about surrounding log noise: take the last parseable line
+    result = None
+    for line in reversed(raw.strip().splitlines()):
+        try:
+            result = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if not isinstance(result, dict):
+        print("tiered gate: no JSON result found", file=sys.stderr)
+        return 2
+    if result.get("error"):
+        print(f"tiered gate: bench errored: {result['error']}",
+              file=sys.stderr)
+        return 2
+
+    t = result.get("tiered")
+    if not isinstance(t, dict):
+        print("tiered gate: result carries no tiered block (bench too "
+              "old, or the phase was skipped)", file=sys.stderr)
+        return 1
+    failures = []
+    if not t.get("zero_recompiles"):
+        failures.append(
+            "steady-state streaming re-traced the fixpoint (expected "
+            "zero recompiles: residency must stay out of the jit key)")
+    if not t.get("parity_ok"):
+        failures.append("hot-point answers diverged from the "
+                        "all-resident oracle")
+    ratio = t.get("tiered_over_resident")
+    p_t = t.get("tiered_check_p50_ms")
+    p_r = t.get("resident_check_p50_ms")
+    if ratio is None or not p_r:
+        failures.append("missing tiered_over_resident / "
+                        "resident_check_p50_ms")
+    else:
+        verdict = "OK" if ratio <= MAX_RATIO else "FAIL"
+        print(f"tiered gate: hot-working-set {p_t:.2f}ms / "
+              f"all-resident {p_r:.2f}ms = {ratio:.2f}x "
+              f"(limit {MAX_RATIO}x) [{verdict}]")
+        if ratio > MAX_RATIO:
+            failures.append(
+                f"hot-working-set p50 is {ratio:.2f}x the all-resident "
+                f"p50 (limit {MAX_RATIO}x): admitted blocks are paying "
+                "more than the tier lookup again")
+    bb = t.get("beyond_budget")
+    if not isinstance(bb, dict):
+        failures.append("missing beyond_budget point")
+    else:
+        if not bb.get("parity_ok"):
+            failures.append("beyond-budget answers diverged from the "
+                            "oracle")
+        if not bb.get("miss_stalls"):
+            failures.append(
+                "beyond-budget point recorded no miss stalls: the "
+                "graph never actually streamed")
+    if failures:
+        for f_ in failures:
+            print(f"tiered gate FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(f"tiered gate PASS: {t.get('hot_blocks')}/"
+          f"{t.get('hot_blocks', 0) + t.get('cold_blocks', 0)} blocks "
+          f"hot under {t.get('budget_bytes')}B budget, "
+          f"{bb.get('miss_stalls')} beyond-budget stalls, 0 recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,7 @@ verify: lint analyze
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_scaleout.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_rebalance.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_tiered.py
+	$(PY) -m pytest -q -p no:cacheprovider tests/test_migration.py
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1159,6 +1159,18 @@ def _measure(args, result: dict) -> None:
         traceback.print_exc(file=sys.stderr)
         log(f"rebalance section failed (non-fatal): {ex}")
 
+    # -- live schema migration (ISSUE 19): additive + rewriting targets
+    # applied under a sustained check/write mix — time-to-cut, cut
+    # freeze, backfill volume, and check p50 during-vs-before. Runs at
+    # EVERY scale including --tiny (contract-pinned).
+    try:
+        _migration_phase(result, quick, args.tiny)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"migration section failed (non-fatal): {ex}")
+
     # -- open-loop trace-shaped macrobench (ROADMAP item 5) --
     # Runs at EVERY scale including --tiny: the macro result schema is
     # contract-test-pinned, and the sweep is the harness later
@@ -1170,6 +1182,23 @@ def _measure(args, result: dict) -> None:
 
         traceback.print_exc(file=sys.stderr)
         log(f"macro section failed (non-fatal): {ex}")
+
+    # -- macro with a live schema migration (ISSUE 19): the SAME-SEED
+    # sweep re-run with a rewriting migration (caveat attached to
+    # namespace#viewer) held open across every measured point, cut at
+    # the end, folded into macro.migration.knee_ratio vs the baseline
+    # just recorded. Runs at EVERY scale (contract-pinned).
+    try:
+        if "macro" in result:
+            _macro_phase(result, quick, args.tiny,
+                         result_key="_macro_migration",
+                         migrate_live=True)
+            _fold_macro_migration(result)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"macro migration sub-run failed (non-fatal): {ex}")
     if not quick:
         # second scale point (full runs only): the same trace at 10k
         # namespaces, so the overlay-on/off goodput delta is recorded at
@@ -1656,6 +1685,20 @@ definition namespace {
   permission view = viewer
 }
 """
+
+# The macro migration target (ISSUE 19): _MACRO_SCHEMA with a caveat
+# attached to the live namespace#viewer relation — a REWRITING change
+# whose affected closure is every stored viewer grant, so the in-sweep
+# backfill and dual window carry real volume.
+_MACRO_MIG_SCHEMA = _MACRO_SCHEMA.replace(
+    "definition user {}",
+    "caveat macro_probation(level int) {\n"
+    "  level < 3\n"
+    "}\n\n"
+    "definition user {}").replace(
+    "  relation viewer: user | user:* | group#member\n",
+    "  relation viewer: user | user:* | group#member"
+    " | user with macro_probation\n")
 
 _MACRO_RULES = """
 apiVersion: authzed.com/v1alpha1
@@ -2776,9 +2819,159 @@ def _rebalance_phase(result: dict, quick: bool, tiny: bool) -> None:
         loop_thread.join(10)
 
 
+# The two migration targets the phase applies in sequence.  Both are
+# BENCH_SCHEMA derivatives built by string surgery so the bench schema
+# stays the single source of truth: the ADDITIVE step grows pod with an
+# auditor relation + audit permission (no existing relation changes →
+# swap-at-a-revision, zero backfill), and the REWRITING step — layered
+# on the additive result, since migrations are sequential — attaches a
+# caveat to the live pod#viewer relation (allowed-set change on stored
+# tuples → journaled backfill of the affected closure).
+_MIG_ADDITIVE_SCHEMA = BENCH_SCHEMA.replace(
+    "  permission edit = creator\n",
+    "  relation auditor: user\n"
+    "  permission audit = auditor\n"
+    "  permission edit = creator\n")
+_MIG_REWRITING_SCHEMA = _MIG_ADDITIVE_SCHEMA.replace(
+    "definition user {}",
+    "caveat bench_probation(level int) {\n"
+    "  level < 3\n"
+    "}\n\n"
+    "definition user {}").replace(
+    "  relation viewer: user\n",
+    "  relation viewer: user | user with bench_probation\n")
+
+
+def _migration_phase(result: dict, quick: bool, tiny: bool) -> None:
+    """Live schema migration (ISSUE 19): an additive and then a
+    rewriting migration applied to a serving engine under a sustained
+    check/write mix at every scale. For each migration the phase records
+    end-to-end time-to-cut, the cut freeze, backfilled row count, and
+    check p50 DURING the migration window (compile + backfill + dual)
+    against the same engine's p50 before any migration — the
+    during-vs-before ratio is the number the no-downtime claim rides
+    on. The migration holds at dual only long enough to collect the
+    during-window samples, then cuts."""
+    import jax
+
+    from spicedb_kubeapi_proxy_tpu.engine import CheckItem
+    from spicedb_kubeapi_proxy_tpu.engine.store import WriteOp
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+
+    if tiny:
+        n_pods, n_users, n_ns, n_groups, n_rels = 200, 100, 10, 10, 3_000
+        n_checks, n_during = 64, 24
+    elif quick:
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            2_000, 500, 50, 50, 50_000)
+        n_checks, n_during = 256, 64
+    else:
+        n_pods, n_users, n_ns, n_groups, n_rels = (
+            20_000, 4_000, 400, 400, 2_000_000)
+        n_checks, n_during = 512, 128
+    e, total = build_engine(n_pods, n_users, n_ns, n_groups, n_rels,
+                            seed=11)
+    rng = np.random.default_rng(29)
+    items = [CheckItem("pod", f"ns/p{int(p)}", "view",
+                       "user", f"u{int(u)}")
+             for p, u in zip(rng.integers(n_pods, size=n_checks),
+                             rng.integers(n_users, size=n_checks))]
+    e.check_bulk(items)  # warm the compiled graph
+
+    def one_check(i: int) -> float:
+        it = items[i % len(items)]
+        t0 = time.perf_counter()
+        e.check(it)
+        return (time.perf_counter() - t0) * 1e3
+
+    before = [one_check(i) for i in range(n_checks)]
+    p50_before = float(np.percentile(before, 50))
+
+    # live write churn for the whole phase: touches pod#viewer rows so
+    # the rewriting window has dual-applied writes racing its backfill
+    stop = threading.Event()
+    writes = {"n": 0}
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            e.write_relationships([WriteOp("touch", Relationship(
+                "pod", f"ns/p{i % n_pods}", "viewer",
+                "user", f"u{(i * 7) % n_users}"))])
+            writes["n"] += 1
+            i += 1
+            time.sleep(0.002)
+
+    wt = threading.Thread(target=writer, daemon=True,
+                          name="mig-bench-writer")
+    wt.start()
+
+    def migrate(schema_text: str, pause: float) -> dict:
+        """Run one migration under the live mix: hold at dual until the
+        during-window sample budget is met, then cut. Returns the
+        per-migration result row."""
+        e.begin_schema_migration(schema_text, hold_at_dual=True,
+                                 backfill_pause=pause)
+        during: list[float] = []
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = e.migration_status()
+            phase = st["phase"] if st else None
+            if phase in ("done", "failed", "aborted"):
+                break
+            if phase == "dual" and len(during) >= n_during:
+                break
+            during.append(one_check(len(during)))
+        st = e.cut_schema_migration(wait=True)
+        row = {
+            "classification": st.get("classification"),
+            "phase": st.get("phase"),
+            "time_to_cut_ms": float(st.get("time_to_cut_ms") or 0.0),
+            "freeze_ms": float(st.get("freeze_ms") or 0.0),
+            "backfilled": int(st.get("backfilled") or 0),
+            "affected": int(st.get("affected") or 0),
+            "p50_during_ms": float(np.percentile(during, 50))
+            if during else p50_before,
+            "during_samples": len(during),
+        }
+        log(f"migration [{row['classification']}]: phase={row['phase']} "
+            f"time_to_cut={row['time_to_cut_ms']:.1f}ms "
+            f"freeze={row['freeze_ms']:.2f}ms "
+            f"backfilled={row['backfilled']} "
+            f"p50 during {row['p50_during_ms']:.3f}ms "
+            f"vs before {p50_before:.3f}ms")
+        return row
+
+    try:
+        additive = migrate(_MIG_ADDITIVE_SCHEMA, pause=0.0)
+        # pace the rewriting backfill a little so the during window is a
+        # genuine mid-backfill measurement, not an instant flip
+        rewriting = migrate(_MIG_REWRITING_SCHEMA,
+                            pause=0.005 if tiny else 0.002)
+    finally:
+        stop.set()
+        wt.join(5)
+
+    worst_during = max(additive["p50_during_ms"],
+                       rewriting["p50_during_ms"])
+    result["migration"] = {
+        "n_rels": int(total),
+        "writes": int(writes["n"]),
+        "p50_before_ms": p50_before,
+        "additive": additive,
+        "rewriting": rewriting,
+        "during_over_before_p50": (worst_during / p50_before
+                                   if p50_before > 0 else 1.0),
+        "provenance": ("[DEGRADED: cpu]"
+                       if jax.default_backend() not in _TPU_PLATFORMS
+                       else "tpu"),
+    }
+
+
 def _macro_phase(result: dict, quick: bool, tiny: bool,
                  result_key: str = "macro",
-                 n_ns_override: Optional[int] = None) -> None:
+                 n_ns_override: Optional[int] = None,
+                 migrate_live: bool = False) -> None:
     """The open-loop, trace-shaped macrobench (ROADMAP item 5): a mixed-
     op workload (checks, bulk checks, list prefilters, Table filtering,
     LookupSubjects, wildcard grants, write churn, watch streams through
@@ -2788,7 +2981,15 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
     knee estimate, per-class burst p99/p99.9, per-stage tail attribution
     from the trace ring, and per-class SLO attainment into the result
     JSON — the harness every engine-scaling PR after this one is judged
-    against."""
+    against.
+
+    ``migrate_live`` (ISSUE 19) re-runs the same-seed sweep with a
+    REWRITING schema migration (caveat attached to the live
+    namespace#viewer relation) held open across every measured point —
+    backfill races the write churn, the dual window replays it — and
+    cut after the sweep. The overlay-on/off comparison is skipped in
+    this mode (one variable at a time); the caller folds the resulting
+    knee into the baseline's ``migration.knee_ratio``."""
     import hashlib
 
     from spicedb_kubeapi_proxy_tpu.admission import (
@@ -3106,6 +3307,15 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
         harness_box[0].close()
         harness_box[0] = _WatchStreamHarness(e, max_streams=max_streams)
 
+        if migrate_live:
+            # the live rewriting migration spans the WHOLE measured
+            # sweep: begin after warmup (its jit compiles must not hide
+            # inside the migration window), hold at dual so every point
+            # runs with dual-applied writes + catch-up replay, cut after
+            e.begin_schema_migration(_MACRO_MIG_SCHEMA,
+                                     hold_at_dual=True,
+                                     backfill_pause=0.005)
+
         monitor = SLOMonitor(default_objectives(), windows=(30.0, 120.0),
                              tick_seconds=0.5)
         monitor.start()
@@ -3138,25 +3348,45 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
         from spicedb_kubeapi_proxy_tpu.utils.features import features
 
         off_mults = (1.0, 2.0)
-        try:
-            features.set("IncrementalGraphUpdates", False)
-            # trace_ops matches the main sweep: the two curves must be
-            # measured under identical instrumentation, or the ratio
-            # reports tracing overhead as an overlay effect. (At --tiny
-            # scale on a small CPU box the ratio is smoke, not signal —
-            # a 120-namespace re-encode is ~ms; the delta grows with
-            # graph scale.)
-            sweep_off = run_sweep(
-                make_config, ops, off_mults, slo_s, max_workers=workers,
-                trace_ops=True, drain_timeout=(8.0 if tiny else 15.0),
-                on_point=lambda p: log(
-                    f"[macro overlay-off x{p.multiplier}] "
-                    f"offered={p.offered_rps:.0f}/s "
-                    f"goodput={p.goodput_rps:.0f}/s shed={p.shed_n} "
-                    f"err={p.error_n} late={p.late_n}"))
-        finally:
-            features.set("IncrementalGraphUpdates", True)
+        sweep_off = None
+        mig_status = None
+        if migrate_live:
+            # cut INSIDE the measured configuration (tracer still wide
+            # open) so the freeze histogram covers the real serving
+            # shape, then skip the overlay-off comparison — this run
+            # varies exactly one thing vs the baseline sweep
+            mig_status = e.cut_schema_migration(wait=True)
+        else:
+            try:
+                features.set("IncrementalGraphUpdates", False)
+                # trace_ops matches the main sweep: the two curves must
+                # be measured under identical instrumentation, or the
+                # ratio reports tracing overhead as an overlay effect.
+                # (At --tiny scale on a small CPU box the ratio is
+                # smoke, not signal — a 120-namespace re-encode is ~ms;
+                # the delta grows with graph scale.)
+                sweep_off = run_sweep(
+                    make_config, ops, off_mults, slo_s,
+                    max_workers=workers, trace_ops=True,
+                    drain_timeout=(8.0 if tiny else 15.0),
+                    on_point=lambda p: log(
+                        f"[macro overlay-off x{p.multiplier}] "
+                        f"offered={p.offered_rps:.0f}/s "
+                        f"goodput={p.goodput_rps:.0f}/s shed={p.shed_n} "
+                        f"err={p.error_n} late={p.late_n}"))
+            finally:
+                features.set("IncrementalGraphUpdates", True)
     finally:
+        if migrate_live:
+            # don't leak a held-at-dual migration thread when a sweep
+            # point raises — the happy path already cut above
+            try:
+                _st = e.migration_status()
+                if _st and _st.get("phase") not in ("done", "aborted",
+                                                    "failed"):
+                    e.abort_schema_migration()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
         if monitor is not None:
             monitor.stop()
         peak_streams[0] = max(peak_streams[0],
@@ -3178,25 +3408,35 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
     macro["base_rate_rps"] = round(base_rate, 1)
     macro["scale"] = {"n_ns": n_ns, "n_users": n_users,
                       "n_groups": n_groups}
-    off = sweep_off.to_dict()
-    on_by_mult = {p["multiplier"]: p for p in macro["curve"]}
-    macro["overlay_off"] = {
-        "curve": off["curve"],
-        "knee_rps": off.get("knee_rps"),
-        "goodput_ratio_on_over_off": {
-            str(m): round(
-                on_by_mult[m]["goodput_rps"]
-                / max(p_off["goodput_rps"], 1e-9), 2)
-            for m in off_mults
-            for p_off in [next(p for p in off["curve"]
-                               if p["multiplier"] == m)]
-            if m in on_by_mult
-        },
-    }
-    for m, ratio in macro["overlay_off"][
-            "goodput_ratio_on_over_off"].items():
-        log(f"[macro] overlay on/off goodput at x{m}: {ratio}x "
-            f"(delta overlay vs per-write re-encode)")
+    if sweep_off is not None:
+        off = sweep_off.to_dict()
+        on_by_mult = {p["multiplier"]: p for p in macro["curve"]}
+        macro["overlay_off"] = {
+            "curve": off["curve"],
+            "knee_rps": off.get("knee_rps"),
+            "goodput_ratio_on_over_off": {
+                str(m): round(
+                    on_by_mult[m]["goodput_rps"]
+                    / max(p_off["goodput_rps"], 1e-9), 2)
+                for m in off_mults
+                for p_off in [next(p for p in off["curve"]
+                                   if p["multiplier"] == m)]
+                if m in on_by_mult
+            },
+        }
+        for m, ratio in macro["overlay_off"][
+                "goodput_ratio_on_over_off"].items():
+            log(f"[macro] overlay on/off goodput at x{m}: {ratio}x "
+                f"(delta overlay vs per-write re-encode)")
+    if mig_status is not None:
+        macro["migration_live"] = {
+            "classification": mig_status.get("classification"),
+            "phase": mig_status.get("phase"),
+            "time_to_cut_ms": float(
+                mig_status.get("time_to_cut_ms") or 0.0),
+            "freeze_ms": float(mig_status.get("freeze_ms") or 0.0),
+            "backfilled": int(mig_status.get("backfilled") or 0),
+        }
     macro["slo_ms"] = {k: round(v * 1e3, 1) for k, v in slo_s.items()}
     macro["watch_streams_opened"] = watch_opened_on
     macro["watch_streams_peak"] = peak_streams_on
@@ -3216,6 +3456,45 @@ def _macro_phase(result: dict, quick: bool, tiny: bool,
         f"{watch_opened_on} watch streams opened "
         f"(tail attribution: {sweep.tail_attribution.get('burst')} "
         f"burst, {sweep.tail_attribution.get('traces', 0)} traces)")
+
+
+def _fold_macro_migration(result: dict) -> None:
+    """Fold the migrate-live macro sub-run into the baseline macro dict
+    as ``macro.migration`` — the same-seed knee ratio the ISSUE 19
+    acceptance gate reads (>= 0.9x means a live rewriting migration
+    costs the serving engine at most 10% of its knee)."""
+    mig = result.pop("_macro_migration", None)
+    base = result.get("macro")
+    if not mig or not base:
+        return
+    base_knee = base.get("knee_rps")
+    mig_knee = mig.get("knee_rps")
+    if base_knee and mig_knee:
+        knee_ratio = mig_knee / base_knee
+        basis = "knee"
+    else:
+        # the sweep never saturated at this scale (small boxes often
+        # don't) — fall back to goodput at the highest common offered-
+        # load multiplier, same-seed schedules on both sides
+        on = {p["multiplier"]: p["goodput_rps"] for p in base["curve"]}
+        off = {p["multiplier"]: p["goodput_rps"] for p in mig["curve"]}
+        common = sorted(set(on) & set(off))
+        if not common:
+            return
+        m = common[-1]
+        knee_ratio = off[m] / max(on[m], 1e-9)
+        basis = f"goodput@x{m}"
+    base["migration"] = {
+        "knee_ratio": round(float(knee_ratio), 3),
+        "basis": basis,
+        "knee_rps": mig.get("knee_rps"),
+        "curve": mig.get("curve"),
+        **(mig.get("migration_live") or {}),
+    }
+    log(f"[macro] live-migration knee ratio {knee_ratio:.2f}x "
+        f"({basis}) — rewriting migration held across the sweep, "
+        f"backfilled={base['migration'].get('backfilled')} "
+        f"freeze={base['migration'].get('freeze_ms')}ms")
 
 
 def main() -> None:

@@ -77,6 +77,13 @@ _OP_CLASSES = {
     "slice_apply": REBALANCE,
     "slice_drop": REBALANCE,
     "slice_watch": REBALANCE,
+    # live schema migration control plane (migration/migrator.py):
+    # operator-driven bulk work, cost-accounted and sheddable beneath
+    # tenant traffic exactly like the tuple mover's slice ops
+    "migrate_begin": REBALANCE,
+    "migrate_status": REBALANCE,
+    "migrate_cut": REBALANCE,
+    "migrate_abort": REBALANCE,
 }
 
 

@@ -75,6 +75,7 @@ from .invariants import (
     KIND_CHECK,
     KIND_DELETE,
     KIND_LOOKUP,
+    KIND_MIGRATION_PROBE,
     KIND_WRITE,
     OUTCOME_ERROR,
     OUTCOME_OK,
@@ -111,6 +112,46 @@ relationships: ""
 
 NS_COUNT = 8  # static namespaces the load spreads over
 FAULT_GROUP = 1  # the browned-out group; group 0 takes the SIGKILL
+
+# the live-migration episode's REWRITING target: the same schema with a
+# caveat trait attached to pod.viewer (an allowed-subject gain on a live
+# relation — the exact change class that forces dual-compile + backfill
+# instead of a metadata-only flip). The affected closure is
+# pod#viewer/pod#view; namespace#view and pod#edit stay outside it and
+# carry the no-verdict-flap obligation through the cut.
+MIGRATED_SCHEMA = """\
+caveat probation(level int) {
+  level < 3
+}
+
+definition user {}
+
+definition namespace {
+  relation creator: user
+  relation viewer: user
+  permission admin = creator
+  permission view = viewer + creator
+}
+
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user | user with probation
+  permission edit = creator
+  permission view = viewer + creator + namespace->view
+}
+"""
+
+
+def _migration_target_text() -> str:
+    """The bootstrap path auto-appends the workflow definitions to every
+    engine's schema (models/bootstrap.py); the migration target needs
+    the same three or the diff would classify them as removed."""
+    from ..models.bootstrap import WORKFLOW_DEFS
+
+    return "\n".join([MIGRATED_SCHEMA]
+                     + [WORKFLOW_DEFS[n]
+                        for n in ("lock", "workflow", "activity")])
 
 # episode shapes: (schedule seconds, baseline arrivals/second)
 EPISODE_SHAPES = {"short": (1.2, 80.0), "standard": (4.0, 150.0)}
@@ -907,6 +948,9 @@ class Campaign:
         # episode 3: SIGKILL group 0's leader mid-schedule, failover,
         # restart, split-journal recovery
         if not topo.supports_crash:
+            # the migration episode still runs (episode 4 below) — its
+            # in-process shape just has no SIGKILL-mid-backfill leg
+            self.migration_episode(seed, state)
             return
         victim: list = []
 
@@ -929,6 +973,125 @@ class Campaign:
             pending_splits=pending)
         self._finish_episode(ev, {
             "load": stats,
+            "killed": (f"group{victim[0][0]}/peer{victim[0][1]}"
+                       if victim else None),
+        })
+
+        # episode 4: live schema migration under load, SIGKILL
+        # mid-backfill, re-begin after the boot-abort
+        self.migration_episode(seed, state)
+
+    # -- live schema migration episode ---------------------------------------
+
+    # (probe key, CheckItem, inside the migration's affected closure?)
+    _MIGRATION_PROBES = (
+        ("namespace:ns0#view@user:owner0",
+         ("namespace", "ns0", "view", "user", "owner0"), False),
+        ("namespace:ns1#view@user:intruder-mig",
+         ("namespace", "ns1", "view", "user", "intruder-mig"), False),
+        ("pod:ns2/p0#edit@user:direct2",
+         ("pod", "ns2/p0", "edit", "user", "direct2"), False),
+        ("pod:ns0/p0#view@user:direct0",
+         ("pod", "ns0/p0", "view", "user", "direct0"), True),
+    )
+
+    def _migration_terminal(self, budget: float = 60.0) -> Optional[dict]:
+        """Poll the planner's aggregate status to a terminal phase."""
+        planner = self.topology.planner
+        deadline = time.monotonic() + budget
+        while True:
+            st = planner.migration_status()
+            if st is None or st.get("phase") in ("done", "failed",
+                                                 "aborted"):
+                return st
+            if time.monotonic() >= deadline:
+                return st
+            time.sleep(0.1)
+
+    def migration_episode(self, seed: int, state: _SeedState) -> None:
+        """Episode 4: a REWRITING schema migration (caveat attached to
+        the live pod.viewer relation) begun mid-load, with steady
+        verdict probes running before/during/after the coordinated cut.
+        On crash-capable topologies, group 0's leader takes a SIGKILL
+        mid-backfill; the interrupted attempt must resolve by the crash
+        matrix (boot-abort, no cut persisted) and a re-begin must then
+        complete — with zero verdict flaps outside the affected closure
+        across the WHOLE window, both attempts included."""
+        topo = self.topology
+        planner = topo.planner
+        records: list = []
+        lock = threading.Lock()
+        stop = threading.Event()
+        target = _migration_target_text()
+
+        def probe_loop():
+            while not stop.is_set():
+                for key, args, _aff in self._MIGRATION_PROBES:
+                    try:
+                        v = bool(planner.check(CheckItem(*args)))
+                        self._record(records, lock, OpRecord(
+                            KIND_MIGRATION_PROBE, OUTCOME_OK,
+                            seq=next(state.seq), key=key, verdict=v))
+                    except Exception as e:  # noqa: BLE001 - availability
+                        self._record(records, lock, OpRecord(
+                            KIND_MIGRATION_PROBE, OUTCOME_ERROR,
+                            seq=next(state.seq), key=key, error=str(e)))
+                time.sleep(0.03)
+
+        crash = topo.supports_crash
+        victim: list = []
+
+        def begin():
+            try:
+                # a paced backfill on crash topologies keeps the window
+                # open long enough for the SIGKILL to land MID-backfill
+                planner.begin_schema_migration(
+                    target,
+                    **({"batch": 4, "backfill_pause": 0.15}
+                       if crash else {}))
+            except Exception as e:  # noqa: BLE001 - judged below
+                log.warning("migration begin failed: %s", e)
+            if crash:
+                try:
+                    victim.append(topo.kill_group_leader(0))
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    log.warning("mid-backfill kill failed: %s", e)
+
+        prober = threading.Thread(target=probe_loop, daemon=True)
+        prober.start()
+        try:
+            stats = self._drive(seed, "migration", state, records,
+                                mid_run=begin)
+            st = self._migration_terminal()
+            attempts = 1
+            if crash:
+                topo.wait_group_leader(0)
+                if victim:
+                    topo.restart(*victim[0])
+                st = self._migration_terminal()
+                if st is None or st.get("phase") != "done":
+                    # the interrupted attempt boot-aborted by the crash
+                    # matrix; the operator's re-begin must complete
+                    attempts += 1
+                    planner.begin_schema_migration(target, wait=True,
+                                                   timeout=90.0)
+                    st = self._migration_terminal()
+        finally:
+            stop.set()
+            prober.join(timeout=10.0)
+        pending = self._drain_pending_splits()
+        affected = frozenset(k for k, _a, aff in self._MIGRATION_PROBES
+                             if aff)
+        ev = EpisodeEvidence(
+            name=f"seed{seed}/migration", records=records,
+            readback=self._readback(state),
+            pending_splits=pending,
+            migration_affected=affected,
+            migration_status=st)
+        self._finish_episode(ev, {
+            "load": stats,
+            "migration_phase": (st or {}).get("phase"),
+            "migration_attempts": attempts,
             "killed": (f"group{victim[0][0]}/peer{victim[0][1]}"
                        if victim else None),
         })

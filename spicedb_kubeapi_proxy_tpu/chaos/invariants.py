@@ -43,6 +43,11 @@ KIND_CHECK = "check"
 KIND_WRITE = "write"
 KIND_DELETE = "delete"
 KIND_LOOKUP = "lookup"
+# steady-state probes issued through a live schema migration window:
+# the campaign mutates NOTHING these probes depend on, so their
+# verdicts carry a flap obligation the ordinary check records (whose
+# tuples the campaign churns) cannot
+KIND_MIGRATION_PROBE = "migration_probe"
 
 OUTCOME_OK = "ok"
 OUTCOME_SHED = "shed"
@@ -99,6 +104,13 @@ class EpisodeEvidence:
     # episode's recovery completed (None when cleared — or when the
     # episode ran no rebalance and the field carries no obligation)
     rebalance_transition: Optional[dict] = None
+    # live schema migration window (migration/migrator.py): the probe
+    # keys the migration's diff marked AFFECTED (these may legitimately
+    # change verdict across the cut; every other migration_probe key
+    # must not), and the engine's migration status after the episode's
+    # recovery completed (None = no migration ran)
+    migration_affected: frozenset = frozenset()
+    migration_status: Optional[dict] = None
 
 
 def check_never_fail_open(records: list) -> list[InvariantViolation]:
@@ -214,6 +226,58 @@ def check_rebalance_converged(transition_doc: Optional[dict]
         "cleanly aborted")]
 
 
+def check_no_verdict_flap(records: list,
+                          affected: frozenset = frozenset()
+                          ) -> list[InvariantViolation]:
+    """Through a live schema migration, a probe for a permission the
+    migration's diff did NOT mark affected must answer the SAME verdict
+    before, during, and after the cut — any flip means the cutover
+    leaked schema-change effects outside the affected closure (a stale
+    decision-cache entry surviving retire_affected, or the new graph
+    disagreeing with the old on untouched reachability). Probes for
+    AFFECTED keys are exempt: changing their verdict is the migration's
+    entire point. Error/shed outcomes carry no obligation — a fault may
+    cost availability, never verdict stability."""
+    out: list[InvariantViolation] = []
+    first: dict[str, "tuple[int, bool]"] = {}
+    for r in sorted(records, key=lambda r: r.seq):
+        if r.kind != KIND_MIGRATION_PROBE or r.outcome != OUTCOME_OK \
+                or r.verdict is None or not r.key:
+            continue
+        if r.key in affected:
+            continue
+        seen = first.get(r.key)
+        if seen is None:
+            first[r.key] = (r.seq, r.verdict)
+        elif r.verdict != seen[1]:
+            out.append(InvariantViolation(
+                "no-verdict-flap",
+                f"unaffected probe {r.key!r} flipped "
+                f"{seen[1]}->{r.verdict} at seq {r.seq} (first seen at "
+                f"seq {seen[0]}) across the migration window"))
+    return out
+
+
+def check_migration_converged(status: Optional[dict]
+                              ) -> list[InvariantViolation]:
+    """A crash-interrupted schema migration must land DONE (cut
+    persisted and finished) or CLEANLY ABORTED — the same all-or-
+    nothing obligation the rebalance transition carries. A status still
+    parked in a working phase after the episode's recovery finished
+    means the engine serves with a half-applied schema change."""
+    if status is None:
+        return []
+    phase = status.get("phase")
+    if phase in ("done", "aborted"):
+        return []
+    return [InvariantViolation(
+        "migration-converged",
+        f"schema migration still in phase {phase!r} after recovery — "
+        "neither completed nor cleanly aborted"
+        + (f" (error: {status.get('error')})" if status.get("error")
+           else ""))]
+
+
 def retry_amplification_bound(ratio: float, burst: float,
                               attempts: int, slack: float = 2.0) -> float:
     """The budget's worst-case total-retry bound for ``attempts``
@@ -252,14 +316,18 @@ def check_all(ev: EpisodeEvidence) -> list[InvariantViolation]:
     out += check_retry_amplification(ev.retries_observed, ev.budget_ratio,
                                      ev.budget_burst, ev.attempts)
     out += check_rebalance_converged(ev.rebalance_transition)
+    out += check_no_verdict_flap(ev.records, ev.migration_affected)
+    out += check_migration_converged(ev.migration_status)
     return out
 
 
 __all__ = [
     "EpisodeEvidence", "InvariantViolation", "OpRecord",
-    "KIND_CHECK", "KIND_DELETE", "KIND_LOOKUP", "KIND_WRITE",
+    "KIND_CHECK", "KIND_DELETE", "KIND_LOOKUP",
+    "KIND_MIGRATION_PROBE", "KIND_WRITE",
     "OUTCOME_ERROR", "OUTCOME_OK", "OUTCOME_SHED",
-    "check_all", "check_never_fail_open", "check_no_stale_verdict",
+    "check_all", "check_migration_converged", "check_never_fail_open",
+    "check_no_stale_verdict", "check_no_verdict_flap",
     "check_rebalance_converged", "check_retry_amplification",
     "check_split_journal_complete", "check_zero_acked_write_loss",
     "retry_amplification_bound",

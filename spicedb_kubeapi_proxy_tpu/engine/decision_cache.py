@@ -258,6 +258,42 @@ class DecisionCache:
             metrics.gauge("engine_decision_cache_mask_bytes").dec(freed)
         return dropped
 
+    def retire_affected(self, affected) -> int:
+        """Drop only the entries whose query lies inside a schema diff's
+        ``affected`` set of ``(resource_type, permission-or-relation)``
+        pairs — the migration cutover's surgical alternative to a full
+        flush. A check key carries the resource type at ``key[2]`` and
+        the permission at ``key[4]``; a lookup key carries them at
+        ``key[2]``/``key[3]``. Everything outside the set keeps its
+        verdicts: the cutover swap preserves the store revision, so
+        surviving keys stay exactly probe-valid — and the no-verdict-flap
+        invariant depends on them answering identically across the flip.
+        Returns the number of entries dropped."""
+        affected = frozenset(affected)
+        if not affected:
+            return 0
+        dropped = 0
+        freed = 0
+        for sh in self._shards:
+            with sh.lock:
+                dead = []
+                for k in sh.entries:
+                    pair = ((k[2], k[4]) if k[0] == "check"
+                            else (k[2], k[3]))
+                    if pair in affected:
+                        dead.append(k)
+                for k in dead:
+                    _, _, nb = sh.entries.pop(k)
+                    sh.mask_bytes -= nb
+                    freed += nb
+                dropped += len(dead)
+        if dropped:
+            metrics.counter("engine_decision_cache_retired_total").inc(
+                dropped)
+            metrics.gauge("engine_decision_cache_entries").dec(dropped)
+            metrics.gauge("engine_decision_cache_mask_bytes").dec(freed)
+        return dropped
+
     def stats(self) -> dict:
         with_entries = sum(len(sh.entries) for sh in self._shards)
         return {

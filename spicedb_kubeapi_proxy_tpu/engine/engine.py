@@ -104,6 +104,130 @@ def mask_pseudo_objects(mask: np.ndarray) -> np.ndarray:
     return mask
 
 
+def validate_caveat(schema: Schema, rel: Relationship) -> None:
+    """A caveated write must name a DECLARED caveat and carry a
+    context that encodes under the declared parameter types — a
+    malformed context stored now would become missing-context
+    denials (or a recompile-time error) at read time. Module-level so
+    the schema migrator can re-validate stored tuples against a
+    CANDIDATE schema without mutating any engine."""
+    from ..caveats.ast import (
+        CaveatError,
+        StringInterner,
+        UnencodableListError,
+        encode_list,
+        encode_scalar,
+    )
+
+    cdef = (schema.caveat_defs or {}).get(rel.caveat)
+    if cdef is None:
+        raise SchemaViolation(
+            f"relationship names undeclared caveat {rel.caveat!r}")
+    if not rel.caveat_context:
+        return
+    try:
+        ctx = rel.context_dict()
+    except ValueError as e:
+        raise SchemaViolation(
+            f"caveat {rel.caveat!r}: invalid context: {e}") from None
+    scratch = StringInterner()
+    for k, v in (ctx or {}).items():
+        p = cdef.param(k)
+        if p is None:
+            raise SchemaViolation(
+                f"caveat {rel.caveat!r} has no parameter {k!r}")
+        try:
+            if p.type.is_list:
+                encode_list(v, p.type.elem, scratch)
+            else:
+                encode_scalar(v, p.type.name, scratch)
+        except UnencodableListError:
+            # well-typed but beyond the VM's list tables (an IPv6
+            # element): the write is accepted — the parameter
+            # resolves UNKNOWN at evaluation (fail closed, counted)
+            pass
+        except CaveatError as e:
+            raise SchemaViolation(
+                f"caveat {rel.caveat!r} context {k!r}: {e}") from None
+
+
+def validate_relationship(schema: Schema, rel: Relationship) -> None:
+    """Schema admission for one relationship tuple — the write path's
+    gate, factored to take the schema EXPLICITLY so the migrator can ask
+    "does every stored tuple still parse under S'?" before it commits to
+    a transition."""
+    if getattr(rel, "caveat", None):
+        validate_caveat(schema, rel)
+    d = schema.definitions.get(rel.resource_type)
+    if d is None:
+        raise SchemaViolation(f"unknown resource type {rel.resource_type!r}")
+    if rel.resource_id == "*":
+        # SpiceDB forbids wildcard resource ids; only subjects may be '*'
+        raise SchemaViolation("resource id may not be the wildcard '*'")
+    r = d.relations.get(rel.relation)
+    if r is None:
+        raise SchemaViolation(
+            f"{rel.resource_type} has no relation {rel.relation!r}"
+            + (" (permissions are not writable)"
+               if rel.relation in d.permissions else "")
+        )
+    sub_def = schema.definitions.get(rel.subject_type)
+    if sub_def is None:
+        raise SchemaViolation(f"unknown subject type {rel.subject_type!r}")
+    ok = False
+    expiration_blocked = False
+    caveat_blocked = False
+    for a in r.allowed:
+        if a.type != rel.subject_type:
+            continue
+        if rel.subject_id == "*":
+            if not a.wildcard:
+                continue
+        elif a.wildcard or (a.relation or None) != rel.subject_relation:
+            continue
+        if (a.caveat or None) != (rel.caveat or None):
+            # SpiceDB matches the caveat trait exactly: a caveated
+            # tuple needs a `with <caveat>` entry, and an entry
+            # REQUIRING a caveat never accepts an unconditional
+            # tuple — another entry of the same subject type may
+            # still match (`user | user with ip_allowlist`)
+            caveat_blocked = True
+            continue
+        if rel.expiration is not None and not a.expiration:
+            # another allowed entry of the same subject type may carry
+            # the expiration trait (e.g. `user | user with expiration`)
+            # — keep scanning instead of rejecting on the first match
+            expiration_blocked = True
+            continue
+        ok = True
+        break
+    if not ok and expiration_blocked:
+        raise SchemaViolation(
+            f"{rel.resource_type}#{rel.relation} does not allow "
+            "expiring relationships"
+        )
+    if not ok and caveat_blocked:
+        raise SchemaViolation(
+            f"{rel.resource_type}#{rel.relation} does not allow "
+            + (f"subjects with caveat {rel.caveat!r}" if rel.caveat
+               else "uncaveated subjects of this type")
+        )
+    if not ok:
+        raise SchemaViolation(
+            f"subject {rel.subject_type}"
+            + (f"#{rel.subject_relation}" if rel.subject_relation else "")
+            + f" not allowed on {rel.resource_type}#{rel.relation}"
+        )
+    if rel.subject_relation:
+        if not schema.definitions[rel.subject_type].relation_or_permission(
+            rel.subject_relation
+        ):
+            raise SchemaViolation(
+                f"{rel.subject_type} has no relation "
+                f"{rel.subject_relation!r}"
+            )
+
+
 class EngineFuture:
     """A dispatched engine query: ``result()`` blocks and post-processes.
     ``fut`` is a :class:`~...ops.reachability.QueryFuture` or ``None`` for
@@ -183,6 +307,15 @@ class Engine:
         # through a ShardedGraph pinned across it instead of one device
         self.mesh = mesh
         self._sharded = None
+        # live schema migration (migration/migrator.py): the active
+        # SchemaMigrator, the brief-freeze write gate it installs for
+        # the atomic cutover, and the set of backfill-echo revisions
+        # watch streams must suppress (a journaled backfill TOUCH of
+        # identical content still logs a WatchRecord; replaying it to
+        # watchers would break exactly-once across the cut)
+        self._migrator = None
+        self._write_gate = None
+        self._watch_suppress: frozenset = frozenset()
         # XLA compilation is the engine's biggest latency cliff and the
         # one event it cannot time itself; the jax monitoring listener
         # mirrors compile events into the metrics registry (obs/profile)
@@ -297,120 +430,10 @@ class Engine:
     # -- write path ---------------------------------------------------------
 
     def _validate_caveat(self, rel: Relationship) -> None:
-        """A caveated write must name a DECLARED caveat and carry a
-        context that encodes under the declared parameter types — a
-        malformed context stored now would become missing-context
-        denials (or a recompile-time error) at read time."""
-        from ..caveats.ast import (
-            CaveatError,
-            StringInterner,
-            UnencodableListError,
-            encode_list,
-            encode_scalar,
-        )
-
-        cdef = (self.schema.caveat_defs or {}).get(rel.caveat)
-        if cdef is None:
-            raise SchemaViolation(
-                f"relationship names undeclared caveat {rel.caveat!r}")
-        if not rel.caveat_context:
-            return
-        try:
-            ctx = rel.context_dict()
-        except ValueError as e:
-            raise SchemaViolation(
-                f"caveat {rel.caveat!r}: invalid context: {e}") from None
-        scratch = StringInterner()
-        for k, v in (ctx or {}).items():
-            p = cdef.param(k)
-            if p is None:
-                raise SchemaViolation(
-                    f"caveat {rel.caveat!r} has no parameter {k!r}")
-            try:
-                if p.type.is_list:
-                    encode_list(v, p.type.elem, scratch)
-                else:
-                    encode_scalar(v, p.type.name, scratch)
-            except UnencodableListError:
-                # well-typed but beyond the VM's list tables (an IPv6
-                # element): the write is accepted — the parameter
-                # resolves UNKNOWN at evaluation (fail closed, counted)
-                pass
-            except CaveatError as e:
-                raise SchemaViolation(
-                    f"caveat {rel.caveat!r} context {k!r}: {e}") from None
+        validate_caveat(self.schema, rel)
 
     def _validate(self, rel: Relationship) -> None:
-        if getattr(rel, "caveat", None):
-            self._validate_caveat(rel)
-        d = self.schema.definitions.get(rel.resource_type)
-        if d is None:
-            raise SchemaViolation(f"unknown resource type {rel.resource_type!r}")
-        if rel.resource_id == "*":
-            # SpiceDB forbids wildcard resource ids; only subjects may be '*'
-            raise SchemaViolation("resource id may not be the wildcard '*'")
-        r = d.relations.get(rel.relation)
-        if r is None:
-            raise SchemaViolation(
-                f"{rel.resource_type} has no relation {rel.relation!r}"
-                + (" (permissions are not writable)"
-                   if rel.relation in d.permissions else "")
-            )
-        sub_def = self.schema.definitions.get(rel.subject_type)
-        if sub_def is None:
-            raise SchemaViolation(f"unknown subject type {rel.subject_type!r}")
-        ok = False
-        expiration_blocked = False
-        caveat_blocked = False
-        for a in r.allowed:
-            if a.type != rel.subject_type:
-                continue
-            if rel.subject_id == "*":
-                if not a.wildcard:
-                    continue
-            elif a.wildcard or (a.relation or None) != rel.subject_relation:
-                continue
-            if (a.caveat or None) != (rel.caveat or None):
-                # SpiceDB matches the caveat trait exactly: a caveated
-                # tuple needs a `with <caveat>` entry, and an entry
-                # REQUIRING a caveat never accepts an unconditional
-                # tuple — another entry of the same subject type may
-                # still match (`user | user with ip_allowlist`)
-                caveat_blocked = True
-                continue
-            if rel.expiration is not None and not a.expiration:
-                # another allowed entry of the same subject type may carry
-                # the expiration trait (e.g. `user | user with expiration`)
-                # — keep scanning instead of rejecting on the first match
-                expiration_blocked = True
-                continue
-            ok = True
-            break
-        if not ok and expiration_blocked:
-            raise SchemaViolation(
-                f"{rel.resource_type}#{rel.relation} does not allow "
-                "expiring relationships"
-            )
-        if not ok and caveat_blocked:
-            raise SchemaViolation(
-                f"{rel.resource_type}#{rel.relation} does not allow "
-                + (f"subjects with caveat {rel.caveat!r}" if rel.caveat
-                   else "uncaveated subjects of this type")
-            )
-        if not ok:
-            raise SchemaViolation(
-                f"subject {rel.subject_type}"
-                + (f"#{rel.subject_relation}" if rel.subject_relation else "")
-                + f" not allowed on {rel.resource_type}#{rel.relation}"
-            )
-        if rel.subject_relation:
-            if not self.schema.definitions[rel.subject_type].relation_or_permission(
-                rel.subject_relation
-            ):
-                raise SchemaViolation(
-                    f"{rel.subject_type} has no relation "
-                    f"{rel.subject_relation!r}"
-                )
+        validate_relationship(self.schema, rel)
 
     def write_relationships(self, ops: list[WriteOp],
                             preconditions: list[Precondition] = (),
@@ -420,8 +443,15 @@ class Engine:
                 self._validate(op.rel)
         if _headroom:
             self._write_headroom(len(ops))
-        rev = self.store.write(list(ops), list(preconditions))
-        self._advance_incremental()
+        gate = self._write_gate
+        if gate is not None:
+            gate.enter()
+        try:
+            rev = self.store.write(list(ops), list(preconditions))
+            self._advance_incremental()
+        finally:
+            if gate is not None:
+                gate.exit()
         return rev
 
     def delete_relationships(self, f: RelationshipFilter,
@@ -433,8 +463,15 @@ class Engine:
         # a counted full recompile, it just isn't shed preemptively)
         if _headroom:
             self._write_headroom(1)
-        n = self.store.delete_by_filter(f, list(preconditions))
-        self._advance_incremental()
+        gate = self._write_gate
+        if gate is not None:
+            gate.enter()
+        try:
+            n = self.store.delete_by_filter(f, list(preconditions))
+            self._advance_incremental()
+        finally:
+            if gate is not None:
+                gate.exit()
         return n
 
     def _write_headroom(self, n_records: int) -> None:
@@ -1302,20 +1339,114 @@ class Engine:
         return self.store.revision
 
     def watch_since(self, revision: int) -> list[WatchEvent]:
+        sup = self._watch_suppress
         return [
             WatchEvent(r.revision, "touch" if r.op == 2 else "delete", r.rel)
             for r in self.store.watch_since(revision)
+            if r.revision not in sup
         ]
 
     def wait_events(self, revision: int, timeout: float) -> list[WatchEvent]:
         """Block until events past ``revision`` land (or ``timeout`` — then
         ``[]``). The push-latency form of :meth:`watch_since`: the watch
         hub parks ONE thread here per engine instead of every watcher
-        polling on an interval."""
+        polling on an interval. Migration-backfill echo revisions are
+        filtered here too (an empty list after a suppressed-only batch
+        just looks like a timeout to the hub, which re-parks)."""
+        sup = self._watch_suppress
         return [
             WatchEvent(r.revision, "touch" if r.op == 2 else "delete", r.rel)
             for r in self.store.wait_since(revision, timeout)
+            if r.revision not in sup
         ]
+
+    # -- live schema migration (migration/migrator.py) -----------------------
+
+    def begin_schema_migration(self, schema_text: str,
+                               record_path: Optional[str] = None,
+                               wait: bool = False, **cfg) -> dict:
+        """Start a zero-downtime migration of this engine to the schema
+        in ``schema_text``: diff-classify, dual-compile, journaled
+        backfill, and an atomic revision-preserving cutover. Returns the
+        initial status dict; ``wait=True`` blocks until done/failed.
+        Raises :class:`~...models.schema.IncompatibleSchemaChange` (a
+        ``SchemaError``) before any state changes when the transition is
+        not performable online."""
+        from ..migration import SchemaMigrator
+
+        with self._lock:
+            if self._migrator is not None and self._migrator.active:
+                raise StoreError("a schema migration is already running")
+            prev = self._migrator
+            m = SchemaMigrator(self, schema_text,
+                               record_path=record_path
+                               or self._default_migration_record(), **cfg)
+            self._migrator = m
+        try:
+            m.start()
+        except BaseException:
+            # a refused plan (e.g. incompatible diff) must not leave a
+            # never-started migrator installed as "active" — that would
+            # refuse every future begin
+            with self._lock:
+                if self._migrator is m:
+                    self._migrator = prev
+            raise
+        if wait:
+            m.join()
+        return m.status()
+
+    def _default_migration_record(self) -> Optional[str]:
+        """Persist the migration phase machine beside the WAL when the
+        engine is durable; memory-only engines migrate without a record
+        (a crash loses the store anyway, so there is nothing to replay
+        the phases against)."""
+        p = self._persistence
+        d = getattr(p, "data_dir", None) if p is not None else None
+        if d is None:
+            return None
+        import os
+
+        return os.path.join(d, "migration.json")
+
+    def migration_status(self) -> Optional[dict]:
+        """Phase/lag status of the running (or last) migration, or
+        ``None`` when this engine never migrated — the /readyz and
+        remote-op probe surface."""
+        m = self._migrator
+        return None if m is None else m.status()
+
+    def abort_schema_migration(self) -> dict:
+        """Abort the running migration (refused once any cut happened —
+        the same one-way rule as the rebalancer's transition)."""
+        m = self._migrator
+        if m is None:
+            raise StoreError("no schema migration to abort")
+        return m.abort()
+
+    def cut_schema_migration(self, wait: bool = True) -> dict:
+        """Release a migration holding at the dual phase into its
+        cutover (the planner's coordinated-cut hook). Idempotent: a
+        migration already cut (or done) returns its status."""
+        m = self._migrator
+        if m is None:
+            raise StoreError("no schema migration to cut")
+        m.request_cut()
+        if wait:
+            m.join()
+        return m.status()
+
+    def recover_schema_migration(self,
+                                 record_path: Optional[str] = None
+                                 ) -> Optional[dict]:
+        """Boot-time crash matrix: consult the persisted migration
+        record (if any) and either cleanly abort (no cut persisted) or
+        resume/finish the cutover (cut persisted). Returns the recovery
+        outcome dict or ``None`` when there was nothing to recover."""
+        from ..migration import recover
+
+        return recover(self, record_path
+                       or self._default_migration_record())
 
     # -- debugging ----------------------------------------------------------
 

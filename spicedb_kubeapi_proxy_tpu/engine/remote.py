@@ -63,6 +63,7 @@ from ..utils.resilience import (
     RetryPolicy,
 )
 
+from ..models.schema import SchemaError
 from ..models.tuples import Relationship
 from .engine import CheckItem, Engine, SchemaViolation, WatchEvent
 from .store import (
@@ -159,6 +160,11 @@ _IDEMPOTENT_OPS = frozenset({
     # group leader produces
     "slice_read", "slice_load", "slice_apply", "slice_drop",
     "slice_watch",
+    # migration control reads + level-triggered controls: status is a
+    # pure read; cut/abort converge to the same terminal state however
+    # many times they land. migrate_begin is NOT here — a replay would
+    # race the single-active-migration refusal.
+    "migrate_status", "migrate_cut", "migrate_abort",
 })
 
 # "the transport failed" (vs the engine answering with an error): socket
@@ -667,6 +673,12 @@ class EngineServer:
             return {"ok": False, "kind": "precondition", "error": str(e)}
         except SchemaViolation as e:
             return {"ok": False, "kind": "schema", "error": str(e)}
+        except SchemaError as e:
+            # migrate_begin's typed incompatible refusal (and any parse
+            # error in the proposed schema) is a SCHEMA answer, not a
+            # host-side failure — kind "internal" would invite retries
+            # against a permanent condition
+            return {"ok": False, "kind": "schema", "error": str(e)}
         except StoreError as e:
             return {"ok": False, "kind": "store", "error": str(e)}
         except Exception as e:
@@ -1039,6 +1051,42 @@ class EngineServer:
         return {"sites": failpoints.status(),
                 "history": failpoints.history(),
                 "history_digest": failpoints.history_digest()}
+
+    # -- live schema migration control plane (migration/migrator.py) ---------
+    # Admission-classed `rebalance` like the slice ops: a migration is
+    # operator-driven bulk work, cost-accounted and sheddable beneath
+    # tenant traffic. begin is NOT idempotent (a replay would race the
+    # active-migration refusal); status/cut/abort are.
+
+    def _op_migrate_begin(self, req: dict):
+        """Start a live migration to the supplied schema text. The diff
+        classification (and a typed incompatible refusal) happens on
+        this call's stack — before any state change — so the caller gets
+        the refusal reasons synchronously; the phase machine then runs
+        in a background thread on this host."""
+        kwargs = {}
+        for k in ("batch", "parity_samples"):
+            if req.get(k) is not None:
+                kwargs[k] = int(req[k])
+        if req.get("hold_at_dual") is not None:
+            kwargs["hold_at_dual"] = bool(req["hold_at_dual"])
+        if req.get("backfill_pause") is not None:
+            kwargs["backfill_pause"] = float(req["backfill_pause"])
+        return self.engine.begin_schema_migration(
+            req["schema_text"], wait=bool(req.get("wait")), **kwargs)
+
+    def _op_migrate_status(self, req: dict):
+        return self.engine.migration_status()
+
+    def _op_migrate_cut(self, req: dict):
+        """Release a ``hold_at_dual`` migration into its cut; idempotent
+        — re-requesting the cut of an already-cut (or done) migration
+        just reports its status."""
+        return self.engine.cut_schema_migration(
+            wait=bool(req.get("wait", True)))
+
+    def _op_migrate_abort(self, req: dict):
+        return self.engine.abort_schema_migration()
 
 
 # -- client ------------------------------------------------------------------
@@ -1641,6 +1689,36 @@ class RemoteEngine:
     def chaos_status(self) -> dict:
         return self._call("chaos_status")
 
+    # live schema migration control plane (migration/migrator.py)
+
+    def migrate_begin(self, schema_text: str, *,
+                      hold_at_dual: Optional[bool] = None,
+                      batch: Optional[int] = None,
+                      backfill_pause: Optional[float] = None,
+                      parity_samples: Optional[int] = None,
+                      wait: bool = False) -> dict:
+        """Begin a live schema migration on the host. Single-attempt
+        (NOT idempotent: a replay would race the host's single-active-
+        migration refusal); an incompatible change surfaces as the
+        host's typed SchemaError before any state change."""
+        return self._call(
+            "migrate_begin", schema_text=schema_text,
+            hold_at_dual=hold_at_dual, batch=batch,
+            backfill_pause=backfill_pause,
+            parity_samples=parity_samples, wait=wait)
+
+    def migrate_status(self) -> Optional[dict]:
+        return self._call("migrate_status")
+
+    def migrate_cut(self, wait: bool = True) -> dict:
+        """Release a ``hold_at_dual`` migration into its cut
+        (idempotent — the planner's coordinated-cut hook retries this
+        through leader churn)."""
+        return self._call("migrate_cut", wait=wait)
+
+    def migrate_abort(self) -> dict:
+        return self._call("migrate_abort")
+
 
 # -- client-side engine failover ----------------------------------------------
 
@@ -1950,6 +2028,23 @@ class FailoverEngine:
 
     def slice_watch_since(self, revision: int) -> list:
         return self._invoke(lambda c: c.slice_watch_since(revision))
+
+    # migration control plane: begin follows the WRITE discipline (no
+    # re-issue after an ambiguous death — a replay races the host's
+    # single-active-migration refusal); status/cut/abort are
+    # level-triggered and re-aim like reads
+    def migrate_begin(self, schema_text: str, **kw) -> dict:
+        return self._invoke(lambda c: c.migrate_begin(schema_text, **kw),
+                            write=True)
+
+    def migrate_status(self) -> Optional[dict]:
+        return self._invoke(lambda c: c.migrate_status())
+
+    def migrate_cut(self, wait: bool = True) -> dict:
+        return self._invoke(lambda c: c.migrate_cut(wait=wait))
+
+    def migrate_abort(self) -> dict:
+        return self._invoke(lambda c: c.migrate_abort())
 
     def fetch_traces(self, limit: int = 64) -> list:
         """Trace fragments from EVERY reachable endpoint (a re-aimed
@@ -2349,6 +2444,13 @@ def main(argv=None) -> int:
                  "records replayed)", args.data_dir,
                  persistence.recovery.revision,
                  persistence.recovery.replayed_records)
+        # boot crash matrix for a live schema migration killed mid-flight
+        # (migration/migrator.py): no persisted cut -> clean abort, cut
+        # persisted -> finish the cutover under the new schema
+        mig = engine.recover_schema_migration()
+        if mig is not None:
+            log.info("schema migration record recovered: %s (phase %s)",
+                     mig.get("action"), mig.get("phase"))
     if args.lookup_batch_window > 0:
         engine.enable_lookup_batching(args.lookup_batch_window)
     if args.authz_cache:

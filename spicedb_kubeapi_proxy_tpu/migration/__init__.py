@@ -1,0 +1,23 @@
+"""Zero-downtime live schema migration (ISSUE 19 / ROADMAP item 5).
+
+The last restart-only operation in the system — a schema change — made
+online: diff-classify S -> S', dual-compile the new graph beside the
+old, backfill affected tuples through the journaled write path, and cut
+atomically at a revision with decision-cache and watch continuity. The
+phase machine persists before every routing-effect change, exactly like
+the rebalancer's transition record (scaleout/rebalance.py).
+"""
+
+from .migrator import (  # noqa: F401
+    ABORTED,
+    BACKFILL,
+    COMPILING,
+    CUT,
+    DONE,
+    DUAL,
+    FAILED,
+    PLANNED,
+    SchemaMigrator,
+    recover,
+    schema_digest,
+)

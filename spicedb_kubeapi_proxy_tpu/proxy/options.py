@@ -237,6 +237,14 @@ class Options:
     # the fleet from the current map to this one with no drain;
     # progress rides /readyz as `rebalance: moving=K copied=J lag=...`.
     rebalance_to: Optional[str] = None
+    # live schema migration (migration/): a schema-DSL file to migrate
+    # the serving engine(s) to at boot, with no downtime — diff
+    # classification (a typed refusal for incompatible changes),
+    # dual-compile, journaled backfill of affected tuples, and an
+    # atomic cutover at a revision. Sharded deployments coordinate the
+    # cut across every group; progress rides /readyz as
+    # `migration: phase=... lag=...`.
+    migrate_schema: Optional[str] = None
     # >0 probes the device backend in a SUBPROCESS with this timeout
     # before building an in-process engine: the remotely-attached TPU
     # plugin HANGS (not errors) when its tunnel is down, which would
@@ -402,6 +410,19 @@ class Options:
             raise OptionsError(
                 "rebalance-to requires --shard-map (it is a transition "
                 "between two shard maps)")
+        if self.migrate_schema:
+            # parse NOW: an unreadable or syntactically-broken target
+            # schema must fail option validation, not surface later as
+            # a failed migration against a serving engine
+            from ..models.schema import SchemaError, parse_schema
+
+            try:
+                with open(self.migrate_schema) as f:
+                    parse_schema(f.read())
+            except OSError as e:
+                raise OptionsError(f"migrate-schema: {e}") from None
+            except SchemaError as e:
+                raise OptionsError(f"migrate-schema: {e}") from None
         if remote is None and self.engine_endpoint not in (EMBEDDED_ENDPOINT,
                                                            TPU_ENDPOINT):
             raise OptionsError(
@@ -798,6 +819,10 @@ class Options:
                     checkpoint_wal_bytes=self.checkpoint_wal_bytes,
                     checkpoint_wal_records=self.checkpoint_wal_records,
                     checkpoint_keep=self.checkpoint_keep)
+                # boot crash matrix for a live schema migration killed
+                # mid-flight (migration/migrator.py): no persisted cut
+                # -> clean abort, cut persisted -> finish the cutover
+                engine.recover_schema_migration()
             else:
                 engine.load_snapshot_if_exists(self.snapshot_path)
             if self.lookup_batch_window > 0:
@@ -806,6 +831,39 @@ class Options:
                 engine.enable_decision_cache(
                     max_entries=self.authz_cache_size,
                     max_mask_bytes=self.authz_cache_mask_bytes)
+        if self.migrate_schema:
+            # start the live migration once the engine is fully
+            # configured (persistence recovered, caches installed):
+            # every engine shape takes it — in-process and sharded via
+            # begin_schema_migration, a tcp:// host via the wire op. An
+            # incompatible change fails BOOT with the typed reasons;
+            # the serving engine never saw any state change.
+            from ..models.schema import SchemaError as _SchemaErr
+
+            with open(self.migrate_schema) as f:
+                _mig_text = f.read()
+            # the bootstrap path auto-appends the workflow definitions
+            # (models/bootstrap.py): give the migration target the same
+            # treatment, or omitting them from the operator's file
+            # would falsely classify as "removed definition"
+            import re as _re
+
+            from ..models.bootstrap import WORKFLOW_DEFS as _WF
+
+            _missing = [n for n in ("lock", "workflow", "activity")
+                        if not _re.search(
+                            rf"definition\s+{n}\b", _mig_text)]
+            if _missing:
+                _mig_text = "\n".join(
+                    [_mig_text] + [_WF[n] for n in _missing])
+            try:
+                if hasattr(engine, "begin_schema_migration"):
+                    engine.begin_schema_migration(_mig_text)
+                else:
+                    engine.migrate_begin(_mig_text)
+            except _SchemaErr as e:
+                raise OptionsError(
+                    f"migrate-schema: {e}") from None
         upstream = self.upstream
         if upstream is None:
             from ..utils.resilience import RetryBudget as _RB
@@ -1001,7 +1059,7 @@ class Options:
         "device_graph_budget_bytes",
         "caveat_context", "caveat_ip_header",
         "shard_map", "shard_journal_path", "shard_cache",
-        "rebalance_to",
+        "rebalance_to", "migrate_schema",
         "upstream_connect_timeout", "upstream_request_deadline",
         "upstream_retries", "engine_connect_timeout", "engine_read_timeout",
         "engine_retries", "breaker_failure_threshold",
@@ -1258,6 +1316,17 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "progress on /readyz as 'rebalance: "
                              "moving=K copied=J lag=...' (see "
                              "docs/operations.md 'Rebalancing')")
+    parser.add_argument("--migrate-schema",
+                        help="live schema migration: a schema-DSL file "
+                             "to migrate the serving engine(s) to at "
+                             "boot with no downtime — classify / "
+                             "dual-compile / journaled backfill / "
+                             "atomic cut at a revision (incompatible "
+                             "changes refuse with typed reasons before "
+                             "any state change); progress on /readyz "
+                             "as 'migration: phase=... lag=...' (see "
+                             "docs/operations.md 'Live schema "
+                             "migration')")
     parser.add_argument("--lock-mode", default=LOCK_MODE_PESSIMISTIC,
                         choices=[LOCK_MODE_PESSIMISTIC, LOCK_MODE_OPTIMISTIC])
     parser.add_argument("--enable-debug-config", action="store_true",
@@ -1470,6 +1539,7 @@ def options_from_args(args: argparse.Namespace) -> Options:
         shard_journal_path=args.shard_journal_path,
         shard_cache=args.shard_cache,
         rebalance_to=args.rebalance_to,
+        migrate_schema=args.migrate_schema,
         engine_probe_timeout=args.engine_probe_timeout,
         enable_debug_config=args.enable_debug_config,
         engine_mesh=args.engine_mesh,

@@ -209,6 +209,25 @@ class Server:
                             f"cut={reb['cut']} lag={reb['lag']}")
                 except Exception:  # noqa: BLE001 - readyz must answer
                     info_lines.append("sharding: status unavailable")
+            # live schema migration (migration/migrator.py): phase/lag
+            # — INFORMATIONAL like rebalance (a migration in flight is
+            # the system changing schemas without downtime, not
+            # unreadiness); covers the sharded planner's aggregate and
+            # the single-engine (in-proc or remote) status alike
+            mig_fn = (getattr(self.deps.engine, "migration_status", None)
+                      or getattr(self.deps.engine, "migrate_status",
+                                 None))
+            if mig_fn is not None:
+                try:
+                    mig = await asyncio.to_thread(mig_fn)
+                except Exception:  # noqa: BLE001 - readyz must answer
+                    mig = None
+                if mig:
+                    info_lines.append(
+                        f"migration: phase={mig.get('phase')} "
+                        f"classification={mig.get('classification')} "
+                        f"lag={mig.get('lag')} "
+                        f"backfilled={mig.get('backfilled')}")
             # admission shed/queue state is INFORMATIONAL: shedding is
             # the overload design working, not unreadiness — pulling a
             # shedding replica from rotation would dump its share of the

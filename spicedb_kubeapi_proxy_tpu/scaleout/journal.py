@@ -65,6 +65,15 @@ class SplitJournal:
             " id INTEGER PRIMARY KEY CHECK (id = 0),"
             " updated REAL NOT NULL,"
             " doc TEXT NOT NULL)")
+        # the planner's coordinated schema-migration record: same
+        # single-row persist-before-effect discipline on the schema axis
+        # (migration/migrator.py holds each group's per-engine record;
+        # this one holds the cross-group cut decision)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS schema_migration ("
+            " id INTEGER PRIMARY KEY CHECK (id = 0),"
+            " updated REAL NOT NULL,"
+            " doc TEXT NOT NULL)")
         self._db.commit()
 
     def _migrate(self) -> None:
@@ -173,6 +182,33 @@ class SplitJournal:
     def clear_transition(self) -> None:
         with self._lock:
             self._db.execute("DELETE FROM rebalance_transition")
+            self._db.commit()
+
+    # -- coordinated schema-migration record ---------------------------------
+
+    def save_migration(self, doc: dict) -> None:
+        """Upsert THE cross-group migration record (one live migration
+        at a time): persisted before the planner issues any
+        routing-effect change to the groups, so a planner crash
+        recovers to the exact coordination phase."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO schema_migration (id, updated, doc) "
+                "VALUES (0, ?, ?) ON CONFLICT(id) DO UPDATE SET "
+                "updated=excluded.updated, doc=excluded.doc",
+                (time.time(), json.dumps(doc)))
+            self._db.commit()
+
+    def load_migration(self) -> "dict | None":
+        with self._lock:
+            row = self._db.execute(
+                "SELECT doc FROM schema_migration WHERE id=0"
+            ).fetchone()
+        return None if row is None else json.loads(row[0])
+
+    def clear_migration(self) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM schema_migration")
             self._db.commit()
 
     def close(self) -> None:

@@ -349,6 +349,11 @@ class ShardedEngine:
         self._active_transition: Optional[MapTransition] = None
         self._archived_transitions: list = []
         self._coordinator: Optional[RebalanceCoordinator] = None
+        # coordinated live schema migration (migration/migrator.py per
+        # group + this planner's cross-group cut): at most one in
+        # flight; the dict is the aggregate status surface
+        self._migration: Optional[dict] = None
+        self._migration_thread: Optional[threading.Thread] = None
         metrics.gauge("scaleout_groups").set(shard_map.n_groups)
         metrics.gauge("scaleout_map_version").set(shard_map.version)
         if journal is not None:
@@ -356,6 +361,11 @@ class ShardedEngine:
             # transition with cut slices changes routing — serving
             # without it would misroute the cut slices' tuples
             self._recover_transition()
+            # schema-migration crash matrix: a persisted "cutting"
+            # record means some group may already serve the new schema
+            # — finish the coordinated cut (idempotent per group);
+            # anything earlier aborts cleanly (no group cut yet)
+            self._recover_migration()
         if recover and journal is not None:
             try:
                 self.recover_splits()
@@ -730,6 +740,209 @@ class ShardedEngine:
     def rebalance_status(self) -> Optional[dict]:
         t = self._active_transition
         return None if t is None else t.progress()
+
+    # -- coordinated live schema migration (migration/migrator.py) -----------
+    # Every group runs its own SchemaMigrator with ``hold_at_dual``; the
+    # planner journals the cross-group decision and releases every group
+    # into its cut only after ALL of them sit at dual with zero lag — so
+    # no request ever scatters across groups evaluating different
+    # schemas past the cut point.
+
+    MIGRATION_POLL = 0.05
+
+    @staticmethod
+    def _mig_begin(client, schema_text: str, **cfg) -> dict:
+        if hasattr(client, "migrate_begin"):
+            return client.migrate_begin(schema_text, hold_at_dual=True,
+                                        **cfg)
+        return client.begin_schema_migration(schema_text,
+                                             hold_at_dual=True, **cfg)
+
+    @staticmethod
+    def _mig_status(client) -> Optional[dict]:
+        if hasattr(client, "migrate_status"):
+            return client.migrate_status()
+        return client.migration_status()
+
+    @staticmethod
+    def _mig_cut(client) -> dict:
+        if hasattr(client, "migrate_cut"):
+            return client.migrate_cut(wait=True)
+        return client.cut_schema_migration(wait=True)
+
+    @staticmethod
+    def _mig_abort(client) -> None:
+        try:
+            if hasattr(client, "migrate_abort"):
+                client.migrate_abort()
+            else:
+                client.abort_schema_migration()
+        except Exception:  # noqa: BLE001 - abort fan-out best-effort
+            pass
+
+    def begin_schema_migration(self, schema_text: str,
+                               wait: bool = False,
+                               timeout: float = 600.0,
+                               **cfg) -> dict:
+        """Coordinated migration of EVERY group to ``schema_text``.
+        Group 0 classifies first — an incompatible change raises its
+        typed :class:`SchemaError` on this stack before any other group
+        changes state. Returns the aggregate status; ``wait=True``
+        blocks through the coordinated cut."""
+        m = self._migration
+        if m is not None and m.get("phase") not in ("done", "aborted",
+                                                    "failed"):
+            raise StoreError("a coordinated schema migration is "
+                             "already running")
+        doc = {"phase": "begin", "schema_text": schema_text,
+               "groups": len(self.groups)}
+        if self.journal is not None:
+            self.journal.save_migration(doc)
+        begun: list = []
+        try:
+            for gi, c in enumerate(self.groups):
+                self._mig_begin(c, schema_text, **cfg)
+                begun.append(gi)
+        except BaseException:
+            # typed refusal (or a group begin failing): no group has cut
+            # — roll every begun group back and clear the record so the
+            # journal never claims a migration that is not running
+            for gi in begun:
+                self._mig_abort(self.groups[gi])
+            if self.journal is not None:
+                self.journal.clear_migration()
+            raise
+        self._migration = {"phase": "dual-wait",
+                           "groups": len(self.groups), "at_dual": 0,
+                           "error": None}
+        if self.journal is not None:
+            doc["phase"] = "dual-wait"
+            self.journal.save_migration(doc)
+        t = threading.Thread(target=self._coordinate_cut,
+                             args=(time.monotonic() + timeout,),
+                             name="schema-migration", daemon=True)
+        self._migration_thread = t
+        t.start()
+        if wait:
+            t.join(timeout)
+        return dict(self._migration)
+
+    def _coordinate_cut(self, deadline: float) -> None:
+        """Poll every group to dual/zero-lag, journal the cut decision,
+        then release all groups (each group's own record makes its cut
+        idempotent under re-issue)."""
+        m = self._migration
+        try:
+            while True:
+                sts = []
+                for c in self.groups:
+                    try:
+                        sts.append(self._mig_status(c))
+                    except Exception:  # noqa: BLE001 - transient
+                        # a group mid-failover: treat as not-ready and
+                        # keep polling (the deadline bounds this); only
+                        # a group ANSWERING failed/aborted/None is a
+                        # definitive coordination failure
+                        sts.append({"phase": "unreachable"})
+                bad = [s for s in sts
+                       if s is None or s.get("phase") in ("failed",
+                                                          "aborted")]
+                if bad:
+                    raise RuntimeError(
+                        f"{len(bad)} group(s) failed/aborted before "
+                        "the coordinated cut")
+                ready = sum(1 for s in sts
+                            if s.get("phase") == "dual"
+                            and not s.get("lag"))
+                m["at_dual"] = ready
+                if ready == len(self.groups):
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"groups at dual: {ready}/{len(self.groups)} "
+                        "when the coordination deadline expired")
+                time.sleep(self.MIGRATION_POLL)
+            # the point of no return is PERSISTED before any group is
+            # released: a planner crash after this line re-issues the
+            # cuts at boot instead of aborting a half-cut fleet
+            if self.journal is not None:
+                self.journal.save_migration(
+                    {"phase": "cutting", "groups": len(self.groups)})
+            m["phase"] = "cutting"
+            for c in self.groups:
+                self._mig_cut(c)
+            m["phase"] = "done"
+            if self.journal is not None:
+                self.journal.clear_migration()
+            metrics.counter("scaleout_schema_migrations_total",
+                            outcome="done").inc()
+        except BaseException as e:  # noqa: BLE001 - worker boundary
+            m["phase"] = "failed"
+            m["error"] = str(e)
+            for c in self.groups:
+                self._mig_abort(c)
+            if self.journal is not None:
+                self.journal.clear_migration()
+            metrics.counter("scaleout_schema_migrations_total",
+                            outcome="failed").inc()
+            log.error("coordinated schema migration failed: %s", e)
+
+    def _recover_migration(self) -> None:
+        """Boot-time crash matrix for the COORDINATED record: "cutting"
+        persisted -> some group may already serve S' — re-issue every
+        cut (idempotent: an already-cut group just reports done);
+        anything earlier -> no group cut, abort them all cleanly."""
+        doc = self.journal.load_migration()
+        if doc is None:
+            return
+        if doc.get("phase") == "cutting":
+            log.warning("resuming interrupted coordinated schema "
+                        "migration cut across %d groups",
+                        len(self.groups))
+            for c in self.groups:
+                try:
+                    self._mig_cut(c)
+                except Exception as e:  # noqa: BLE001 - per-group
+                    # the group's OWN persisted record finishes its cut
+                    # at its next boot; this planner must still serve
+                    log.warning("migration cut re-issue failed: %s", e)
+            self._migration = {"phase": "done",
+                               "groups": len(self.groups),
+                               "recovered": True}
+            metrics.counter("scaleout_schema_migrations_total",
+                            outcome="boot-resumed").inc()
+        else:
+            log.warning("aborting interrupted coordinated schema "
+                        "migration (phase %r, no cut persisted)",
+                        doc.get("phase"))
+            for c in self.groups:
+                self._mig_abort(c)
+            self._migration = {"phase": "aborted",
+                               "groups": len(self.groups),
+                               "recovered": True}
+            metrics.counter("scaleout_schema_migrations_total",
+                            outcome="boot-aborted").inc()
+        self.journal.clear_migration()
+
+    def migration_status(self) -> Optional[dict]:
+        """Aggregate coordinated-migration status (or the per-group
+        worst phase while one is in flight); None when this planner
+        never migrated."""
+        m = self._migration
+        if m is None:
+            return None
+        out = dict(m)
+        if m.get("phase") in ("dual-wait", "cutting"):
+            lags = []
+            for c in self.groups:
+                try:
+                    s = self._mig_status(c)
+                except Exception:  # noqa: BLE001 - status best-effort
+                    s = None
+                if s is not None and s.get("lag") is not None:
+                    lags.append(int(s["lag"]))
+            out["lag"] = max(lags) if lags else None
+        return out
 
     # -- scatter machinery ---------------------------------------------------
 
@@ -1433,6 +1646,9 @@ class ShardedEngine:
             # transition window (/readyz renders it as
             # `rebalance: moving=K copied=J lag=...`)
             "rebalance": self.rebalance_status(),
+            # the coordinated schema migration's progress, or None
+            # (/readyz renders it as `migration: phase=... lag=...`)
+            "migration": self.migration_status(),
         }
 
     def fetch_traces(self, limit: int = 64) -> list:

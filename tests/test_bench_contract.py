@@ -71,7 +71,11 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     _assert_tiered_schema(out["tiered"])
     _assert_shard_schema(out["shard"])
     _assert_rebalance_schema(out["rebalance"])
+    _assert_migration_schema(out["migration"])
     _assert_macro_schema(out["macro"])
+    # ISSUE 19: the tiny run also carries the same-seed macro sweep
+    # re-run under a live rewriting migration, folded into the baseline
+    _assert_macro_migration_schema(out["macro"]["migration"])
 
 
 def _assert_mesh_schema(mesh: dict) -> None:
@@ -243,6 +247,50 @@ def _assert_caveat_schema(cav: dict) -> None:
     # MUST register missing-context denials (the old behavior silently
     # excluded the tuples instead)
     assert cav["missing_context_denials"] >= 1
+
+
+def _assert_migration_schema(mig: dict) -> None:
+    """The ISSUE 19 live-migration contract: an additive and a
+    rewriting migration each complete under a sustained check/write mix
+    with finite time-to-cut / freeze / during-window p50 numbers, the
+    additive one backfills nothing, the rewriting one backfills the
+    affected closure, and the provenance label is honest."""
+    assert mig["provenance"] in ("tpu", "[DEGRADED: cpu]")
+    assert mig["n_rels"] >= 1
+    p50_before = mig["p50_before_ms"]
+    assert isinstance(p50_before, (int, float)) and p50_before > 0 \
+        and p50_before == p50_before
+    ratio = mig["during_over_before_p50"]
+    assert isinstance(ratio, (int, float)) and ratio > 0 \
+        and abs(ratio) != float("inf")
+    for cls in ("additive", "rewriting"):
+        row = mig[cls]
+        assert row["classification"] == cls
+        assert row["phase"] == "done"
+        assert row["during_samples"] >= 1
+        for k in ("time_to_cut_ms", "freeze_ms", "p50_during_ms"):
+            v = row[k]
+            assert isinstance(v, (int, float)) and v >= 0 \
+                and v == v and abs(v) != float("inf")
+    assert mig["additive"]["backfilled"] == 0
+    assert mig["rewriting"]["backfilled"] >= 1
+
+
+def _assert_macro_migration_schema(m: dict) -> None:
+    """The macro.migration fold: same-seed sweep under a held-open
+    rewriting migration, knee (or top-multiplier goodput) ratio against
+    the baseline, and the migration itself finished DONE with a real
+    backfill and a sub-second freeze."""
+    assert isinstance(m["knee_ratio"], (int, float)) and m["knee_ratio"] > 0
+    assert m["basis"] == "knee" or m["basis"].startswith("goodput@x")
+    assert m["classification"] == "rewriting"
+    assert m["phase"] == "done"
+    assert m["backfilled"] >= 1
+    assert len(m["curve"]) >= 4
+    for k in ("time_to_cut_ms", "freeze_ms"):
+        v = m[k]
+        assert isinstance(v, (int, float)) and v >= 0 \
+            and abs(v) != float("inf")
 
 
 def _assert_macro_schema(macro: dict) -> None:

@@ -672,7 +672,8 @@ def test_inproc_campaign_one_seed_zero_violations(tmp_path):
     assert result["violations"] == []
     assert result["seeds"]["0"]["fault_digest"] == BROWNOUT_SEED0_DIGEST
     episodes = {e["episode"] for e in result["episodes"]}
-    assert episodes == {"seed0/baseline", "seed0/brownout"}
+    assert episodes == {"seed0/baseline", "seed0/brownout",
+                        "seed0/migration"}
     # records actually flowed (checks, writes, lookups all exercised)
     assert all(e["records"] > 20 for e in result["episodes"])
 
@@ -691,7 +692,8 @@ def test_subprocess_campaign_one_seed(tmp_path):
     result = Campaign(cfg).run()
     assert result["ok"], result["violations"]
     names = [e["episode"] for e in result["episodes"]]
-    assert names == ["seed0/baseline", "seed0/brownout", "seed0/crash"]
+    assert names == ["seed0/baseline", "seed0/brownout", "seed0/crash",
+                     "seed0/migration"]
     crash = result["episodes"][2]
     assert crash["killed"], "the crash episode never killed a leader"
     brown = result["episodes"][1]

@@ -328,3 +328,113 @@ definition ns {
 """)
     _, expires = watch_relevance(s2, "ns", "view")
     assert expires is True
+
+
+# ---------------------------------------------------------------------------
+# parser DX: errors name the enclosing definition/relation (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "bad,where",
+    [
+        ("definition user {}\ndefinition pod {\n"
+         "  relation viewer user\n}",
+         "in definition 'pod', relation 'viewer'"),
+        ("definition user {}\ndefinition pod {\n"
+         "  relation viewer: user |\n}",
+         "in definition 'pod', relation 'viewer'"),
+        ("definition user {}\ndefinition pod {\n"
+         "  permission view = viewer +\n}",
+         "in definition 'pod', permission 'view'"),
+        ("definition user {}\ndefinition pod {\n"
+         "  relation viewer: user with\n}",
+         "in definition 'pod', relation 'viewer'"),
+    ],
+)
+def test_parse_errors_name_enclosing_scope(bad, where):
+    """An operator editing a 500-line schema needs 'in definition
+    <d>, relation <r>', not a bare line number."""
+    with pytest.raises(SchemaError) as ei:
+        parse_schema(bad)
+    msg = str(ei.value)
+    assert where in msg
+    assert "schema line" in msg  # the line number survives too
+
+
+# ---------------------------------------------------------------------------
+# migration diff stability under definition reordering (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_DIFF_BASE = """
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition namespace {
+  relation viewer: user | group#member
+  permission view = viewer
+}
+definition pod {
+  relation namespace: namespace
+  relation viewer: user
+  permission view = viewer + namespace->view
+}
+"""
+
+_DIFF_TARGET = """
+caveat probation(level int) {
+  level < 3
+}
+
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition namespace {
+  relation viewer: user | group#member
+  permission view = viewer
+}
+definition pod {
+  relation namespace: namespace
+  relation viewer: user | user with probation
+  permission view = viewer + namespace->view
+}
+"""
+
+
+def _shuffled(text: str, rng) -> str:
+    """Permute top-level blocks (definitions + caveats) of a schema
+    text — same IR, different declaration order."""
+    import re
+
+    blocks = re.split(r"(?m)^(?=definition |caveat )", text)
+    head, body = blocks[0], blocks[1:]
+    rng.shuffle(body)
+    return head + "".join(body)
+
+
+def test_diff_classification_stable_under_reordering():
+    """SchemaDiff is frozenset-based by construction: permuting either
+    side's definitions yields an EQUAL diff and an identical ir_digest
+    — the migration layer's identity test must not depend on the order
+    an operator happened to write the file in."""
+    import random
+
+    from spicedb_kubeapi_proxy_tpu.models.schema import (
+        diff_schemas,
+        ir_digest,
+    )
+
+    base = parse_schema(_DIFF_BASE)
+    target = parse_schema(_DIFF_TARGET)
+    ref = diff_schemas(base, target)
+    assert ref.classification == "rewriting"
+    rng = random.Random(0x5EED)
+    for _ in range(25):
+        base2 = parse_schema(_shuffled(_DIFF_BASE, rng))
+        target2 = parse_schema(_shuffled(_DIFF_TARGET, rng))
+        assert ir_digest(base2) == ir_digest(base)
+        assert ir_digest(target2) == ir_digest(target)
+        got = diff_schemas(base2, target2)
+        assert got == ref  # frozen dataclass: full structural equality

@@ -44,6 +44,9 @@ SCOPE_FILES = (
     # the tuple mover's routing/cutover path: a swallowed failure here
     # is a half-routed placement serving stale verdicts
     "scaleout/rebalance.py",
+    # the live schema migrator: a swallowed failure mid-backfill or
+    # mid-cut leaves two graphs half-routed against one schema
+    "migration/migrator.py",
 )
 
 BUILDER = "_fail_closed_503"

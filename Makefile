@@ -132,6 +132,7 @@ verify: lint analyze
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_caveats.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_scaleout.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_rebalance.py
+	$(PY) -m pytest -q -p no:cacheprovider tests/test_autoscale.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_tiered.py
 	$(PY) -m pytest -q -p no:cacheprovider tests/test_migration.py
 	set -o pipefail; rm -f /tmp/_t1.log; \

@@ -1159,6 +1159,19 @@ def _measure(args, result: dict) -> None:
         traceback.print_exc(file=sys.stderr)
         log(f"rebalance section failed (non-fatal): {ex}")
 
+    # -- elastic scale-out (ISSUE 20): frontier-exchange parity on a
+    # cross-namespace reference schema WITHOUT replication (boundary
+    # wire bytes + rounds recorded), then an autoscaler-applied 3->2
+    # shrink under load with paused-vs-running goodput windows. Runs at
+    # EVERY scale including --tiny (contract-pinned).
+    try:
+        _autoscale_phase(result, quick, args.tiny)
+    except Exception as ex:  # noqa: BLE001 - aux measurement only
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"autoscale section failed (non-fatal): {ex}")
+
     # -- live schema migration (ISSUE 19): additive + rewriting targets
     # applied under a sustained check/write mix — time-to-cut, cut
     # freeze, backfill volume, and check p50 during-vs-before. Runs at
@@ -2802,6 +2815,307 @@ def _rebalance_phase(result: dict, quick: bool, tiny: bool) -> None:
             f"in {move_s:.2f}s, goodput paused "
             f"{paused or 0:.0f} vs moving {running or 0:.0f} op/s "
             f"(ratio {ratio}), lost={lost} "
+            f"fail_open={fail_open['n']}")
+    finally:
+        stop.set()
+        if planner is not None:
+            try:
+                planner.close()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        for srv in servers:
+            try:
+                run_in_loop(srv.stop(), timeout=15.0)
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        loop.call_soon_threadsafe(loop.stop)
+        loop_thread.join(10)
+
+
+# ISSUE 20's cross-namespace reference schema: `team` is NAMESPACED
+# (sharded — one copy, on its owner group) yet referenced as a userset
+# subject by `doc` rows living in OTHER namespaces, i.e. usually on
+# OTHER shards. Under the PR-11 contract this schema required `team`
+# to be cluster-scoped (replicated everywhere); the frontier exchange
+# resolves it with only boundary descriptors on the wire.
+_FRONTIER_SCHEMA = """
+definition user {}
+
+definition team {
+  relation member: user
+}
+
+definition doc {
+  relation owner: team#member
+  relation viewer: user
+  permission view = viewer + owner
+}
+"""
+
+
+def _autoscale_phase(result: dict, quick: bool, tiny: bool) -> None:
+    """Elastic scale-out (ISSUE 20): a cross-namespace reference schema
+    served WITHOUT replication — frontier-exchange checks/lookups
+    verified against an unsharded oracle, per-round boundary wire
+    bytes and round counts recorded straight from the planner's
+    counters — then an SLO-driven SHRINK (3 -> 2 groups) proposed and
+    applied by the real AutoscaleController under sustained load, with
+    paused-vs-running goodput windows, zero acked-write loss, and the
+    fail-open probe count."""
+    import asyncio
+    import statistics
+    import threading as _threading
+
+    from spicedb_kubeapi_proxy_tpu.autoscale import (
+        AutoscaleController,
+        AutoscalePolicy,
+        PolicyConfig,
+        Signals,
+    )
+    from spicedb_kubeapi_proxy_tpu.engine import Engine
+    from spicedb_kubeapi_proxy_tpu.engine.engine import CheckItem
+    from spicedb_kubeapi_proxy_tpu.engine.remote import (
+        EngineServer,
+        RemoteEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.engine.store import (
+        RelationshipFilter,
+        WriteOp,
+    )
+    from spicedb_kubeapi_proxy_tpu.models import parse_schema
+    from spicedb_kubeapi_proxy_tpu.models.tuples import Relationship
+    from spicedb_kubeapi_proxy_tpu.scaleout import (
+        FrontierConfig,
+        ShardMap,
+        ShardedEngine,
+    )
+    from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics
+
+    if tiny:
+        n_pairs, win_s, n_windows = 16, 0.4, 2
+    elif quick:
+        n_pairs, win_s, n_windows = 48, 0.5, 3
+    else:
+        n_pairs, win_s, n_windows = 160, 0.7, 3
+
+    smap = ShardMap(version=1, groups=tuple(
+        (("127.0.0.1", 0),) for _ in range(3)))
+
+    loop = asyncio.new_event_loop()
+    loop_thread = _threading.Thread(target=loop.run_forever,
+                                    daemon=True)
+    loop_thread.start()
+
+    def run_in_loop(coro, timeout=60.0):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(
+            timeout)
+
+    def wire(direction):
+        return metrics.counter("scaleout_frontier_wire_bytes_total",
+                               direction=direction).value
+
+    servers, clients = [], []
+    planner = None
+    oracle = Engine(schema=parse_schema(_FRONTIER_SCHEMA))
+    stop = _threading.Event()
+    try:
+        for _ in range(3):
+            srv = EngineServer(Engine(schema=parse_schema(
+                _FRONTIER_SCHEMA)))
+            port = run_in_loop(srv.start())
+            servers.append(srv)
+            clients.append(RemoteEngine("127.0.0.1", port))
+        planner = ShardedEngine(smap, clients, journal=None,
+                                frontier=FrontierConfig())
+        # teams live in the a* namespaces, docs in b* — the owner
+        # edge crosses namespaces (and so, usually, shards)
+        writes = []
+        for i in range(n_pairs):
+            writes.append(WriteOp("create", Relationship(
+                "team", f"a{i}/t", "member", "user", f"u{i % 8}",
+                None)))
+            writes.append(WriteOp("create", Relationship(
+                "doc", f"b{i}/d", "owner", "team", f"a{i}/t",
+                "member")))
+            writes.append(WriteOp("create", Relationship(
+                "doc", f"b{i}/d", "viewer", "user", f"v{i % 8}",
+                None)))
+        planner.write_relationships(writes)
+        oracle.write_relationships(writes)
+
+        # -- frontier parity vs the unsharded oracle, wire-accounted --
+        scatter0, gather0 = wire("scatter"), wire("gather")
+        rounds0 = (metrics.hist_snapshot("scaleout_frontier_rounds")
+                   or {"n": 0, "max": 0})
+        boundary0 = metrics.counter(
+            "scaleout_frontier_boundary_tuples_total").value
+        parity = 0
+        mismatches = 0
+        for i in range(min(n_pairs, 32)):
+            for subj in (f"u{i % 8}", "intruder"):
+                item = CheckItem("doc", f"b{i}/d", "view", "user",
+                                 subj)
+                if bool(planner.check(item)) == bool(
+                        oracle.check(item)):
+                    parity += 1
+                else:
+                    mismatches += 1
+        lookup_ok = (sorted(planner.lookup_resources(
+            "doc", "view", "user", "u0"))
+            == sorted(oracle.lookup_resources(
+                "doc", "view", "user", "u0")))
+        rounds1 = (metrics.hist_snapshot("scaleout_frontier_rounds")
+                   or {"n": 0, "max": 0})
+        scatter_bytes = wire("scatter") - scatter0
+        gather_bytes = wire("gather") - gather0
+        boundary_tuples = metrics.counter(
+            "scaleout_frontier_boundary_tuples_total").value - boundary0
+        # the no-replication proof: every team tuple has exactly ONE
+        # copy fleet-wide (its owner group) — the closure crossed
+        # shards via the exchange, not via replicated reference data
+        per_group_teams = [
+            len(list(c.read_relationships(RelationshipFilter(
+                resource_type="team")))) for c in clients]
+        single_copy = sum(per_group_teams) == n_pairs
+
+        # -- SLO-driven shrink applied by the real controller ---------
+        staying = []
+        for i in range(n_pairs):
+            if smap.shard_for(f"b{i}", "doc") != 2:
+                staying.append(i)
+        probes = staying[:8] or list(range(n_pairs))
+        goodput = {"n": 0}
+        fail_open = {"n": 0}
+
+        def load_worker(wi):
+            j = wi
+            while not stop.is_set():
+                i = probes[j % len(probes)]
+                try:
+                    planner.check(CheckItem(
+                        "doc", f"b{i}/d", "view", "user",
+                        f"v{i % 8}"))
+                    if planner.check(CheckItem(
+                            "doc", f"b{i}/d", "view", "user",
+                            "intruder")):
+                        fail_open["n"] += 1
+                    goodput["n"] += 2
+                except Exception:  # noqa: BLE001 - keep probing
+                    pass
+                j += 3
+
+        workers = [_threading.Thread(target=load_worker, args=(wi,),
+                                     daemon=True) for wi in range(3)]
+        for w in workers:
+            w.start()
+        time.sleep(0.4)
+
+        controller = AutoscaleController(
+            planner,
+            AutoscalePolicy(PolicyConfig(
+                min_groups=2, max_groups=4, hysteresis_ticks=2,
+                cooldown_seconds=0.0)),
+            mode="apply",
+            signal_fn=lambda: Signals(
+                n_groups=len(planner.groups), occupancy=0.05,
+                burn_rate=0.0,
+                rebalance_active=(planner.rebalance_status()
+                                  is not None),
+                gc_pending=any(
+                    not t.gc_complete
+                    for t in planner._archived_transitions)),
+            coordinator_cfg={"pace_seconds": 0.2, "batch_rows": 16,
+                             "poll_seconds": 0.25})
+        t0 = time.perf_counter()
+        ticks = 0
+        proposal = None
+        while proposal is None and ticks < 10:
+            proposal = controller.tick(now=float(ticks))
+            ticks += 1
+        if proposal is None:
+            raise RuntimeError("autoscaler never proposed the shrink")
+        coord = planner._coordinator
+
+        def window():
+            goodput["n"] = 0
+            w0 = time.monotonic()
+            time.sleep(win_s)
+            return goodput["n"] / (time.monotonic() - w0)
+
+        paused_w, running_w = [], []
+        for _ in range(n_windows):
+            if coord is None or coord._done.is_set():
+                break
+            coord.pause()
+            time.sleep(0.05)
+            paused_w.append(window())
+            coord.resume()
+            time.sleep(0.05)
+            if coord._done.is_set():
+                break
+            running_w.append(window())
+        if coord is not None:
+            coord.resume()
+            ok = coord.wait(120.0)
+            if not ok or coord.error is not None:
+                raise RuntimeError(f"shrink mover failed: "
+                                   f"{coord.error}")
+        move_s = time.perf_counter() - t0
+        stop.set()
+        for w in workers:
+            w.join(5)
+
+        # zero acked writes lost across the shrink: every seeded doc
+        # still answers — the DIRECT viewer and the CROSS-SHARD
+        # frontier path both
+        lost = 0
+        for i in range(n_pairs):
+            if not planner.check(CheckItem(
+                    "doc", f"b{i}/d", "view", "user", f"v{i % 8}")):
+                lost += 1
+            if not planner.check(CheckItem(
+                    "doc", f"b{i}/d", "view", "user", f"u{i % 8}")):
+                lost += 1
+        paused = (statistics.median(paused_w) if paused_w else None)
+        running = (statistics.median(running_w) if running_w
+                   else None)
+        ratio = (round(running / paused, 3)
+                 if paused and running else None)
+        result["autoscale"] = {
+            "n_teams": n_pairs,
+            "n_docs": n_pairs,
+            "frontier": {
+                "parity_checks": parity,
+                "parity_ok": mismatches == 0,
+                "lookup_parity_ok": bool(lookup_ok),
+                "exchanges": int(rounds1["n"] - rounds0["n"]),
+                "rounds_max": int(rounds1["max"] or 0),
+                "scatter_bytes": int(scatter_bytes),
+                "gather_bytes": int(gather_bytes),
+                "boundary_tuples": int(boundary_tuples),
+                "reference_single_copy": bool(single_copy),
+            },
+            "shrink": {
+                "proposal_action": proposal.action,
+                "ticks_to_fire": ticks,
+                "groups_after": len(planner.groups),
+                "move_seconds": round(move_s, 3),
+                "goodput_paused_ops_s": (round(paused, 1)
+                                         if paused else None),
+                "goodput_moving_ops_s": (round(running, 1)
+                                         if running else None),
+                "goodput_ratio_moving_over_paused": ratio,
+                "zero_acked_write_loss": lost == 0,
+                "fail_open_probes": int(fail_open["n"]),
+            },
+        }
+        fr = result["autoscale"]["frontier"]
+        log(f"autoscale: frontier parity {parity} checks "
+            f"({mismatches} mismatches), {fr['exchanges']} exchanges "
+            f"<= {fr['rounds_max']} rounds, "
+            f"{fr['scatter_bytes']}+{fr['gather_bytes']}B boundary "
+            f"wire; shrink {proposal.action} after {ticks} ticks in "
+            f"{move_s:.2f}s, goodput ratio {ratio}, lost={lost} "
             f"fail_open={fail_open['n']}")
     finally:
         stop.set()

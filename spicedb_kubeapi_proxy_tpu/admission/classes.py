@@ -66,6 +66,11 @@ _OP_CLASSES = {
     "lookup_resources": LOOKUP_PREFILTER,
     "lookup_mask": LOOKUP_PREFILTER,
     "lookup_subjects": LOOKUP_PREFILTER,  # chunked bulk checks inside
+    # one frontier-exchange leg is a batch of lookup_resources against
+    # the group's local tuples — same cost shape, same shed class (the
+    # planner's scatter fails closed if any leg sheds); frontier_pairs
+    # is a pure schema walk and stays ungated control-plane
+    "frontier_expand": LOOKUP_PREFILTER,
     "read_relationships": CHECK,
     "watch_since": WATCH_RECOMPUTE,
     "write_relationships": WRITE_DTX,

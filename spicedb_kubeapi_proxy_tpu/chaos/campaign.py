@@ -356,6 +356,28 @@ class SubprocessTopology:
         for g in self.planner.groups:
             g.chaos_reset()
 
+    # -- elastic topology (grow/shrink episode) ------------------------------
+
+    def add_group(self):
+        """Boot a fresh 2-peer failover group (new ports, new data
+        dirs) and return ``(endpoints, client)`` for a grow
+        transition. The new hosts join ``procs``/``ports`` so the
+        crash plane (kill/restart/wait) and teardown cover them."""
+        from ..engine.remote import FailoverEngine
+
+        g = len(self.ports)
+        self.ports.append([_free_port(), _free_port()])
+        for p in range(2):
+            self.procs[(g, p)] = self._boot(g, p)
+        self.wait_group_leader(g, budget=120.0)
+        client = FailoverEngine(
+            [("127.0.0.1", port) for port in self.ports[g]],
+            token="chaos-tok", probe_timeout=2.0,
+            resolve_deadline=15.0, connect_timeout=2.0, timeout=8.0,
+            retries=2, retry_budget=self.retry_budget)
+        return (tuple(("127.0.0.1", port)
+                      for port in self.ports[g]), client)
+
     # -- crash/restart -------------------------------------------------------
 
     def kill_group_leader(self, g: int) -> tuple[int, int]:
@@ -489,6 +511,16 @@ class InprocTopology:
         retargeted.arm()
         return {"armed": [s.site for s in specs],
                 "digest": retargeted.digest()}
+
+    def add_group(self):
+        """A fresh in-process engine group for a grow transition; the
+        placeholder endpoint only has to be unique within the map."""
+        from ..engine import Engine
+
+        gi = len(self.engines)
+        e = Engine(bootstrap=SCHEMA_YAML)
+        self.engines.append(e)
+        return ((("127.0.0.1", gi + 1),), _FaultableEngine(e, gi))
 
     def reset_faults(self) -> None:
         failpoints.disable_all()
@@ -948,8 +980,10 @@ class Campaign:
         # episode 3: SIGKILL group 0's leader mid-schedule, failover,
         # restart, split-journal recovery
         if not topo.supports_crash:
-            # the migration episode still runs (episode 4 below) — its
-            # in-process shape just has no SIGKILL-mid-backfill leg
+            # the elastic + migration episodes still run (episodes 4-5
+            # below) — their in-process shapes just have no
+            # SIGKILL-mid-drain / SIGKILL-mid-backfill legs
+            self.elastic_episode(seed, state)
             self.migration_episode(seed, state)
             return
         victim: list = []
@@ -977,9 +1011,147 @@ class Campaign:
                        if victim else None),
         })
 
-        # episode 4: live schema migration under load, SIGKILL
+        # episode 4: elastic grow -> shrink -> grow under load, SIGKILL
+        # of the retiring group's leader mid-drain (BEFORE the
+        # migration episode: a freshly booted group bootstraps the
+        # original schema, so growing after a live migration would
+        # split the fleet's schema)
+        self.elastic_episode(seed, state)
+
+        # episode 5: live schema migration under load, SIGKILL
         # mid-backfill, re-begin after the boot-abort
         self.migration_episode(seed, state)
+
+    # -- elastic scale-out episode -------------------------------------------
+
+    def elastic_episode(self, seed: int, state: _SeedState) -> None:
+        """Grow -> shrink -> grow, each map transition begun MID-load
+        through the same coordinator the autoscaler's apply mode
+        drives. On crash-capable topologies the shrink is paced and
+        the RETIRING group's leader takes a SIGKILL mid-drain: the
+        drain must fail over to the group's surviving peer and
+        converge. Every write acked anywhere in the cycle is a
+        read-back obligation at the end, no probe may flip open, and a
+        transition that never converges (including its GC) is itself a
+        violation (``rebalance-converged``)."""
+        from ..scaleout import ShardMap
+        from ..scaleout.rebalance import shrink_map
+
+        topo = self.topology
+        planner = topo.planner
+        crash = topo.supports_crash
+        records: list = []
+        transitions: list = []
+        victim: list = []
+
+        def _converged(want_version: int, want_groups: int,
+                       budget: float = 120.0) -> bool:
+            # converged = transition cleared, target map live, target
+            # group count routing, AND no archived transition owing GC
+            # (a shrink begun over pending GC would be refused, so an
+            # unconverged GC stalls the elastic cycle for real)
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                if planner.rebalance_status() is None \
+                        and planner.map.version >= want_version \
+                        and len(planner.groups) == want_groups \
+                        and all(t.gc_complete
+                                for t in planner._archived_transitions):
+                    return True
+                time.sleep(0.1)
+            return False
+
+        def run_phase(name, mid_run, want_version, want_groups):
+            stats = self._drive(seed, f"elastic-{name}", state, records,
+                                mid_run=mid_run)
+            ok = _converged(want_version, want_groups)
+            if not ok:
+                self.violations.append(InvariantViolation(
+                    "rebalance-converged",
+                    f"elastic {name} transition never converged: "
+                    f"status={planner.rebalance_status()}, map "
+                    f"v{planner.map.version} (want >= {want_version}), "
+                    f"{len(planner.groups)} groups "
+                    f"(want {want_groups})"))
+            transitions.append({"phase": name, "converged": ok,
+                                "map_version": planner.map.version,
+                                "groups": len(planner.groups),
+                                "load": stats})
+            return ok
+
+        # phase 1: grow — append a freshly booted group mid-load
+        eps, client = topo.add_group()
+        base = planner.map
+        gi = len(base.groups)
+        grown = ShardMap(version=base.version + 1,
+                         groups=tuple(base.groups) + (tuple(eps),),
+                         virtual_nodes=base.virtual_nodes)
+
+        def begin_grow():
+            try:
+                planner.begin_rebalance(grown, new_clients={gi: client})
+            except Exception as e:  # noqa: BLE001 - judged by converge
+                log.warning("elastic grow begin failed: %s", e)
+
+        ok = run_phase("grow", begin_grow, grown.version, gi + 1)
+
+        # phase 2: shrink the group straight back out; crash shapes
+        # pace the drain and SIGKILL the retiring group's leader in
+        # the middle of it
+        if ok:
+            shrunk = shrink_map(planner.map)
+            retiring = len(planner.groups) - 1
+
+            def begin_shrink():
+                try:
+                    planner.begin_rebalance(
+                        shrunk,
+                        **({"batch_rows": 4, "pace_seconds": 0.15}
+                           if crash else {}))
+                except Exception as e:  # noqa: BLE001 - judged below
+                    log.warning("elastic shrink begin failed: %s", e)
+                    return
+                if crash:
+                    time.sleep(0.4)  # let the drain actually start
+                    try:
+                        victim.append(topo.kill_group_leader(retiring))
+                    except Exception as e:  # noqa: BLE001 - surfaced
+                        log.warning("mid-drain kill failed: %s", e)
+
+            ok = run_phase("shrink", begin_shrink, shrunk.version,
+                           retiring)
+
+        # phase 3: grow again — the cycle must be repeatable (stale
+        # archived owner filters from the first cycle are the
+        # regression this phase pins at the campaign level)
+        if ok:
+            eps2, client2 = topo.add_group()
+            base2 = planner.map
+            gi2 = len(base2.groups)
+            regrown = ShardMap(version=base2.version + 1,
+                               groups=tuple(base2.groups)
+                               + (tuple(eps2),),
+                               virtual_nodes=base2.virtual_nodes)
+
+            def begin_regrow():
+                try:
+                    planner.begin_rebalance(regrown,
+                                            new_clients={gi2: client2})
+                except Exception as e:  # noqa: BLE001 - judged above
+                    log.warning("elastic re-grow begin failed: %s", e)
+
+            run_phase("regrow", begin_regrow, regrown.version, gi2 + 1)
+
+        self._revocation_probe(seed, "elastic", state, records)
+        ev = EpisodeEvidence(
+            name=f"seed{seed}/elastic", records=records,
+            readback=self._readback(state),
+            pending_splits=self._drain_pending_splits())
+        self._finish_episode(ev, {
+            "transitions": transitions,
+            "killed": (f"group{victim[0][0]}/peer{victim[0][1]}"
+                       if victim else None),
+        })
 
     # -- live schema migration episode ---------------------------------------
 

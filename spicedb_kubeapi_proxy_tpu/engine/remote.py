@@ -165,6 +165,11 @@ _IDEMPOTENT_OPS = frozenset({
     # many times they land. migrate_begin is NOT here — a replay would
     # race the single-active-migration refusal.
     "migrate_status", "migrate_cut", "migrate_abort",
+    # frontier exchange: both legs are pure reads (pair derivation is
+    # a schema walk; expansion is a batch of lookup_resources)
+    "frontier_expand", "frontier_pairs",
+    # autoscaler signal probe: a pure read of admission/latency state
+    "load_status",
 })
 
 # "the transport failed" (vs the engine answering with an error): socket
@@ -717,6 +722,28 @@ class EngineServer:
             req["subject_type"], req.get("subject_relation"),
             now=req.get("now"), context=req.get("ctx") or None)
 
+    def _op_frontier_pairs(self, req: dict):
+        """The schema's frontier reference pairs (scaleout/frontier.py)
+        — raises the monotonicity refusal server-side so a planner
+        enabling the exchange against an unsupported schema fails
+        closed on first use."""
+        from ..scaleout.frontier import reference_pairs
+
+        return [list(p) for p in reference_pairs(self.engine.schema)]
+
+    def _op_frontier_expand(self, req: dict):
+        """One frontier-exchange leg against THIS group's local tuples
+        (scaleout/frontier.py expand_local — one owner for the
+        semantics, in-process and over the wire)."""
+        from ..scaleout.frontier import decode_frontier, expand_local
+
+        out = expand_local(
+            self.engine, decode_frontier(req["descs"]),
+            [(str(t), str(r)) for t, r in req["pairs"]],
+            now=req.get("now"), context=req.get("ctx") or None)
+        return sorted(([t, i, r] for t, i, r in out),
+                      key=lambda d: (d[0], d[1], d[2] or ""))
+
     def _op_lookup_mask(self, req: dict):
         """The hot-path variant: packed bitmask over the type's object
         index space (see module docstring): constant-size, ~16 KB at a
@@ -951,6 +978,23 @@ class EngineServer:
         return {"role": "leader",
                 "term": int(getattr(eng, "term", 0) or 0),
                 "revision": eng.revision, "peer_id": None, "lag": 0}
+
+    def _op_load_status(self, req: dict):
+        """Autoscaler signal probe (autoscale/controller.py): this
+        host's admission occupancy (weighted in-flight cost over the
+        AIMD limit) and mean engine check latency. Ungated
+        control-plane like failover_state — a saturated host must
+        still answer the probe that would relieve it."""
+        occ = 0.0
+        if self.admission is not None:
+            st = self.admission.status()
+            occ = max(0.0, min(1.0, float(st["inflight_cost"])
+                               / max(1e-9, float(st["limit"]))))
+        lat_ms = 0.0
+        snap = metrics.hist_snapshot("engine_check_seconds")
+        if snap and snap["n"]:
+            lat_ms = snap["total"] / snap["n"] * 1e3
+        return {"occupancy": occ, "check_ms": lat_ms}
 
     def _op_exists(self, req: dict):
         return self.engine.store.exists(_filter_from_dict(req["filter"]))
@@ -1486,6 +1530,30 @@ class RemoteEngine:
 
         return mask_to_ids(mask, interner)
 
+    def load_status(self) -> dict:
+        """The host's autoscaler signals: admission occupancy [0, 1]
+        and mean engine check latency in ms."""
+        return self._call("load_status")
+
+    def frontier_pairs(self) -> tuple:
+        """The group's schema-derived frontier reference pairs."""
+        return tuple((str(t), str(r))
+                     for t, r in self._call("frontier_pairs"))
+
+    def frontier_expand(self, descs, pairs,
+                        now: Optional[float] = None,
+                        context: Optional[dict] = None) -> set:
+        """One frontier-exchange leg on this group; descriptors cross
+        the wire in the canonical encode_frontier form (the planner's
+        wire-bytes counters measure exactly these payloads)."""
+        got = self._call(
+            "frontier_expand",
+            descs=[[t, i, r] for t, i, r in descs],
+            pairs=[[t, r] for t, r in pairs],
+            now=now, ctx=context or None)
+        return {(str(t), str(i), None if r is None else str(r))
+                for t, i, r in got}
+
     def lookup_resources_mask(self, resource_type: str, permission: str,
                               subject_type: str, subject_id: str,
                               subject_relation: Optional[str] = None,
@@ -1984,6 +2052,18 @@ class FailoverEngine:
         return self._invoke(lambda c: c.lookup_resources_mask(
             resource_type, permission, subject_type, subject_id,
             subject_relation, now=now, context=context))
+
+    def load_status(self) -> dict:
+        return self._invoke(lambda c: c.load_status())
+
+    def frontier_pairs(self) -> tuple:
+        return self._invoke(lambda c: c.frontier_pairs())
+
+    def frontier_expand(self, descs, pairs,
+                        now: Optional[float] = None,
+                        context: Optional[dict] = None) -> set:
+        return self._invoke(lambda c: c.frontier_expand(
+            descs, pairs, now=now, context=context))
 
     def write_relationships(self, ops: list,
                             preconditions: list = ()) -> int:

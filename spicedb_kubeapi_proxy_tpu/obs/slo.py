@@ -244,6 +244,20 @@ class SLOMonitor:
             }
         return out
 
+    def worst_burn(self, window: Optional[float] = None) -> float:
+        """The hottest burn rate across every objective at one window
+        (default: the SHORTEST — the fast-burn signal the autoscaler
+        folds into its grow/never-shrink decisions). 0.0 when no
+        traffic has flowed."""
+        w = self.windows[0] if window is None else float(window)
+        ts = self._clock()
+        worst = 0.0
+        for o in self.objectives:
+            st = self._window_stats(o.name, ts).get(w)
+            if st is not None:
+                worst = max(worst, float(st["burn_rate"]))
+        return worst
+
     def status(self) -> dict:
         """The ``/debug/slo`` document: every declared objective with its
         per-window burn rates and attainment."""

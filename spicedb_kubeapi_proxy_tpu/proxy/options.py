@@ -339,6 +339,22 @@ class Options:
     slo_windows: str = "60,300,3600"
     slo_tick_seconds: float = 5.0
     enable_debug_slo: bool = False
+    # -- elastic scale-out (autoscale/, scaleout/frontier.py) ----------------
+    # SLO-driven autoscaler: "off" (default), "dry-run" (proposals are
+    # counted and surfaced on /readyz, nothing moves), or "apply"
+    # (proposals drive REAL grow/shrink map transitions through the
+    # rebalance coordinator). Requires --shard-map.
+    autoscale: str = "off"
+    # policy knobs as key=value CSV (autoscale/policy.py parse_policy),
+    # e.g. "max_groups=6,grow_occupancy=0.7"; None = all defaults
+    autoscale_policy: Optional[str] = None
+    autoscale_tick_seconds: float = 15.0
+    # cross-shard frontier exchange (scaleout/frontier.py): lifts the
+    # cluster-scoped-only restriction on cross-namespace reference
+    # types by iterating boundary-frontier rounds instead of
+    # replicating tuples; fail-closed after frontier_max_rounds
+    frontier_exchange: bool = False
+    frontier_max_rounds: int = 8
 
     def _parse_remote(self) -> Optional[list[tuple[str, int]]]:
         """[(host, port), ...] for tcp:// endpoints, None otherwise;
@@ -400,16 +416,46 @@ class Options:
                         f"rebalance-to map version {target.version} "
                         f"must exceed the current shard-map version "
                         f"{smap.version}")
-                if target.n_groups < smap.n_groups:
+                if target.n_groups < smap.n_groups - 1:
                     raise OptionsError(
-                        "rebalance-to cannot REMOVE groups yet: group "
-                        "indices are identity across a transition "
-                        "(move the slices first, then retire the "
-                        "empty group in a later map)")
+                        "rebalance-to can retire at most ONE group per "
+                        "map version: group indices are identity across "
+                        "a transition, and a shrink drains + GCs the "
+                        "retiring tail group before commit — chain "
+                        "single-group shrinks to go further")
+                if target.n_groups == smap.n_groups - 1 \
+                        and target.groups != smap.groups[:-1]:
+                    raise OptionsError(
+                        "a shrink map must keep the surviving groups' "
+                        "endpoints byte-identical and retire only the "
+                        "LAST group (ring points are keyed by group "
+                        "index; reordering would silently remap "
+                        "untouched slices)")
         elif self.rebalance_to:
             raise OptionsError(
                 "rebalance-to requires --shard-map (it is a transition "
                 "between two shard maps)")
+        if self.autoscale not in ("off", "dry-run", "apply"):
+            raise OptionsError(
+                f"autoscale must be off, dry-run, or apply "
+                f"(got {self.autoscale!r})")
+        if self.autoscale != "off" and not self.shard_map:
+            raise OptionsError(
+                "autoscale requires --shard-map (it proposes and "
+                "drives shard-map transitions)")
+        if self.autoscale_policy is not None:
+            from ..autoscale import AutoscaleError, parse_policy
+
+            try:
+                parse_policy(self.autoscale_policy)
+            except AutoscaleError as e:
+                raise OptionsError(f"autoscale-policy: {e}") from None
+        if self.frontier_exchange and not self.shard_map:
+            raise OptionsError(
+                "frontier-exchange requires --shard-map (it is a "
+                "cross-shard join protocol)")
+        if self.frontier_max_rounds < 1:
+            raise OptionsError("frontier-max-rounds must be >= 1")
         if self.migrate_schema:
             # parse NOW: an unreadable or syntactically-broken target
             # schema must fail option validation, not surface later as
@@ -720,11 +766,18 @@ class Options:
                     journal_path = _osj.path.join(
                         _osj.path.dirname(_osj.path.abspath(base)),
                         "scaleout-journal.sqlite")
+                frontier_cfg = None
+                if self.frontier_exchange:
+                    from ..scaleout import FrontierConfig
+
+                    frontier_cfg = FrontierConfig(
+                        max_rounds=self.frontier_max_rounds)
                 engine = ShardedEngine(
                     smap, groups, journal=SplitJournal(journal_path),
                     cache=(ShardVectorCache() if self.shard_cache
                            else None),
                     retry_budget=engine_budget,
+                    frontier=frontier_cfg,
                     # lets a persisted mid-rebalance transition
                     # reconstruct clients for groups the target map
                     # ADDED beyond --shard-map at the next boot
@@ -977,6 +1030,23 @@ class Options:
                          if w.strip()],
                 tick_seconds=self.slo_tick_seconds)
             slo_monitor.start()
+        autoscale_controller = None
+        if self.autoscale != "off" and self.shard_map:
+            from ..autoscale import (
+                AutoscaleController,
+                AutoscalePolicy,
+                PolicyConfig,
+                parse_policy,
+            )
+
+            policy_cfg = (parse_policy(self.autoscale_policy)
+                          if self.autoscale_policy else PolicyConfig())
+            autoscale_controller = AutoscaleController(
+                engine, AutoscalePolicy(policy_cfg),
+                mode=self.autoscale,
+                slo_monitor=slo_monitor,
+                tick_seconds=self.autoscale_tick_seconds)
+            autoscale_controller.start()
         deps = AuthzDeps(
             matcher=matcher, engine=engine, upstream=upstream,
             workflow=workflow, default_lock_mode=self.lock_mode,
@@ -1040,9 +1110,10 @@ class Options:
                         token_authenticator=token_authenticator,
                         enable_debug_traces=self.enable_debug_traces,
                         slo_monitor=slo_monitor,
-                        enable_debug_slo=self.enable_debug_slo)
+                        enable_debug_slo=self.enable_debug_slo,
+                        autoscale_controller=autoscale_controller)
         return CompletedConfig(self, engine, workflow, deps, server,
-                               slo_monitor)
+                               slo_monitor, autoscale_controller)
 
     # fields safe to expose on /debug/config — an ALLOWLIST so a future
     # credential-bearing Options field fails safe (omitted) instead of
@@ -1074,6 +1145,8 @@ class Options:
         "enable_debug_traces", "audit_log", "audit_allow_rps",
         "slo_objectives", "slo_windows", "slo_tick_seconds",
         "enable_debug_slo",
+        "autoscale", "autoscale_policy", "autoscale_tick_seconds",
+        "frontier_exchange", "frontier_max_rounds",
     )
 
     def debug_dump(self) -> dict:
@@ -1093,6 +1166,7 @@ class CompletedConfig:
     deps: AuthzDeps
     server: Server
     slo_monitor: Optional[object] = None
+    autoscale_controller: Optional[object] = None
 
     async def run(self) -> None:
         """Start serving: resume pending dual-writes, listen, serve
@@ -1480,6 +1554,32 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--audit-allow-rps", type=float, default=10.0,
                         help="rate cap for ALLOW audit lines per second "
                              "(denies are never capped)")
+    parser.add_argument("--autoscale", default="off",
+                        choices=["off", "dry-run", "apply"],
+                        help="SLO-driven autoscaler: dry-run counts and "
+                             "surfaces grow/shrink proposals on /readyz; "
+                             "apply drives real shard-map transitions "
+                             "through the rebalance coordinator. "
+                             "Requires --shard-map")
+    parser.add_argument("--autoscale-policy", default=None,
+                        help="policy knobs as key=value CSV, e.g. "
+                             "'max_groups=6,grow_occupancy=0.7' "
+                             "(autoscale/policy.py; unset = defaults)")
+    parser.add_argument("--autoscale-tick-seconds", type=float,
+                        default=15.0,
+                        help="autoscaler observe/decide cadence")
+    parser.add_argument("--frontier-exchange", action="store_true",
+                        help="enable cross-shard frontier-exchange joins "
+                             "(scaleout/frontier.py): cross-namespace "
+                             "reference types resolve by iterating "
+                             "boundary frontiers instead of requiring "
+                             "cluster-scoped replication. Requires "
+                             "--shard-map; monotone schemas only")
+    parser.add_argument("--frontier-max-rounds", type=int, default=8,
+                        help="fail-closed round budget per frontier "
+                             "exchange (exhaustion under-approximates "
+                             "the closure: deny/under-list, never "
+                             "over-grant)")
 
 
 def options_from_args(args: argparse.Namespace) -> Options:
@@ -1575,4 +1675,9 @@ def options_from_args(args: argparse.Namespace) -> Options:
         slo_windows=args.slo_windows,
         slo_tick_seconds=args.slo_tick_seconds,
         enable_debug_slo=args.enable_debug_slo,
+        autoscale=args.autoscale,
+        autoscale_policy=args.autoscale_policy,
+        autoscale_tick_seconds=args.autoscale_tick_seconds,
+        frontier_exchange=args.frontier_exchange,
+        frontier_max_rounds=args.frontier_max_rounds,
     )

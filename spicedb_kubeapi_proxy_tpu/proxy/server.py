@@ -58,7 +58,8 @@ class Server:
                  token_authenticator=None,
                  enable_debug_traces: bool = False,
                  slo_monitor=None,
-                 enable_debug_slo: bool = False):
+                 enable_debug_slo: bool = False,
+                 autoscale_controller=None):
         self.deps = deps
         self.authenticator = authenticator or HeaderAuthenticator()
         self.cert_authenticator = ClientCertAuthenticator()
@@ -88,6 +89,10 @@ class Server:
         # /debug/traces — flag-gated on top of authentication
         self.slo_monitor = slo_monitor
         self.enable_debug_slo = enable_debug_slo
+        # autoscale controller (autoscale/controller.py); surfaced on
+        # /readyz so operators see dry-run proposals before trusting
+        # --autoscale=apply
+        self.autoscale_controller = autoscale_controller
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()  # live connection-handler tasks
 
@@ -228,6 +233,23 @@ class Server:
                         f"classification={mig.get('classification')} "
                         f"lag={mig.get('lag')} "
                         f"backfilled={mig.get('backfilled')}")
+            # autoscaler posture: INFORMATIONAL like migration — a
+            # proposal (or a transition it started) is the elasticity
+            # design working, not unreadiness
+            if self.autoscale_controller is not None:
+                try:
+                    st = self.autoscale_controller.status()
+                    last = st.get("last_proposal")
+                    last_s = ("none" if not last else
+                              f"{last['action']}->"
+                              f"{last['target_groups']}")
+                    info_lines.append(
+                        f"autoscale: mode={st['mode']} "
+                        f"groups={st['groups']} "
+                        f"transitions={st['transitions']} "
+                        f"last={last_s}")
+                except Exception:  # noqa: BLE001 - readyz must answer
+                    info_lines.append("autoscale: status unavailable")
             # admission shed/queue state is INFORMATIONAL: shedding is
             # the overload design working, not unreadiness — pulling a
             # shedding replica from rotation would dump its share of the

@@ -24,9 +24,24 @@ subsystem partitions the relationship space itself:
 - ``rebalance.py`` — the online tuple mover: map V -> V+1 without a
   drain, via plan / copy / catch-up / dual-write / per-slice cutover /
   GC (:class:`RebalanceCoordinator`), with read-owner-only watch
-  delivery keeping merged streams exact across the flip.
+  delivery keeping merged streams exact across the flip — in BOTH
+  directions: a shrink (:func:`shrink_map`) empties the retiring tail
+  group through the same machinery, GCs it BEFORE commit, and drops
+  its revision-vector component at commit.
+- ``frontier.py`` — the cross-shard frontier exchange: iterative
+  membership-closure joins where only boundary tuples ride the wire,
+  lifting the cluster-scoped-only restriction on cross-namespace
+  reference types (monotone schemas; fail-closed round budget).
 """
 
+from .frontier import (  # noqa: F401
+    FrontierConfig,
+    FrontierError,
+    decode_frontier,
+    encode_frontier,
+    expand_local,
+    reference_pairs,
+)
 from .journal import SplitJournal  # noqa: F401
 from .planner import (  # noqa: F401
     ShardedEngine,
@@ -40,6 +55,7 @@ from .rebalance import (  # noqa: F401
     RebalanceError,
     abort_transition,
     plan_moves,
+    shrink_map,
 )
 from .shardmap import (  # noqa: F401
     RevisionVector,

@@ -275,6 +275,14 @@ class ShardedWatchStream:
         planner's rebalance delivery filter — the resumption token
         still advances past suppressed mover echoes, so a consumer
         resuming from ``self.revision`` never sees them either."""
+        if len(self._p.groups) < self._n_pumps:
+            # a SHRINK committed under this stream: its per-component
+            # indexing (and the consumer's resumption arithmetic) no
+            # longer matches the contracted group space — fail closed
+            # with re-list semantics instead of mis-stamping events
+            raise StoreError(
+                "shard-group space shrank beneath this watch stream; "
+                "re-list and re-watch")
         self._ensure_pumps()
         try:
             gi, events, err = self._q.get(timeout=self._p.PUSH_WAIT)
@@ -318,15 +326,27 @@ class ShardedEngine:
                  journal: Optional[SplitJournal] = None,
                  cache: Optional[ShardVectorCache] = None,
                  recover: bool = True, retry_budget=None,
-                 client_factory=None):
+                 client_factory=None, frontier=None):
         if len(groups) != shard_map.n_groups:
             raise ValueError(
                 f"shard map names {shard_map.n_groups} groups, got "
                 f"{len(groups)} clients")
         self.map = shard_map
         self.groups = list(groups)
+        # clients a SHRINK commit removed from routing: ownership
+        # parks here until close() — the planner may not own a test's
+        # in-process engine, but a factory-built remote client's
+        # sockets/heartbeats must not leak past teardown
+        self._retired_clients: list = []
         self.journal = journal
         self.cache = cache
+        # cross-shard frontier exchange (scaleout/frontier.py): a
+        # FrontierConfig enables the planner-coordinated iterative
+        # join for closures that cross shard boundaries; None keeps
+        # the classic shard-local closure contract
+        self.frontier = frontier
+        self._frontier_pairs = (None if frontier is None
+                                else frontier.pairs)
         # the SAME RetryBudget instance the group clients hold
         # (utils/resilience.py): the planner's scatter-leg re-issues
         # draw from it too, so a browned-out shard sees one bounded
@@ -391,6 +411,12 @@ class ShardedEngine:
         except (TypeError, ValueError):
             return
         with self._vec_lock:
+            if shard >= len(self.groups):
+                # a RETIRED group's straggler (a watch pump or probe
+                # that outlived its shrink): the group's history is
+                # closed — extending the contracted vector back out
+                # would resurrect the dropped component
+                return
             if shard >= len(self._vector):
                 # a rebalance-added group: grow the tracked vector
                 self._vector = self._vector.extend(shard + 1)
@@ -434,19 +460,50 @@ class ShardedEngine:
 
     def begin_rebalance(self, new_map: ShardMap,
                         new_clients: Optional[dict] = None,
+                        retire: Optional[int] = None,
                         **coordinator_cfg) -> RebalanceCoordinator:
         """Start a live map transition V -> ``new_map.version`` on a
         background mover thread (``--rebalance-to``). ``new_clients``
         maps ADDED group indices to their engine clients (or a
         ``client_factory`` builds them from the map's endpoints).
         Returns the coordinator; routing changes take effect per slice
-        as the protocol advances — no drain, ever."""
+        as the protocol advances — no drain, ever.
+
+        A target with FEWER groups is a SHRINK: the ``retire``-d group
+        (default: the last one) is emptied through the same
+        copy/catch-up/dual-write/cutover machinery, GC'd, and removed
+        at commit. Only the LAST group may retire — every survivor's
+        ring points are keyed by group index, so retiring the tail
+        leaves their placement untouched; to retire a middle group,
+        rebalance its slices onto the tail first."""
         if self._active_transition is not None:
             raise RebalanceError(
                 "a rebalance is already in flight (to map version "
                 f"{self._active_transition.new_map.version})")
-        t = MapTransition(self.map, new_map,
-                          plan_moves(self.map, new_map))
+        if new_map.n_groups < self.map.n_groups:
+            if retire is None:
+                retire = self.map.n_groups - 1
+            if retire != self.map.n_groups - 1:
+                raise RebalanceError(
+                    "only the LAST group can retire (group indices are "
+                    "identity across a transition: removing a middle "
+                    "index would silently renumber every later group's "
+                    "ring points); move its slices to the tail first")
+            if any(not past.gc_complete
+                   for past in self._archived_transitions):
+                raise RebalanceError(
+                    "cannot shrink while an earlier transition's GC is "
+                    "incomplete: its lingering copies are filtered by "
+                    "group index, and the shrink renumbers the index "
+                    "space out from under that filter — re-run GC (it "
+                    "resumes at boot) and retry")
+            t = MapTransition(self.map, new_map,
+                              plan_moves(self.map, new_map,
+                                         retire=retire),
+                              retire=retire)
+        else:
+            t = MapTransition(self.map, new_map,
+                              plan_moves(self.map, new_map))
         self._install_transition(t, new_clients)
         coord = RebalanceCoordinator(self, t, **coordinator_cfg)
         self._coordinator = coord
@@ -482,13 +539,27 @@ class ShardedEngine:
     def commit_rebalance(self, t: MapTransition) -> None:
         """Every slice cut: map V+1 becomes THE map (atomic swap); the
         transition is archived — its cut table keeps filtering watch
-        replays and translating V-minted resumption tokens."""
+        replays and translating V-minted resumption tokens. A SHRINK
+        commit additionally removes the retiring group from the routing
+        space: its client leaves ``groups`` (closed at planner
+        teardown — the planner may not own its lifecycle mid-test) and
+        the tracked vector drops its component; the translation
+        watermark is recorded first."""
         if not t.all_cut():
             raise RebalanceError(
                 "commit before every slice cut would misroute the "
                 "uncut slices")
-        with self._vec_lock:
-            self.map = t.new_map
+        if t.retire is not None:
+            if t.retire_cut is None:
+                t.retire_cut = t.retire_watermark()
+            with self._vec_lock:
+                self.map = t.new_map
+                retired = self.groups.pop(t.retire)
+                self._vector = self._vector.drop_component(t.retire)
+            self._retired_clients.append(retired)
+        else:
+            with self._vec_lock:
+                self.map = t.new_map
         self._active_transition = None
         self._archived_transitions.append(t)
         # bound the era-walk/translation memory: resumption tokens old
@@ -496,8 +567,41 @@ class ShardedEngine:
         # semantics (their groups' watch logs have long been trimmed
         # past those cut revisions anyway)
         del self._archived_transitions[:-8]
+        self._retire_stale_archives()
         metrics.gauge("scaleout_groups").set(t.new_map.n_groups)
         metrics.gauge("scaleout_map_version").set(t.new_map.version)
+
+    def _retire_stale_archives(self) -> None:
+        """Drop archived transitions that reference a group index
+        OUTSIDE today's group space (beyond their own retiree). They
+        accumulate across grow→shrink cycles and pin two stale filters:
+        a ``gc_complete=False`` archive holds ``_copies_may_linger``
+        open forever (per-row owner filtering on every scatter, and the
+        ``exists`` probe degraded to full row gathers) even though the
+        shrink that removed the group already copy-REPLACED the ranges
+        its GC owed; and their era tables make every watch-delivery
+        walk compare today's group indices against a dead index space.
+        Safe to drop: ``begin_rebalance`` refuses to shrink past
+        incomplete GC, and a dropped archive's resumption tokens get
+        re-list semantics (exactly what tokens older than the 8-ring
+        already get)."""
+        n = len(self.groups)
+        kept = []
+        for past in self._archived_transitions:
+            refs = ({sl.src for sl in past.slices}
+                    | {sl.dst for sl in past.slices}
+                    | set(past.new_groups))
+            if past.retire is not None:
+                # its own retiree is the one out-of-space index an
+                # archive may keep: the era walk and token translation
+                # for the shrink itself live there
+                refs.discard(past.retire)
+            if any(gi >= n for gi in refs):
+                metrics.counter(
+                    "scaleout_archives_retired_total").inc()
+                continue
+            kept.append(past)
+        self._archived_transitions = kept
 
     def _recover_transition(self) -> None:
         """Boot-time crash matrix (see rebalance.py): committed or
@@ -528,6 +632,23 @@ class ShardedEngine:
                 "the flag to clear this)", doc["old_version"], done_ver)
             return
         t = MapTransition.from_doc(doc, self.map)
+        if t.retire is not None and t.any_cut():
+            # SHRINK crash matrix, collapsed: GC runs BEFORE commit (it
+            # must address the sources in OLD index space), so any
+            # post-cut crash — mid-move, mid-GC, or between GC and
+            # commit — resumes the coordinator, which skips cut slices,
+            # re-runs GC only if the persisted gc_complete says it owes
+            # one (idempotent deletes), then commits and renumbers
+            log.warning(
+                "resuming interrupted shrink to map v%d (%d/%d slices "
+                "cut, gc_complete=%s)", t.new_map.version,
+                sum(1 for s in t.slices if s.state == "cut"),
+                len(t.slices), t.gc_complete)
+            self._install_transition(t)
+            self._coordinator = RebalanceCoordinator(self, t).start()
+            metrics.counter("scaleout_rebalance_transitions_total",
+                            outcome="resumed").inc()
+            return
         if doc.get("phase") == "committed" or t.all_cut():
             # raises if rebalance-added groups have no clients: serving
             # without them would misroute every cut slice (fail closed)
@@ -686,11 +807,18 @@ class ShardedEngine:
 
     def _resolve_token(self, revision) -> RevisionVector:
         """Watch resumption token -> a vector over TODAY's group space.
-        A token minted under a smaller map that a recorded transition
-        grew from is TRANSLATED (new components start at zero — the
-        rebalance event filter suppresses the pre-cut records there); a
-        token from an unknown map version, or with a component count no
-        transition explains, is REJECTED instead of misindexed."""
+        A token minted under a different map that recorded transitions
+        connect to today's is TRANSLATED step by step along the chain:
+        a GROW extends it with zero components (the rebalance event
+        filter suppresses the pre-cut records there); a SHRINK drops
+        the retired component — but only when the token already sits
+        at or past the transition's retire watermark (a token below it
+        missed retiring-group events no surviving group re-delivers:
+        StoreError, re-list semantics). A token from an unknown map
+        version, or with a component count no transition explains, is
+        REJECTED instead of misindexed. Version-tagged tokens enter
+        the chain at their minting epoch; untagged ones at the first
+        length match (exact for tagged, best-effort for raw vectors)."""
         if isinstance(revision, RevisionVector):
             vec, ver = revision, None
         elif isinstance(revision, int):
@@ -704,16 +832,41 @@ class ShardedEngine:
                 f" which this planner has no transition for (current: "
                 f"{self.map.version}); re-list and re-watch")
         n = len(self.groups)
-        if len(vec) == n:
+        if len(vec) == n and (ver is None or ver == self.map.version):
             return vec
-        if len(vec) < n:
-            grew = any(
-                t.old_map.n_groups == len(vec)
-                for t in ([self._active_transition]
-                          if self._active_transition is not None else [])
-                + self._archived_transitions)
-            if grew:
-                return vec.extend(n)
+        # committed transitions in commit order; the active one joins
+        # only while it still GROWS the space (its added groups already
+        # route) — an uncommitted shrink keeps the old space routing,
+        # so its tokens bind directly above
+        chain = list(self._archived_transitions)
+        act = self._active_transition
+        if act is not None and act.retire is None:
+            chain.append(act)
+        if ver is not None and ver != self.map.version:
+            start = next((i for i, t in enumerate(chain)
+                          if t.old_map.version == ver), None)
+        else:
+            start = next((i for i, t in enumerate(chain)
+                          if t.old_map.n_groups == len(vec)), None)
+        if start is not None:
+            for t in chain[start:]:
+                if t.old_map.n_groups != len(vec):
+                    continue  # a retired archive left a gap; skip
+                if t.retire is not None:
+                    cut = (t.retire_cut if t.retire_cut is not None
+                           else t.retire_watermark())
+                    if vec[t.retire] < int(cut or 0):
+                        raise StoreError(
+                            "watch token predates the shrink to map "
+                            f"v{t.new_map.version}: its component for "
+                            f"retired group {t.retire} stops at "
+                            f"{vec[t.retire]} but the group delivered "
+                            f"through {cut}; re-list and re-watch")
+                    vec = vec.drop_component(t.retire)
+                else:
+                    vec = vec.extend(t.new_map.n_groups)
+            if len(vec) == n:
+                return vec
         raise ShardMapError(
             f"watch token has {len(vec)} components but the planner "
             f"routes {n} groups and no recorded transition maps "
@@ -872,6 +1025,10 @@ class ShardedEngine:
             for c in self.groups:
                 self._mig_cut(c)
             m["phase"] = "done"
+            # the fleet now serves a NEW schema: re-derive the frontier
+            # reference pairs on next use (config-pinned pairs stand)
+            self._frontier_pairs = (None if self.frontier is None
+                                    else self.frontier.pairs)
             if self.journal is not None:
                 self.journal.clear_migration()
             metrics.counter("scaleout_schema_migrations_total",
@@ -965,6 +1122,7 @@ class ShardedEngine:
     _RETRYABLE_SCATTER = frozenset({
         "lookup_resources", "lookup_subjects", "read_relationships",
         "exists", "watch_since", "revision", "check_bulk",
+        "frontier_expand",
     })
 
     def _scatter(self, op: str, fn,
@@ -1100,6 +1258,12 @@ class ShardedEngine:
                 for gi, idxs in by_shard.items():
                     for pos, verdict in zip(idxs, results[gi]):
                         out[pos] = bool(verdict)
+        if self.frontier is not None and not all(out):
+            # cross-shard closure pass for the locally-denied residue:
+            # runs BEFORE the cache put so a frontier-granted verdict
+            # caches at vec_before like any other (and a denial stays
+            # a denial only after the exchange had its say)
+            out = self._frontier_recheck(items, out, now, context)
         if cache_key is not None:
             # keyed at the vector observed BEFORE dispatch: any write
             # landing during the dispatch advances the tracked vector
@@ -1134,6 +1298,14 @@ class ShardedEngine:
                     if rid not in seen:
                         seen.add(rid)
                         out.append(rid)
+        if self.frontier is not None:
+            # widen by the subject's cross-shard closure: each userset
+            # the subject transitively belongs to is looked up as a
+            # subject in its own right (exact for monotone schemas —
+            # reference_pairs refused anything else)
+            self._frontier_lookup_union(
+                out, seen, resource_type, permission, subject_type,
+                subject_id, subject_relation, now, context)
         metrics.histogram("scaleout_scatter_fanout").observe(
             len(results))
         return out
@@ -1188,6 +1360,178 @@ class ShardedEngine:
             out = sorted({sid for got in results.values()
                           for sid in got})
         return out
+
+    # -- cross-shard frontier exchange (scaleout/frontier.py) ----------------
+
+    def _frontier_pair_set(self) -> tuple:
+        """The schema's reference pairs, resolved lazily on first use:
+        config-pinned, else asked of group 0 over the wire
+        (``frontier_pairs`` op), else derived from its in-process
+        schema. Every group serves the same schema (the coordinated
+        migration guarantees it), so one group's answer is THE answer;
+        the coordinated cut resets the cache so a migrated schema
+        re-derives. A non-monotone schema raises FrontierError here —
+        the exchange refuses to run rather than compose wrong."""
+        if self.frontier is None:
+            return ()
+        pairs = self._frontier_pairs
+        if pairs is None:
+            c = self.groups[0]
+            if hasattr(c, "frontier_pairs"):
+                pairs = c.frontier_pairs()
+            else:
+                from .frontier import reference_pairs
+                pairs = reference_pairs(c.schema)
+            pairs = tuple(sorted((str(t), str(r)) for t, r in pairs))
+            self._frontier_pairs = pairs
+        return pairs
+
+    def _frontier_leg(self, gi: int, c, descs, pairs, now, context):
+        if hasattr(c, "frontier_expand"):
+            return c.frontier_expand(descs, pairs, now=now,
+                                     context=context)
+        from .frontier import expand_local
+        return expand_local(c, descs, pairs, now=now, context=context)
+
+    def frontier_closure(self, subject_type: str, subject_id: str,
+                         subject_relation: Optional[str] = None,
+                         now: Optional[float] = None,
+                         context: Optional[dict] = None) -> set:
+        """The subject's cross-shard membership closure: every userset
+        descriptor ``(type, id, relation)`` the subject transitively
+        belongs to, computed by the iterative frontier exchange
+        (scaleout/frontier.py module docstring). Each round scatters
+        ONLY the newly-resolved boundary descriptors — the wire-bytes
+        counters measure exactly the canonical encoding of what moved,
+        in both directions. The round budget is HARD and fails CLOSED:
+        an exhausted exchange returns the partial closure, which can
+        only under-approximate (deny / under-list, never over-grant)."""
+        pairs = self._frontier_pair_set()
+        if not pairs:
+            return set()
+        from .frontier import encode_frontier
+        max_rounds = max(1, int(self.frontier.max_rounds))
+        seed = (str(subject_type), str(subject_id),
+                None if subject_relation is None
+                else str(subject_relation))
+        visited = {seed}
+        frontier = {seed}
+        closure: set = set()
+        rounds = 0
+        outcome = "converged"
+        with tracer.span("frontier_exchange",
+                         subject=f"{seed[0]}:{seed[1]}"):
+            while frontier:
+                if rounds >= max_rounds:
+                    outcome = "budget-exhausted"
+                    log.warning(
+                        "frontier exchange for %s:%s exhausted its "
+                        "%d-round budget with %d descriptors still "
+                        "unexpanded; proceeding with the partial "
+                        "closure (fail-closed: may deny/under-list, "
+                        "never over-grants)", seed[0], seed[1],
+                        max_rounds, len(frontier))
+                    break
+                rounds += 1
+                payload = encode_frontier(frontier)
+                metrics.counter(
+                    "scaleout_frontier_boundary_tuples_total").inc(
+                        len(frontier))
+                descs = sorted(
+                    frontier, key=lambda d: (d[0], d[1], d[2] or ""))
+                results = self._scatter(
+                    "frontier_expand",
+                    lambda gi, c, _d=descs: self._frontier_leg(
+                        gi, c, _d, pairs, now, context))
+                nxt: set = set()
+                for gi in sorted(results):
+                    got = results[gi]
+                    metrics.counter(
+                        "scaleout_frontier_wire_bytes_total",
+                        direction="scatter").inc(len(payload))
+                    metrics.counter(
+                        "scaleout_frontier_wire_bytes_total",
+                        direction="gather").inc(
+                            len(encode_frontier(got)))
+                    for d in got:
+                        # mover copies filter here like any gather: a
+                        # not-yet-cut destination (or un-GC'd source)
+                        # must not smuggle a membership its read owner
+                        # doesn't serve
+                        if self._admit_gathered(gi, d[0], d[1]):
+                            nxt.add(d)
+                fresh = nxt - visited
+                visited |= fresh
+                closure |= fresh
+                frontier = fresh
+        metrics.histogram("scaleout_frontier_rounds").observe(rounds)
+        metrics.counter("scaleout_frontier_exchanges_total",
+                        outcome=outcome).inc()
+        return closure
+
+    def _frontier_recheck(self, items: list, out: list, now, context
+                          ) -> list:
+        """Second check pass for locally-denied items: compute each
+        denied subject's closure once, then re-check the item on its
+        resource's read owner with every closure descriptor as the
+        subject — the owner holds the ``resource -> userset`` tuple and
+        the engine seeds userset subjects natively, so ANY True means
+        the cross-shard path exists and the item is granted. Monotone
+        schemas only (enforced at pair derivation), so the union of
+        verdicts is exact."""
+        closures: dict = {}
+        for pos, it in enumerate(items):
+            if out[pos]:
+                continue
+            skey = (it.subject_type, it.subject_id,
+                    it.subject_relation)
+            if skey not in closures:
+                closures[skey] = sorted(
+                    self.frontier_closure(*skey, now=now,
+                                          context=context),
+                    key=lambda d: (d[0], d[1], d[2] or ""))
+            descs = closures[skey]
+            if not descs:
+                continue
+            gi = self._read_anchor(it.resource_type, it.resource_id)
+            checks = [CheckItem(it.resource_type, it.resource_id,
+                                it.permission, t, i, rel)
+                      for (t, i, rel) in descs]
+            verdicts = self._single(
+                gi, "check_bulk",
+                lambda c, _ck=checks: c.check_bulk(
+                    _ck, now=now, context=context))
+            if any(verdicts):
+                out[pos] = True
+        return out
+
+    def _frontier_lookup_union(self, out: list, seen: set,
+                               resource_type: str, permission: str,
+                               subject_type: str, subject_id: str,
+                               subject_relation, now, context) -> None:
+        """Widen a gathered lookup by the subject's closure: each
+        closure descriptor runs its own scatter as the subject, and the
+        results union in (owner-filtered and deduped like the primary
+        gather). Appends into ``out``/``seen`` in place."""
+        closure = sorted(
+            self.frontier_closure(subject_type, subject_id,
+                                  subject_relation, now=now,
+                                  context=context),
+            key=lambda d: (d[0], d[1], d[2] or ""))
+        for t, i, rel in closure:
+            results = self._scatter(
+                "lookup_resources",
+                lambda gi, c, _t=t, _i=i, _r=rel: c.lookup_resources(
+                    resource_type, permission, _t, _i, _r,
+                    now=now, context=context))
+            for gi in sorted(results):
+                for rid in results[gi]:
+                    if not self._admit_gathered(gi, resource_type,
+                                                rid):
+                        continue
+                    if rid not in seen:
+                        seen.add(rid)
+                        out.append(rid)
 
     # -- relationship reads --------------------------------------------------
 
@@ -1669,7 +2013,7 @@ class ShardedEngine:
             # the crash matrix at the next boot
             self._coordinator.stop()
         self._pool.shutdown(wait=False, cancel_futures=True)
-        for c in self.groups:
+        for c in list(self.groups) + list(self._retired_clients):
             try:
                 if hasattr(c, "close"):
                     c.close()

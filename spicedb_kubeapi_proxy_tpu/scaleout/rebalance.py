@@ -173,18 +173,53 @@ class _SliceGate:
             self._cv.notify_all()
 
 
-def plan_moves(old_map: ShardMap, new_map: ShardMap) -> list:
+def shrink_map(old_map: ShardMap, retire: Optional[int] = None,
+               version: Optional[int] = None) -> ShardMap:
+    """The one-group-smaller target map of a SHRINK transition:
+    ``old_map`` minus the retiring group (default: the LAST one — the
+    only index whose removal leaves every survivor's ring points, and
+    so every survivor's data placement, untouched)."""
+    if old_map.n_groups < 2:
+        raise RebalanceError("cannot shrink a single-group map")
+    if retire is None:
+        retire = old_map.n_groups - 1
+    if not 0 <= retire < old_map.n_groups:
+        raise RebalanceError(
+            f"retire index {retire} out of range for a "
+            f"{old_map.n_groups}-group map")
+    groups = old_map.groups[:retire] + old_map.groups[retire + 1:]
+    return ShardMap(
+        version=old_map.version + 1 if version is None else int(version),
+        groups=groups, virtual_nodes=old_map.virtual_nodes)
+
+
+def plan_moves(old_map: ShardMap, new_map: ShardMap,
+               retire: Optional[int] = None) -> list:
     """Diff two maps' ring assignments into the moving slice set:
     merge both rings' boundary points, sample each segment's owner
     under both maps, and coalesce adjacent segments with the same
     ``(src, dst)``. Group INDEX is identity across the transition —
     group *i* of the new map is the same logical group as group *i*
     of the old (new maps may append groups; surviving indices keep
-    their data except for the diffed slices)."""
+    their data except for the diffed slices).
+
+    A SHRINK diff names the ``retire``-d group: the new map has one
+    fewer group and its indices renumber past the gap, so new-map
+    owners translate back into the OLD index space (``ni`` -> ``ni``
+    below the gap, ``ni + 1`` at or above it) — the emitted slices'
+    ``src``/``dst`` always address the planner's CURRENT clients, and
+    every slice the retiring group owned moves off it."""
     bounds = sorted(set(old_map.ring_points())
                     | set(new_map.ring_points()))
     if not bounds:
         return []
+
+    def _dst(h: int) -> int:
+        ni = new_map.owner_of_hash(h)
+        if retire is not None and ni >= retire:
+            return ni + 1
+        return ni
+
     segs = []  # (lo, hi, src, dst) half-open over [0, HASH_SPACE)
     # segment starting at each boundary, up to the next one; the ring
     # wraps, so the last boundary's segment splits into [last, 2^32)
@@ -192,13 +227,13 @@ def plan_moves(old_map: ShardMap, new_map: ShardMap) -> list:
     for i, lo in enumerate(bounds):
         hi = bounds[i + 1] if i + 1 < len(bounds) else HASH_SPACE
         src = old_map.owner_of_hash(lo)
-        dst = new_map.owner_of_hash(lo)
+        dst = _dst(lo)
         if src != dst:
             segs.append((lo, hi, src, dst))
     lo0 = bounds[0]
     if lo0 > 0:
         src = old_map.owner_of_hash(0)
-        dst = new_map.owner_of_hash(0)
+        dst = _dst(0)
         if src != dst:
             segs.append((0, lo0, src, dst))
     segs.sort()
@@ -227,13 +262,38 @@ class MapTransition:
     persisted by the coordinator before it takes routing effect."""
 
     def __init__(self, old_map: ShardMap, new_map: ShardMap,
-                 slices: list):
+                 slices: list, retire: Optional[int] = None):
         if new_map.version <= old_map.version:
             raise RebalanceError(
                 f"rebalance target map version {new_map.version} must "
                 f"exceed the current version {old_map.version}")
+        if retire is None and new_map.n_groups < old_map.n_groups:
+            raise RebalanceError(
+                "a transition to a smaller map must name the retiring "
+                "group (plan it through begin_rebalance / shrink_map)")
+        if retire is not None:
+            if new_map.n_groups != old_map.n_groups - 1:
+                raise RebalanceError(
+                    "a shrink transition retires exactly ONE group per "
+                    f"map version (old {old_map.n_groups} groups, new "
+                    f"{new_map.n_groups})")
+            if not 0 <= retire < old_map.n_groups:
+                raise RebalanceError(
+                    f"retire index {retire} out of range for a "
+                    f"{old_map.n_groups}-group map")
         self.old_map = old_map
         self.new_map = new_map
+        # SHRINK: the OLD-space index of the group this transition
+        # empties and removes (None for grow/steady transitions). The
+        # slices' src/dst stay in old index space throughout — the
+        # planner renumbers only at commit.
+        self.retire = retire
+        # set at commit: the retiring group's final delivered revision
+        # (max src-side cut over its outgoing slices) — the watermark
+        # resumption-token translation checks before dropping the
+        # component (a token below it missed src-era events that no
+        # surviving group will ever re-deliver)
+        self.retire_cut: Optional[int] = None
         self.slices = list(slices)
         self._lock = threading.Lock()
         # range index for slice_for: sorted (lo, hi, slice)
@@ -262,6 +322,18 @@ class MapTransition:
         # transition (the watch-delivery era walk stays — history
         # replays still span the cutover)
         self.gc_complete = False
+
+    def retire_watermark(self) -> Optional[int]:
+        """The retiring group's final delivered revision: the max
+        src-side cut over its outgoing slices (0 when it owned no
+        moving slice). A resumption token whose retiring component is
+        at or past this has consumed every event the group's eras will
+        ever deliver — everything later lives in the destinations'
+        histories past their dst cuts."""
+        if self.retire is None:
+            return None
+        return max((int(sl.src_cut or 0) for sl in self.slices
+                    if sl.src == self.retire), default=0)
 
     # -- membership ----------------------------------------------------------
 
@@ -372,6 +444,12 @@ class MapTransition:
                 "old_version": self.old_map.version,
                 "new_map": map_to_doc(self.new_map),
                 "seed_cuts": seed_cuts,
+                "retire": self.retire,
+                "retire_cut": self.retire_cut,
+                # shrink runs GC BEFORE commit (old indices must still
+                # name the mover's clients), so the crash matrix needs
+                # the GC watermark durably, not implied by the phase
+                "gc_complete": bool(self.gc_complete),
                 "slices": [sl.to_doc() for sl in self.slices]}
 
     @classmethod
@@ -384,7 +462,12 @@ class MapTransition:
                 "placement is authoritative")
         new_map = map_from_doc(doc["new_map"])
         t = cls(old_map, new_map,
-                [MovingSlice.from_doc(d) for d in doc["slices"]])
+                [MovingSlice.from_doc(d) for d in doc["slices"]],
+                retire=(None if doc.get("retire") is None
+                        else int(doc["retire"])))
+        t.retire_cut = (None if doc.get("retire_cut") is None
+                        else int(doc["retire_cut"]))
+        t.gc_complete = bool(doc.get("gc_complete", False))
         t.seed_cuts = {int(k): int(v)
                        for k, v in (doc.get("seed_cuts") or {}).items()}
         # a restart loses the in-memory seeded latch (the coordinator
@@ -632,10 +715,28 @@ class RebalanceCoordinator:
             for sl in self.t.slices:
                 if self.t.state_of(sl) != CUT:
                     self._move_slice(sl)
-            self.planner.commit_rebalance(self.t)
-            self._persist("committed")
-            self._gc()
-            self.t.gc_complete = True
+            if self.t.retire is not None:
+                # SHRINK: GC runs BEFORE commit — the slices' src/dst
+                # are OLD-space indices, and commit removes the retiring
+                # group from the planner's client list, so post-commit
+                # the mover could no longer address the sources. Safe
+                # ordering: every slice is cut (reads/writes route to
+                # dst), the active transition keeps the scatter-merge
+                # owner filter up, and the era filter suppresses the GC
+                # deletes — exactly the grow-GC guarantees, one phase
+                # earlier. A crash in here resumes via any/all-cut with
+                # the persisted gc_complete deciding whether GC re-runs
+                # (idempotent deletes either way).
+                if not self.t.gc_complete:
+                    self._gc()
+                    self.t.gc_complete = True
+                    self._persist()
+                self.planner.commit_rebalance(self.t)
+            else:
+                self.planner.commit_rebalance(self.t)
+                self._persist("committed")
+                self._gc()
+                self.t.gc_complete = True
             # the record flips to phase "done" instead of clearing:
             # a restart whose CLI flags still say --shard-map V
             # --rebalance-to V+1 must find durable proof that V+1 is
@@ -844,5 +945,5 @@ def abort_transition(planner, transition: MapTransition) -> None:
 __all__ = [
     "CATCHUP", "COPYING", "CUT", "DUAL", "PLANNED",
     "MapTransition", "MovingSlice", "RebalanceCoordinator",
-    "RebalanceError", "abort_transition", "plan_moves",
+    "RebalanceError", "abort_transition", "plan_moves", "shrink_map",
 ]

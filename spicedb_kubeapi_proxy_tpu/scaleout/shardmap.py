@@ -81,6 +81,22 @@ class RevisionVector(tuple):
             return self
         return RevisionVector(tuple(self) + (0,) * (n - len(self)))
 
+    def drop_component(self, shard: int) -> "RevisionVector":
+        """This vector with ``shard``'s component REMOVED — the
+        shrink-transition translation, dual to :meth:`extend`: once a
+        retiring group's slices have all cut over and its copies are
+        GC'd, the group's history is closed and surviving components
+        renumber down by one past the gap. Only valid when the dropped
+        component's consumer has already observed everything the
+        retiring group will ever produce (the planner checks the token
+        against the transition's cut watermark before translating)."""
+        if not 0 <= shard < len(self):
+            raise ShardMapError(
+                f"cannot drop component {shard} from a "
+                f"{len(self)}-component revision vector")
+        return RevisionVector(tuple(self)[:shard]
+                              + tuple(self)[shard + 1:])
+
     def encode(self, map_version: Optional[int] = None) -> str:
         """``v1.2.3`` — or ``v1.2.3@m4`` when ``map_version`` is given:
         the shard-map version the component INDICES were minted under.

@@ -1,0 +1,632 @@
+"""Elastic scale-out in both directions (ISSUE 20).
+
+Covers the acceptance surface:
+
+- RevisionVector component removal and shrink-token translation
+  (tokens at/past the retire watermark translate; tokens below it get
+  StoreError re-list semantics; unknown map versions are rejected —
+  never misindexed);
+- grow -> shrink end-to-end: the retiring tail group drains through
+  the existing copy/catch-up/cutover machinery, its copies GC, the
+  routing space renumbers, zero data loss;
+- the shrink crash matrix: a post-cut SIGKILL resumes the coordinator
+  at boot and completes the retire;
+- the archive-retirement regression (satellite 1): grow->shrink cycles
+  must not pin stale scatter-merge owner filters through dead-index
+  archives;
+- cross-shard frontier exchange: oracle parity for cross-namespace
+  reference schemas WITHOUT replication, boundary-only wire accounting,
+  hard round budget failing CLOSED, non-monotone schemas refused;
+- the autoscale policy kernel (hysteresis, cooldown, never-shrink-
+  while-burning, knob parsing/validation) and the controller
+  end-to-end in apply mode driving REAL grow and shrink transitions.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from spicedb_kubeapi_proxy_tpu.autoscale import (  # noqa: E402
+    AutoscaleController,
+    AutoscaleError,
+    AutoscalePolicy,
+    PolicyConfig,
+    Signals,
+    parse_policy,
+)
+from spicedb_kubeapi_proxy_tpu.engine import Engine  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine.engine import CheckItem  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.engine.store import (  # noqa: E402
+    RelationshipFilter,
+    StoreError,
+    WriteOp,
+)
+from spicedb_kubeapi_proxy_tpu.models.tuples import (  # noqa: E402
+    Relationship,
+)
+from spicedb_kubeapi_proxy_tpu.scaleout import (  # noqa: E402
+    FrontierConfig,
+    FrontierError,
+    MapTransition,
+    RebalanceCoordinator,
+    RevisionVector,
+    ShardedEngine,
+    ShardMap,
+    ShardMapError,
+    SplitJournal,
+    plan_moves,
+    reference_pairs,
+    shrink_map,
+)
+from spicedb_kubeapi_proxy_tpu.utils.metrics import metrics  # noqa: E402
+
+SCHEMA_YAML = """\
+schema: |-
+  definition user {}
+
+  definition namespace {
+    relation viewer: user
+    permission view = viewer
+  }
+
+  definition pod {
+    relation namespace: namespace
+    relation viewer: user
+    permission view = viewer + namespace->view
+  }
+relationships: ""
+"""
+
+# cross-namespace reference schema: docs grant through team usersets
+# that live in OTHER namespaces — the schema class PR 11 forced to be
+# cluster-scoped (replicated) and the frontier exchange now serves
+# from single-copy placement
+FRONTIER_YAML = """\
+schema: |-
+  definition user {}
+
+  definition team {
+    relation member: user | team#member
+    permission view = member
+  }
+
+  definition doc {
+    relation owner: team#member
+    relation viewer: user
+    permission view = viewer + owner
+  }
+relationships: ""
+"""
+
+
+def _engine(yaml: str = SCHEMA_YAML) -> Engine:
+    return Engine(bootstrap=yaml)
+
+
+def _map(n: int, version: int = 1, vnodes: int = 64) -> ShardMap:
+    return ShardMap(version=version,
+                    groups=tuple((("127.0.0.1", 0),) for _ in range(n)),
+                    virtual_nodes=vnodes)
+
+
+def rel(rt, rid, rl, st, sid, srl=None) -> Relationship:
+    return Relationship(rt, rid, rl, st, sid, srl)
+
+
+def _seed_writes(n_ns: int, users: int = 4) -> list:
+    out = []
+    for i in range(n_ns):
+        out.append(WriteOp("create", rel(
+            "namespace", f"ns{i}", "viewer", "user", f"u{i % users}")))
+        out.append(WriteOp("create", rel(
+            "pod", f"ns{i}/p0", "namespace", "namespace", f"ns{i}")))
+        out.append(WriteOp("create", rel(
+            "pod", f"ns{i}/p0", "viewer", "user", f"u{i % users}")))
+    return out
+
+
+def _ns_on(smap: ShardMap, rtype: str, group: int, tag: str) -> str:
+    """A namespace name the map routes to ``group`` for ``rtype``."""
+    for i in range(10_000):
+        ns = f"{tag}{i}"
+        if smap.shard_for(ns, rtype) == group:
+            return ns
+    raise AssertionError(f"no {tag}* namespace lands on group {group}")
+
+
+def _wait_gc(p: ShardedEngine, budget: float = 30.0) -> None:
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if all(t.gc_complete for t in p._archived_transitions):
+            return
+        time.sleep(0.02)
+    raise AssertionError("archived transitions never finished GC")
+
+
+# -- revision-vector component removal ---------------------------------------
+
+
+def test_drop_component_units():
+    v = RevisionVector((5, 7, 9))
+    assert v.drop_component(2) == (5, 7)
+    assert v.drop_component(0) == (7, 9)
+    assert isinstance(v.drop_component(1), RevisionVector)
+    with pytest.raises(ShardMapError, match="drop component"):
+        v.drop_component(3)
+    with pytest.raises(ShardMapError, match="drop component"):
+        v.drop_component(-1)
+
+
+def test_shrink_token_translation():
+    engines = [_engine(), _engine()]
+    p = ShardedEngine(_map(2), engines)
+    p.write_relationships(_seed_writes(12))
+    # mint a resumption token under the 2-group map, quiesced: its
+    # retired-group component sits exactly AT the watermark the shrink
+    # will record, so translation must accept it
+    tok_at = p.revision_vector().encode(map_version=1)
+    coord = p.begin_rebalance(shrink_map(p.map))
+    assert coord.wait(90) and coord.error is None
+    assert p.map.version == 2 and len(p.groups) == 1
+    assert len(p.revision_vector()) == 1
+    t = p._archived_transitions[-1]
+    assert t.retire == 1 and t.retire_cut is not None
+    # at/past the cut: translated through the recorded transition
+    p.watch_since(tok_at)
+    # below the cut: the retiring group delivered events no survivor
+    # re-delivers — re-list semantics, loudly
+    assert int(t.retire_cut) > 0
+    with pytest.raises(StoreError, match="predates the shrink"):
+        p.watch_since("v0.0@m1")
+    # unknown minting epoch: rejected, never misindexed
+    with pytest.raises(ShardMapError, match="no transition"):
+        p.watch_since("v0.0@m9")
+    # a component count no recorded transition explains
+    with pytest.raises(ShardMapError, match="no recorded transition"):
+        p.watch_since("v0.0.0.0")
+    p.close()
+
+
+# -- grow -> shrink end-to-end ------------------------------------------------
+
+
+def test_grow_then_shrink_round_trip():
+    n_ns = 16
+    engines = [_engine(), _engine()]
+    p = ShardedEngine(_map(2), engines)
+    p.write_relationships(_seed_writes(n_ns))
+
+    extra = _engine()
+    grown = ShardMap(version=2,
+                     groups=p.map.groups + ((("127.0.0.1", 0),),),
+                     virtual_nodes=64)
+    coord = p.begin_rebalance(grown, new_clients={2: extra})
+    assert coord.wait(90) and coord.error is None
+    engines.append(extra)
+    assert p.map.version == 3 - 1 and len(p.groups) == 3
+    _wait_gc(p)
+    moved = [i for i in range(n_ns)
+             if p.map.shard_for(f"ns{i}", "pod") == 2]
+    assert moved, "grow moved nothing to the new group"
+
+    coord = p.begin_rebalance(shrink_map(p.map))
+    assert coord.wait(90) and coord.error is None
+    assert p.map.version == 3 and len(p.groups) == 2
+    assert len(p.revision_vector()) == 2
+    assert p.rebalance_status() is None
+    _wait_gc(p)
+    # zero loss: every seeded grant still answers, on the shrunken map
+    for i in range(n_ns):
+        assert p.check(CheckItem("pod", f"ns{i}/p0", "view", "user",
+                                 f"u{i % 4}")), i
+        assert not p.check(CheckItem("pod", f"ns{i}/p0", "view",
+                                     "user", "intruder")), i
+    # the retiree drained: its copies were moved off and GC'd
+    f = RelationshipFilter(resource_type="pod")
+    assert not extra.store.exists(f)
+    # placement matches the committed map exactly (no survivor moved)
+    for i in range(n_ns):
+        ff = RelationshipFilter(resource_type="pod",
+                                resource_id=f"ns{i}/p0")
+        holders = [gi for gi, e in enumerate(engines[:2])
+                   if e.store.exists(ff)]
+        assert holders == [p.map.shard_for(f"ns{i}", "pod")], i
+    p.close()
+
+
+def test_shrink_crash_after_cut_resumes(tmp_path):
+    """SIGKILL mid-shrink after >= 1 slice cut: boot resumes the
+    coordinator, finishes the drain + GC, commits and renumbers."""
+    n_ns = 12
+    old = _map(3, 1)
+    new = shrink_map(old, version=2)
+    engines = [_engine(), _engine(), _engine()]
+    journal = SplitJournal(str(tmp_path / "sj.sqlite"))
+    p = ShardedEngine(old, engines, journal=journal)
+    p.write_relationships(_seed_writes(n_ns))
+    t = MapTransition(old, new, plan_moves(old, new, retire=2),
+                      retire=2)
+    p._install_transition(t)
+    coord = RebalanceCoordinator(p, t)
+    for i, sl in enumerate(t.slices):
+        copy_rev, rows = coord._slice_read(sl.src, sl.ranges)
+        coord._slice_load(sl.dst, rows)
+        t.set_state(sl, "catchup", copy_rev=copy_rev,
+                    replayed=copy_rev)
+        while coord._catch_up_once(sl) > 0:
+            pass
+        if i == 0:
+            src_cut = coord._src_revision(sl.src)
+            dst_cut = coord._src_revision(sl.dst)
+            t.set_state(sl, "cut", src_cut=src_cut, dst_cut=dst_cut)
+    coord._persist()
+    p.close(close_journal=False)  # the "SIGKILL": record stays
+
+    p2 = ShardedEngine(old, engines, journal=journal)
+    assert p2._coordinator is not None  # resumed at boot
+    assert p2._coordinator.wait(90)
+    assert p2._coordinator.error is None, p2._coordinator.error
+    assert p2.map.version == 2 and len(p2.groups) == 2
+    _wait_gc(p2)
+    for i in range(n_ns):
+        assert p2.check(CheckItem("pod", f"ns{i}/p0", "view", "user",
+                                  f"u{i % 4}")), i
+    assert not engines[2].store.exists(
+        RelationshipFilter(resource_type="pod"))
+    p2.close()
+
+
+# -- archive retirement across grow->shrink cycles (satellite 1) --------------
+
+
+def test_stale_archives_retired_across_grow_shrink_grow():
+    """The first grow's archive references group index 2; after the
+    shrink renumbers to a 2-group space that archive would pin
+    ``_copies_may_linger`` open (per-row owner filtering on every
+    scatter) and make the era walk compare dead indices forever.
+    Commit must retire it — and routing must stay exact after."""
+    n_ns = 12
+    engines = [_engine(), _engine()]
+    p = ShardedEngine(_map(2), engines)
+    p.write_relationships(_seed_writes(n_ns))
+    retired0 = metrics.counter("scaleout_archives_retired_total").value
+
+    def grow():
+        extra = _engine()
+        grown = ShardMap(version=p.map.version + 1,
+                         groups=p.map.groups + ((("127.0.0.1", 0),),),
+                         virtual_nodes=64)
+        coord = p.begin_rebalance(grown, new_clients={2: extra})
+        assert coord.wait(90) and coord.error is None
+        _wait_gc(p)
+        return extra
+
+    grow()
+    coord = p.begin_rebalance(shrink_map(p.map))
+    assert coord.wait(90) and coord.error is None
+    _wait_gc(p)
+    # the grow archive referenced index 2 and died with the shrink
+    assert metrics.counter(
+        "scaleout_archives_retired_total").value > retired0
+    n = len(p.groups)
+    for past in p._archived_transitions:
+        refs = ({sl.src for sl in past.slices}
+                | {sl.dst for sl in past.slices})
+        refs.discard(past.retire)
+        assert all(gi < n for gi in refs), (past.retire, refs)
+    grow()  # a fresh cycle must start clean, not inherit dead filters
+    assert not p._copies_may_linger()
+    for i in range(n_ns):
+        assert p.check(CheckItem("pod", f"ns{i}/p0", "view", "user",
+                                 f"u{i % 4}")), i
+        assert not p.check(CheckItem("pod", f"ns{i}/p0", "view",
+                                     "user", "intruder")), i
+    p.close()
+
+
+# -- cross-shard frontier exchange -------------------------------------------
+
+
+def test_reference_pairs_extraction_and_refusal():
+    pairs = reference_pairs(_engine(FRONTIER_YAML).schema)
+    assert pairs == (("team", "member"),)
+    # no userset references at all: nothing to exchange
+    assert reference_pairs(_engine(SCHEMA_YAML).schema) == ()
+    bad = """\
+schema: |-
+  definition user {}
+
+  definition team {
+    relation member: user
+  }
+
+  definition doc {
+    relation owner: team#member
+    relation banned: user
+    permission view = owner - banned
+  }
+relationships: ""
+"""
+    with pytest.raises(FrontierError, match="monotone"):
+        reference_pairs(_engine(bad).schema)
+
+
+def _frontier_fixture(max_rounds: int = 8):
+    """2-group planner + unsharded oracle over FRONTIER_YAML, with a
+    2-hop cross-shard chain: u0 -> teamB (group 0) -> teamA (group 1)
+    -> doc (group 0, owned by teamA#member). No tuple is replicated."""
+    smap = _map(2)
+    engines = [_engine(FRONTIER_YAML), _engine(FRONTIER_YAML)]
+    p = ShardedEngine(smap, engines,
+                      frontier=FrontierConfig(max_rounds=max_rounds))
+    oracle = _engine(FRONTIER_YAML)
+    ns_b = _ns_on(smap, "team", 0, "tb")
+    ns_a = _ns_on(smap, "team", 1, "ta")
+    ns_d = _ns_on(smap, "doc", 0, "dd")
+    team_b, team_a, doc = f"{ns_b}/t", f"{ns_a}/t", f"{ns_d}/d"
+    writes = [
+        WriteOp("create", rel("team", team_b, "member", "user", "u0")),
+        WriteOp("create", rel("team", team_a, "member", "team",
+                              team_b, "member")),
+        WriteOp("create", rel("doc", doc, "owner", "team", team_a,
+                              "member")),
+        WriteOp("create", rel("doc", doc, "viewer", "user", "direct")),
+    ]
+    p.write_relationships(writes)
+    oracle.write_relationships(writes)
+    return p, engines, oracle, (team_b, team_a, doc)
+
+
+def test_frontier_cross_shard_oracle_parity():
+    p, engines, oracle, (team_b, team_a, doc) = _frontier_fixture()
+    scatter0 = metrics.counter("scaleout_frontier_wire_bytes_total",
+                               direction="scatter").value
+    gather0 = metrics.counter("scaleout_frontier_wire_bytes_total",
+                              direction="gather").value
+    conv0 = metrics.counter("scaleout_frontier_exchanges_total",
+                            outcome="converged").value
+    # single-copy placement, proven: each membership tuple exists on
+    # exactly one group (this is what PR 11 would have replicated)
+    for rt, rid in (("team", team_b), ("team", team_a), ("doc", doc)):
+        f = RelationshipFilter(resource_type=rt, resource_id=rid)
+        assert sum(1 for e in engines if e.store.exists(f)) == 1
+    for sid, rid in (("u0", doc), ("direct", doc), ("intruder", doc)):
+        want = oracle.check(CheckItem("doc", rid, "view", "user", sid))
+        got = p.check(CheckItem("doc", rid, "view", "user", sid))
+        assert got == want, (sid, got, want)
+    assert p.check(CheckItem("doc", doc, "view", "user", "u0"))
+    # lookup parity: the closure widens the gather the same way
+    assert sorted(p.lookup_resources("doc", "view", "user", "u0")) \
+        == sorted(oracle.lookup_resources("doc", "view", "user", "u0"))
+    assert p.lookup_resources("doc", "view", "user", "intruder") == []
+    # boundary-only mass moved, and it was counted in BOTH directions
+    scatter = metrics.counter("scaleout_frontier_wire_bytes_total",
+                              direction="scatter").value - scatter0
+    gather = metrics.counter("scaleout_frontier_wire_bytes_total",
+                             direction="gather").value - gather0
+    assert 0 < scatter < 4096 and 0 < gather < 4096
+    assert metrics.counter("scaleout_frontier_exchanges_total",
+                           outcome="converged").value > conv0
+    p.close()
+
+
+def test_frontier_budget_exhaustion_fails_closed():
+    # the chain needs two exchange rounds; a 1-round budget must stop
+    # short and DENY (under-approximate), never grant, and count the
+    # exhaustion
+    p, _, oracle, (team_b, team_a, doc) = _frontier_fixture(
+        max_rounds=1)
+    exh0 = metrics.counter("scaleout_frontier_exchanges_total",
+                           outcome="budget-exhausted").value
+    assert oracle.check(CheckItem("doc", doc, "view", "user", "u0"))
+    assert not p.check(CheckItem("doc", doc, "view", "user", "u0"))
+    assert metrics.counter("scaleout_frontier_exchanges_total",
+                           outcome="budget-exhausted").value > exh0
+    # direct grants on the resource's own group are untouched
+    assert p.check(CheckItem("doc", doc, "view", "user", "direct"))
+    p.close()
+
+
+# -- the policy kernel --------------------------------------------------------
+
+
+def test_policy_hysteresis_and_cooldown():
+    pol = AutoscalePolicy(PolicyConfig(
+        min_groups=1, max_groups=4, hysteresis_ticks=3,
+        cooldown_seconds=100.0))
+    hot = Signals(n_groups=2, occupancy=0.9)
+    cold = Signals(n_groups=2, occupancy=0.1)
+    # two hot ticks then a cold one: the streak restarts — flapping
+    # around the threshold proposes nothing
+    assert pol.observe(hot, now=0.0) is None
+    assert pol.observe(hot, now=1.0) is None
+    assert pol.observe(cold, now=2.0) is None
+    assert pol.observe(hot, now=3.0) is None
+    assert pol.observe(hot, now=4.0) is None
+    prop = pol.observe(hot, now=5.0)
+    assert prop is not None and prop.action == "grow"
+    assert prop.target_groups == 3
+    # inside the cooldown even a completed streak fires nothing...
+    for ts in (6.0, 7.0, 8.0, 9.0):
+        assert pol.observe(hot, now=ts) is None
+    # ...and it fires on the first tick past the cooldown (the streak
+    # kept accruing — the signal never stopped saying grow)
+    assert pol.observe(hot, now=106.0) is not None
+
+
+def test_policy_guards():
+    pol = AutoscalePolicy(PolicyConfig(
+        min_groups=1, max_groups=3, hysteresis_ticks=1,
+        cooldown_seconds=0.0))
+    # never-shrink-while-burning: idle occupancy but the error budget
+    # is burning at objective-failing rate
+    burning = Signals(n_groups=2, occupancy=0.05, burn_rate=1.5)
+    assert pol.observe(burning, now=0.0) is None
+    calm = Signals(n_groups=2, occupancy=0.05, burn_rate=0.2)
+    prop = pol.observe(calm, now=1.0)
+    assert prop is not None and prop.action == "shrink"
+    assert prop.target_groups == 1
+    # bounds: min_groups floors the shrink, max_groups caps the grow
+    assert pol.observe(Signals(n_groups=1, occupancy=0.0),
+                       now=2.0) is None
+    assert pol.observe(Signals(n_groups=3, occupancy=0.99),
+                       now=3.0) is None
+    # an in-flight transition (or owed GC) resets the streak
+    pol2 = AutoscalePolicy(PolicyConfig(hysteresis_ticks=2,
+                                        cooldown_seconds=0.0))
+    hot = Signals(n_groups=2, occupancy=0.9)
+    assert pol2.observe(hot, now=0.0) is None
+    assert pol2.observe(Signals(n_groups=2, occupancy=0.9,
+                                rebalance_active=True), now=1.0) is None
+    assert pol2.observe(hot, now=2.0) is None  # re-earning
+    assert pol2.observe(hot, now=3.0) is not None
+    # SLO burn alone triggers a grow
+    pol3 = AutoscalePolicy(PolicyConfig(hysteresis_ticks=1,
+                                        cooldown_seconds=0.0))
+    prop = pol3.observe(Signals(n_groups=2, occupancy=0.1,
+                                burn_rate=5.0), now=0.0)
+    assert prop is not None and prop.action == "grow"
+
+
+def test_policy_parsing_and_validation():
+    cfg = parse_policy("max_groups=6,grow_occupancy=0.7,"
+                       "hysteresis_ticks=2")
+    assert cfg.max_groups == 6
+    assert cfg.grow_occupancy == 0.7
+    assert cfg.hysteresis_ticks == 2
+    assert cfg.cooldown_seconds == 300.0  # unnamed knobs keep defaults
+    with pytest.raises(AutoscaleError, match="unknown"):
+        parse_policy("bogus_knob=1")
+    with pytest.raises(AutoscaleError, match="bad autoscale"):
+        parse_policy("max_groups=lots")
+    with pytest.raises(AutoscaleError, match="min_groups"):
+        PolicyConfig(min_groups=5, max_groups=2).validate()
+    with pytest.raises(AutoscaleError, match="thrash"):
+        PolicyConfig(grow_occupancy=0.5,
+                     shrink_occupancy=0.6).validate()
+    with pytest.raises(AutoscaleError, match="hysteresis"):
+        PolicyConfig(hysteresis_ticks=0).validate()
+
+
+# -- controller end-to-end (apply mode) --------------------------------------
+
+
+def test_controller_apply_mode_drives_real_transitions():
+    """ISSUE 20 acceptance: the autoscaler in apply mode drives a real
+    grow AND a real shrink through the rebalance coordinator, with the
+    transition-in-flight guard holding proposals off until each one
+    converges (including GC)."""
+    n_ns = 10
+    engines = [_engine(), _engine()]
+    p = ShardedEngine(_map(2), engines)
+    p.write_relationships(_seed_writes(n_ns))
+
+    sig = {"occupancy": 0.95}
+
+    def signal_fn():
+        return Signals(
+            n_groups=len(p.groups),
+            occupancy=sig["occupancy"],
+            burn_rate=0.0,
+            rebalance_active=p.rebalance_status() is not None,
+            gc_pending=any(not t.gc_complete
+                           for t in p._archived_transitions))
+
+    spares = []
+
+    def grow_source(gi):
+        e = _engine()
+        spares.append(e)
+        return ((("127.0.0.1", 0),), e)
+
+    ctl = AutoscaleController(
+        p, AutoscalePolicy(PolicyConfig(
+            min_groups=2, max_groups=3, hysteresis_ticks=2,
+            cooldown_seconds=0.0)),
+        mode="apply", signal_fn=signal_fn,
+        grow_group_source=grow_source)
+    started0 = metrics.counter("autoscale_transitions_total",
+                               action="grow", outcome="started").value
+
+    ticks = 0
+    prop = None
+    while prop is None and ticks < 10:
+        prop = ctl.tick(now=float(ticks))
+        ticks += 1
+    assert prop is not None and prop.action == "grow"
+    assert ticks >= 2  # hysteresis made it earn the streak
+    assert metrics.counter("autoscale_transitions_total",
+                           action="grow",
+                           outcome="started").value > started0
+    assert p._coordinator is not None
+    assert p._coordinator.wait(90) and p._coordinator.error is None
+    assert len(p.groups) == 3 and p.map.version == 2
+    _wait_gc(p)
+    st = ctl.status()
+    assert st["mode"] == "apply" and st["transitions"] == 1
+    assert st["last_proposal"]["action"] == "grow"
+
+    # load drains away: the same controller proposes and applies the
+    # shrink back down through shrink_map + the coordinator
+    sig["occupancy"] = 0.02
+    prop = None
+    ticks = 100
+    while prop is None and ticks < 120:
+        prop = ctl.tick(now=float(ticks))
+        ticks += 1
+    assert prop is not None and prop.action == "shrink"
+    assert p._coordinator is not None
+    assert p._coordinator.wait(90) and p._coordinator.error is None
+    assert len(p.groups) == 2 and p.map.version == 3
+    _wait_gc(p)
+    assert ctl.status()["transitions"] == 2
+    for i in range(n_ns):
+        assert p.check(CheckItem("pod", f"ns{i}/p0", "view", "user",
+                                 f"u{i % 4}")), i
+    p.close()
+
+
+def test_controller_dry_run_proposes_but_never_acts():
+    engines = [_engine(), _engine()]
+    p = ShardedEngine(_map(2), engines)
+    props0 = metrics.counter("autoscale_proposals_total",
+                             action="grow").value
+    ctl = AutoscaleController(
+        p, AutoscalePolicy(PolicyConfig(hysteresis_ticks=1,
+                                        cooldown_seconds=0.0)),
+        mode="dry-run",
+        signal_fn=lambda: Signals(n_groups=2, occupancy=0.99))
+    prop = ctl.tick(now=0.0)
+    assert prop is not None and prop.action == "grow"
+    assert metrics.counter("autoscale_proposals_total",
+                           action="grow").value > props0
+    # surfaced, counted — and nothing moved
+    assert p.rebalance_status() is None
+    assert p.map.version == 1 and len(p.groups) == 2
+    assert ctl.status()["last_proposal"]["mode"] == "dry-run"
+    assert ctl.status()["transitions"] == 0
+    p.close()
+
+
+def test_controller_apply_grow_without_source_fails_safe():
+    engines = [_engine(), _engine()]
+    p = ShardedEngine(_map(2), engines)
+    failed0 = metrics.counter("autoscale_transitions_total",
+                              action="grow", outcome="failed").value
+    ctl = AutoscaleController(
+        p, AutoscalePolicy(PolicyConfig(hysteresis_ticks=1,
+                                        cooldown_seconds=0.0)),
+        mode="apply",
+        signal_fn=lambda: Signals(n_groups=2, occupancy=0.99))
+    assert ctl.tick(now=0.0) is not None  # proposed...
+    # ...but acting failed SAFE: counted, fleet untouched
+    assert metrics.counter("autoscale_transitions_total",
+                           action="grow",
+                           outcome="failed").value > failed0
+    assert p.rebalance_status() is None and len(p.groups) == 2
+    assert ctl.status()["transitions"] == 0
+    p.close()

@@ -71,6 +71,7 @@ def test_hung_plugin_falls_back_to_cpu_and_emits_json():
     _assert_tiered_schema(out["tiered"])
     _assert_shard_schema(out["shard"])
     _assert_rebalance_schema(out["rebalance"])
+    _assert_autoscale_schema(out["autoscale"])
     _assert_migration_schema(out["migration"])
     _assert_macro_schema(out["macro"])
     # ISSUE 19: the tiny run also carries the same-seed macro sweep
@@ -224,6 +225,41 @@ def _assert_rebalance_schema(rb: dict) -> None:
     for key in ("goodput_paused_ops_s", "goodput_moving_ops_s",
                 "goodput_ratio_moving_over_paused"):
         v = rb[key]
+        assert v is None or (isinstance(v, (int, float)) and v == v
+                             and v > 0 and abs(v) != float("inf")), \
+            (key, v)
+
+
+def _assert_autoscale_schema(au: dict) -> None:
+    """The ISSUE 20 elastic scale-out contract: a cross-namespace
+    reference schema answered correctly WITHOUT replication (oracle
+    parity with exactly one fleet-wide copy of every reference tuple),
+    the exchange's boundary mass counter-measured (bounded rounds,
+    finite wire bytes), and an SLO-driven shrink PROPOSED by the
+    policy and APPLIED by the controller under load with zero acked
+    loss and zero fail-open probes."""
+    assert au["n_teams"] >= 1 and au["n_docs"] >= 1
+    fr = au["frontier"]
+    assert fr["parity_checks"] >= 1
+    assert fr["parity_ok"] is True
+    assert fr["lookup_parity_ok"] is True
+    assert fr["reference_single_copy"] is True
+    assert fr["exchanges"] >= 1
+    assert 1 <= fr["rounds_max"] <= 8
+    assert fr["boundary_tuples"] >= 1
+    for key in ("scatter_bytes", "gather_bytes"):
+        v = fr[key]
+        assert isinstance(v, int) and v > 0, (key, v)
+    sh = au["shrink"]
+    assert sh["proposal_action"] == "shrink"
+    assert sh["ticks_to_fire"] >= 2  # hysteresis held, not a one-tick
+    assert sh["groups_after"] == 2
+    assert sh["move_seconds"] > 0
+    assert sh["zero_acked_write_loss"] is True
+    assert sh["fail_open_probes"] == 0
+    for key in ("goodput_paused_ops_s", "goodput_moving_ops_s",
+                "goodput_ratio_moving_over_paused"):
+        v = sh[key]
         assert v is None or (isinstance(v, (int, float)) and v == v
                              and v > 0 and abs(v) != float("inf")), \
             (key, v)

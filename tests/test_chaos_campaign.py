@@ -673,9 +673,18 @@ def test_inproc_campaign_one_seed_zero_violations(tmp_path):
     assert result["seeds"]["0"]["fault_digest"] == BROWNOUT_SEED0_DIGEST
     episodes = {e["episode"] for e in result["episodes"]}
     assert episodes == {"seed0/baseline", "seed0/brownout",
-                        "seed0/migration"}
+                        "seed0/elastic", "seed0/migration"}
     # records actually flowed (checks, writes, lookups all exercised)
     assert all(e["records"] > 20 for e in result["episodes"])
+    # the elastic episode completed its full grow -> shrink -> grow
+    # cycle (each phase converged, group count home where it started +1
+    # from the final grow)
+    elastic = next(e for e in result["episodes"]
+                   if e["episode"] == "seed0/elastic")
+    phases = [(t["phase"], t["converged"]) for t in elastic["transitions"]]
+    assert phases == [("grow", True), ("shrink", True),
+                      ("regrow", True)]
+    assert elastic["transitions"][-1]["groups"] == 3
 
 
 # -- slow compositions (the CI chaos job) -------------------------------------
@@ -693,9 +702,15 @@ def test_subprocess_campaign_one_seed(tmp_path):
     assert result["ok"], result["violations"]
     names = [e["episode"] for e in result["episodes"]]
     assert names == ["seed0/baseline", "seed0/brownout", "seed0/crash",
-                     "seed0/migration"]
+                     "seed0/elastic", "seed0/migration"]
     crash = result["episodes"][2]
     assert crash["killed"], "the crash episode never killed a leader"
+    elastic = result["episodes"][3]
+    assert elastic["killed"], \
+        "the elastic episode never killed the retiring group's leader"
+    assert [(t["phase"], t["converged"])
+            for t in elastic["transitions"]] == \
+        [("grow", True), ("shrink", True), ("regrow", True)]
     brown = result["episodes"][1]
     assert brown["retries_at_faulted_group"] is not None
 

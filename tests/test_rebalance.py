@@ -638,15 +638,25 @@ def test_options_validation_rebalance_to():
     with pytest.raises(OptionsError, match="must exceed"):
         Options(shard_map=good, rebalance_to=good, rule_content="x",
                 upstream=object()).validate()
-    with pytest.raises(OptionsError, match="REMOVE groups"):
-        Options(shard_map=good,
+    good3 = ('{"version": 1, "groups": [["127.0.0.1:1"], '
+             '["127.0.0.1:2"], ["127.0.0.1:3"]]}')
+    with pytest.raises(OptionsError, match="at most ONE group"):
+        Options(shard_map=good3,
                 rebalance_to='{"version": 2, '
                              '"groups": [["127.0.0.1:1"]]}',
                 rule_content="x", upstream=object()).validate()
-    # a valid transition map validates
+    with pytest.raises(OptionsError, match="LAST group"):
+        Options(shard_map=good,
+                rebalance_to='{"version": 2, '
+                             '"groups": [["127.0.0.1:2"]]}',
+                rule_content="x", upstream=object()).validate()
+    # a valid transition map validates; so does a tail-group shrink
     Options(shard_map=good,
             rebalance_to='{"version": 2, "groups": [["127.0.0.1:1"], '
                          '["127.0.0.1:2"]], "virtual_nodes": 96}',
+            rule_content="x", upstream=object()).validate()
+    Options(shard_map=good,
+            rebalance_to='{"version": 2, "groups": [["127.0.0.1:1"]]}',
             rule_content="x", upstream=object()).validate()
 
 

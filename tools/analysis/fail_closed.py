@@ -47,6 +47,14 @@ SCOPE_FILES = (
     # the live schema migrator: a swallowed failure mid-backfill or
     # mid-cut leaves two graphs half-routed against one schema
     "migration/migrator.py",
+    # the frontier exchange must under-approximate on ANY failure —
+    # a swallowed expansion error that defaulted a verdict open would
+    # grant across a shard boundary nobody proved
+    "scaleout/frontier.py",
+    # the autoscale controller acts on the live shard map: a swallowed
+    # apply failure must count + leave the fleet untouched, never
+    # half-start a transition
+    "autoscale/controller.py",
 )
 
 BUILDER = "_fail_closed_503"
